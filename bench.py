@@ -1,0 +1,50 @@
+"""Headline benchmark: AlexNet training throughput on real TPU.
+
+Mirrors the reference's measurement protocol exactly — N timed
+iterations between fences, ``tp = iters*batch/elapsed`` images/s
+(``cnn.cc:122-129``).  Prints ONE JSON line for the driver.
+
+The reference publishes no absolute numbers (BASELINE.md); the target
+we normalize against is the 4×V100 AlexNet figure the driver's
+BASELINE.json names — approximated here as 1500 img/s per the ICML'18
+era hardware — so ``vs_baseline`` is imgs/sec/chip over (target/4).
+"""
+
+import json
+import sys
+
+import jax
+
+BASELINE_IMGS_PER_SEC_PER_CHIP = 1500.0 / 4.0  # 4xV100 AlexNet target, per chip
+
+
+def main():
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models.alexnet import build_alexnet
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    batch_size = 256
+    n_chips = len(jax.devices())
+    cfg = FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
+    ff = build_alexnet(batch_size=batch_size, image_size=229, num_classes=1000,
+                       config=cfg)
+    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.01, momentum=0.9, weight_decay=1e-4))
+    trainer = Trainer(ex)
+    stats = trainer.fit(iterations=20, warmup=3)
+    per_chip = stats["samples_per_s"] / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_imgs_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Run configuration and CLI flag parsing.
+
+Mirrors the reference's two-tier flag system (reference:
+``src/runtime/model.cc:695-785`` defaults + ``parse_args``, and
+``include/config.h:50-77`` for the FFConfig fields).  The Legion
+``-ll:gpu`` worker count becomes ``-ll:tpu`` (number of TPU chips to use;
+defaults to all visible devices), and the strategy file is JSON rather
+than protobuf (see ``flexflow_tpu/parallel/strategy.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Global training configuration.
+
+    Field defaults mirror ``FFConfig::FFConfig`` (reference:
+    ``src/runtime/model.cc:695-708``): batch 64, lr 0.01, wd 0.0001,
+    1 epoch, profiling off.
+    """
+
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    iterations: int = 10
+    # Device topology.  num_devices == the reference's workersPerNode *
+    # numNodes (reference: model.cc:765-779 re-reads -ll:gpu / --nodes).
+    num_devices: int = 0  # 0 = use all visible jax devices
+    num_nodes: int = 1
+    # Data / strategy files.
+    dataset_path: Optional[str] = None  # -d; None => synthetic input
+    strategy_file: Optional[str] = None  # -s
+    profiling: bool = False
+    # Numerics.  Activations/params follow the input tensors' dtype,
+    # which defaults to this (FFModel.create_tensor).
+    compute_dtype: str = "float32"  # "bfloat16" for the TPU fast path
+    seed: int = 1234  # the reference NMT fixed seed (nmt/rnn.cu:345-349)
+    # Synthetic input (reference: config.h:73 syntheticInput)
+    synthetic_input: bool = True
+
+    @staticmethod
+    def parse_args(argv: Sequence[str]) -> "FFConfig":
+        """Parse the reference's CLI surface.
+
+        Flags (reference ``src/runtime/model.cc:729-785``):
+        ``-e`` epochs, ``-b`` batch size, ``--lr`` learning rate,
+        ``--wd`` weight decay, ``-d`` dataset, ``-s`` strategy file,
+        ``-ll:tpu`` devices (was ``-ll:gpu``), ``--nodes``,
+        ``--profiling``, ``-i``/``--iterations``.
+        Unknown flags are ignored (Legion-style pass-through).
+        """
+        cfg = FFConfig()
+        i = 0
+        argv = list(argv)
+        while i < len(argv):
+            a = argv[i]
+
+            def _next() -> str:
+                nonlocal i
+                i += 1
+                if i >= len(argv):
+                    raise ValueError(f"flag {a} expects a value")
+                return argv[i]
+
+            if a == "-e" or a == "--epochs":
+                cfg.epochs = int(_next())
+            elif a == "-b" or a == "--batch-size":
+                cfg.batch_size = int(_next())
+            elif a == "--lr" or a == "--learning-rate":
+                cfg.learning_rate = float(_next())
+            elif a == "--wd" or a == "--weight-decay":
+                cfg.weight_decay = float(_next())
+            elif a == "-d" or a == "--dataset":
+                cfg.dataset_path = _next()
+                cfg.synthetic_input = False
+            elif a == "-s" or a == "--strategy":
+                cfg.strategy_file = _next()
+            elif a in ("-ll:tpu", "-ll:gpu"):
+                cfg.num_devices = int(_next())
+            elif a == "--nodes":
+                cfg.num_nodes = int(_next())
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a in ("-i", "--iterations"):
+                cfg.iterations = int(_next())
+            elif a == "--dtype":
+                cfg.compute_dtype = _next()
+            elif a == "--seed":
+                cfg.seed = int(_next())
+            i += 1
+        return cfg
+
+    def resolve_num_devices(self) -> int:
+        if self.num_devices > 0:
+            return self.num_devices
+        import jax
+
+        return len(jax.devices())

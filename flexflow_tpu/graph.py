@@ -1,0 +1,224 @@
+"""The FFModel graph-builder API.
+
+Mirrors the reference's ``FFModel`` (``include/model.h:197-307``): apps
+call ``conv2d/dense/embedding/...`` to append ops to ``self.layers``
+(each ctor in the reference creates regions/partitions and no compute —
+here each builder infers shapes and no compute), then hand the model to
+the runtime (``flexflow_tpu/runtime``) which compiles the whole graph +
+strategy into one jitted train step — the TPU equivalent of the
+reference's per-op Legion index launches wrapped in a captured trace
+(``dlrm.cc:151-156``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ops import (
+    BatchNorm,
+    Concat,
+    Conv2D,
+    Embedding,
+    Flat,
+    Linear,
+    MSELoss,
+    MultiEmbedding,
+    Op,
+    Pool2D,
+    Reshape,
+    SoftmaxCrossEntropy,
+    TensorSpec,
+)
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.layers: List[Op] = []
+        self.input_tensors: List[TensorSpec] = []
+        self._name_counts: Dict[str, int] = {}
+
+    # -- naming -----------------------------------------------------------
+
+    def _unique(self, base: str, name: Optional[str]) -> str:
+        existing = {op.name for op in self.layers} | {t.name for t in self.input_tensors}
+        if name is not None:
+            assert name not in existing, f"duplicate op name {name!r}"
+            return name
+        while True:
+            i = self._name_counts.get(base, 0)
+            self._name_counts[base] = i + 1
+            candidate = f"{base}{i}"
+            if candidate not in existing:
+                return candidate
+
+    def _add(self, op: Op) -> TensorSpec:
+        self.layers.append(op)
+        return op.outputs[0]
+
+    # -- inputs -----------------------------------------------------------
+
+    def create_tensor(
+        self,
+        shape: Sequence[int],
+        dtype=None,
+        name: Optional[str] = None,
+        dim_axes: Optional[Sequence[Optional[str]]] = None,
+    ) -> TensorSpec:
+        """Declare an input placeholder (reference:
+        ``create_tensor<NDIM>`` ``model.cc:213-280``).  4-D shapes are
+        NHWC.  Default sharding tags: batch on dim 0, and NHWC tags for
+        4-D tensors.  Default dtype is ``config.compute_dtype``."""
+        if dtype is None:
+            dtype = jnp.dtype(self.config.compute_dtype)
+        shape = tuple(shape)
+        if dim_axes is None:
+            if len(shape) == 4:
+                dim_axes = ("n", "h", "w", "c")
+            else:
+                dim_axes = ("n",) + tuple(None for _ in shape[1:])
+        t = TensorSpec(
+            name=self._unique("input", name),
+            shape=shape,
+            dtype=dtype,
+            dim_axes=tuple(dim_axes),
+            producer=None,
+        )
+        self.input_tensors.append(t)
+        return t
+
+    # -- op builders (reference: model.h:197-307) --------------------------
+
+    def conv2d(
+        self,
+        x: TensorSpec,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        activation: Optional[str] = None,
+        use_bias: bool = True,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        return self._add(
+            Conv2D(
+                self._unique("conv2d", name), x, out_channels,
+                kernel_h, kernel_w, stride_h, stride_w, padding_h, padding_w,
+                activation=activation, use_bias=use_bias, **kw,
+            )
+        )
+
+    def pool2d(
+        self,
+        x: TensorSpec,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        pool_type: str = "max",
+        activation: Optional[str] = None,
+        name: Optional[str] = None,
+    ) -> TensorSpec:
+        return self._add(
+            Pool2D(
+                self._unique("pool2d", name), x,
+                kernel_h, kernel_w, stride_h, stride_w, padding_h, padding_w,
+                pool_type=pool_type, activation=activation,
+            )
+        )
+
+    def batch_norm(self, x: TensorSpec, relu: bool = False, name: Optional[str] = None) -> TensorSpec:
+        return self._add(BatchNorm(self._unique("batchnorm", name), x, relu=relu))
+
+    def dense(
+        self,
+        x: TensorSpec,
+        out_dim: int,
+        activation: Optional[str] = None,
+        use_bias: bool = True,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        return self._add(
+            Linear(self._unique("dense", name), x, out_dim,
+                   activation=activation, use_bias=use_bias, **kw)
+        )
+
+    # The reference calls this ``linear`` in places; keep an alias.
+    linear = dense
+
+    def embedding(
+        self,
+        x: TensorSpec,
+        num_entries: int,
+        out_dim: int,
+        aggr: str = "sum",
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        return self._add(
+            Embedding(self._unique("embedding", name), x, num_entries, out_dim,
+                      aggr=aggr, **kw)
+        )
+
+    def multi_embedding(
+        self,
+        x: TensorSpec,
+        num_tables: int,
+        num_entries: int,
+        out_dim: int,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        return self._add(
+            MultiEmbedding(self._unique("embeddings", name), x, num_tables,
+                           num_entries, out_dim, **kw)
+        )
+
+    def concat(self, inputs: Sequence[TensorSpec], axis: int, name: Optional[str] = None) -> TensorSpec:
+        return self._add(Concat(self._unique("concat", name), inputs, axis))
+
+    def flat(self, x: TensorSpec, name: Optional[str] = None) -> TensorSpec:
+        return self._add(Flat(self._unique("flat", name), x))
+
+    def reshape(self, x: TensorSpec, shape: Sequence[int], name: Optional[str] = None) -> TensorSpec:
+        return self._add(Reshape(self._unique("reshape", name), x, shape))
+
+    def softmax(self, logits: TensorSpec, labels: TensorSpec, name: Optional[str] = None) -> TensorSpec:
+        """Fused softmax + cross-entropy loss (reference: softmax op is
+        fused with the loss, ``src/ops/softmax.cu:91-160``)."""
+        return self._add(SoftmaxCrossEntropy(self._unique("softmax", name), logits, labels))
+
+    def mse_loss(self, pred: TensorSpec, label: TensorSpec, reduction: str = "mean",
+                 name: Optional[str] = None) -> TensorSpec:
+        return self._add(MSELoss(self._unique("mseloss", name), pred, label, reduction))
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def loss_ops(self) -> List[Op]:
+        return [op for op in self.layers if op.is_loss]
+
+    def find_op(self, name: str) -> Op:
+        for op in self.layers:
+            if op.name == name:
+                return op
+        raise KeyError(name)
+
+    def summary(self) -> str:
+        lines = []
+        for t in self.input_tensors:
+            lines.append(f"input   {t.name:24s} {t.shape}")
+        for op in self.layers:
+            outs = ", ".join(str(o.shape) for o in op.outputs)
+            lines.append(f"{type(op).__name__:8s}{op.name:24s} -> {outs}")
+        return "\n".join(lines)

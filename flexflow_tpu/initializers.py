@@ -1,0 +1,100 @@
+"""Parameter initializers.
+
+The reference registers four initializer task families — GlorotUniform,
+Zero, Uniform, Norm — each a Legion task driving cuRAND on the weight
+region (reference: ``include/initializer.h:26-81`` and
+``src/runtime/initializer_kernel.cu:24-179``).  Here each is a pure
+function of a jax PRNG key; sharding of the produced array is decided by
+the runtime (params are created via jit so XLA materializes them
+directly in their target sharding — no host round-trip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, shape: Sequence[int], dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class GlorotUniform(Initializer):
+    """Glorot/Xavier uniform: ``scale = sqrt(6/(fan_in+fan_out))``
+    (reference: ``initializer_kernel.cu:24-46``).
+
+    Fan factors are layout-dependent (our conv kernels are HWIO, linear
+    kernels out-major), so ops pass them explicitly; the fallback
+    treats dim0 as fan_out, dim1 as fan_in with trailing dims as the
+    receptive field (the out-major 2-D linear case).
+    """
+
+    fan_in: int | None = None
+    fan_out: int | None = None
+
+    def __call__(self, key, shape, dtype):
+        shape = tuple(shape)
+        fan_in, fan_out = self.fan_in, self.fan_out
+        if fan_in is None or fan_out is None:
+            if len(shape) >= 2:
+                receptive = 1
+                for d in shape[2:]:
+                    receptive *= d
+                fan_in = shape[1] * receptive
+                fan_out = shape[0] * receptive
+            else:
+                fan_in = fan_out = shape[0]
+        scale = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(
+            key, shape, dtype=jnp.float32, minval=-scale, maxval=scale
+        ).astype(dtype)
+
+
+@dataclasses.dataclass
+class ZeroInitializer(Initializer):
+    """Zero fill (reference: ``initializer_kernel.cu:60-90``)."""
+
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(tuple(shape), dtype=dtype)
+
+
+@dataclasses.dataclass
+class UniformInitializer(Initializer):
+    """Uniform in [min, max] (reference: ``initializer_kernel.cu:92-109``)."""
+
+    min_val: float = -0.1
+    max_val: float = 0.1
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(
+            key, tuple(shape), dtype=jnp.float32, minval=self.min_val, maxval=self.max_val
+        ).astype(dtype)
+
+
+@dataclasses.dataclass
+class NormInitializer(Initializer):
+    """Gaussian N(mean, stddev) (reference: ``initializer_kernel.cu:111-179``;
+    the reference's <4-element CPU fallback is unnecessary here)."""
+
+    mean: float = 0.0
+    stddev: float = 1.0
+
+    def __call__(self, key, shape, dtype):
+        return (
+            self.mean
+            + self.stddev * jax.random.normal(key, tuple(shape), dtype=jnp.float32)
+        ).astype(dtype)
+
+
+@dataclasses.dataclass
+class OnesInitializer(Initializer):
+    """Deterministic all-ones — the reference's ``PARAMETER_ALL_ONES``
+    reproducibility mode (reference: ``conv_2d.cu:394-399``)."""
+
+    def __call__(self, key, shape, dtype):
+        return jnp.ones(tuple(shape), dtype=dtype)

@@ -1,0 +1,44 @@
+"""Training metrics.
+
+Reference: ``PerfMetrics`` (``include/model.h:128-132``) accumulated by
+device atomicAdd inside the MSELoss backward kernels and folded across
+shards via Legion future chaining + ``UPDATE_METRICS_TASK``
+(``src/runtime/model.cc:597-627``, ``src/ops/mse_loss.cu:213-221``).
+Here per-step metrics come out of the jitted step as scalars; this
+class does the host-side running accumulation and printing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    train_loss: float = 0.0
+    train_correct: int = 0
+    train_all: int = 0
+    steps: int = 0
+
+    def update(self, step_metrics) -> None:
+        """Fold one step's metrics dict (device scalars ok)."""
+        self.train_loss += float(step_metrics.get("train_loss", 0.0))
+        self.train_correct += int(step_metrics.get("train_correct", 0))
+        self.train_all += int(step_metrics.get("train_all", 0))
+        self.steps += 1
+
+    @property
+    def avg_loss(self) -> float:
+        return self.train_loss / max(self.steps, 1)
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(self.train_all, 1)
+
+    def report(self) -> str:
+        # Mirrors update_metrics_task's printout (model.cc:597-627).
+        return (
+            f"[Metrics] loss={self.avg_loss:.6f} "
+            f"accuracy={100.0 * self.accuracy:.2f}% "
+            f"({self.train_correct}/{self.train_all})"
+        )

@@ -1,0 +1,3 @@
+from flexflow_tpu.models.alexnet import build_alexnet
+
+__all__ = ["build_alexnet"]

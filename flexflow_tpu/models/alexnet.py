@@ -1,0 +1,44 @@
+"""AlexNet — the reference's canonical single-model app.
+
+Op list mirrors ``alexnet.cc:3-19`` exactly (conv1..conv5 with fused
+relu, 3 maxpools, flat, 3 linears, fused softmax+CE), with input
+229×229 RGB in NHWC and int labels.  Convs default to relu and the last
+linear has none, as in the reference (``alexnet.cc:17`` passes
+``false/*relu*/``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+
+
+def build_alexnet(
+    batch_size: int = 64,
+    image_size: int = 229,
+    num_classes: int = 1000,
+    dtype=None,
+    config: FFConfig | None = None,
+) -> FFModel:
+    """``dtype=None`` follows ``config.compute_dtype``."""
+    ff = FFModel(config or FFConfig(batch_size=batch_size))
+    img = ff.create_tensor(
+        (batch_size, image_size, image_size, 3), dtype=dtype, name="image"
+    )
+    label = ff.create_tensor((batch_size,), dtype=jnp.int32, name="label")
+    t = ff.conv2d(img, 64, 11, 11, 4, 4, 2, 2, activation="relu", name="conv1")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool1")
+    t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation="relu", name="conv2")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool2")
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation="relu", name="conv3")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu", name="conv4")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu", name="conv5")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool3")
+    t = ff.flat(t, name="flat")
+    t = ff.dense(t, 4096, activation="relu", name="linear1")
+    t = ff.dense(t, 4096, activation="relu", name="linear2")
+    t = ff.dense(t, num_classes, activation=None, name="linear3")
+    ff.softmax(t, label, name="softmax")
+    return ff

@@ -1,0 +1,24 @@
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+from flexflow_tpu.ops.conv import Conv2D, Flat, Pool2D
+from flexflow_tpu.ops.embedding import Embedding, MultiEmbedding
+from flexflow_tpu.ops.linear import Linear
+from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
+from flexflow_tpu.ops.norm import BatchNorm
+from flexflow_tpu.ops.tensor_ops import Concat, Reshape
+
+__all__ = [
+    "Op",
+    "ParamSpec",
+    "TensorSpec",
+    "Conv2D",
+    "Pool2D",
+    "Flat",
+    "BatchNorm",
+    "Linear",
+    "Embedding",
+    "MultiEmbedding",
+    "Concat",
+    "Reshape",
+    "SoftmaxCrossEntropy",
+    "MSELoss",
+]

@@ -1,0 +1,106 @@
+"""Operator base classes.
+
+The reference gives every op one Legion task family (init/fwd/bwd) and
+makes each op own its output region + partitions (reference:
+``include/model.h:141-156``, pattern described at ``src/ops/*.cu``).
+Here an op is a pure-function node in the graph: it declares its
+parameters (shape/dtype/initializer/sharding axes), infers its output
+specs, and implements ``forward`` in jax.  Backward is jax autodiff —
+there are no hand-written bwd tasks; XLA emits the transposed kernels
+the reference wrote by hand (e.g. ``linear.cu:388-488``).
+
+Semantic sharding axes: each tensor dim is tagged 'n' (sample), 'c'
+(channel/feature), 'h', 'w' or None; the mesh plan maps tags to mesh
+axes per the op's ParallelConfig (see parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from flexflow_tpu.initializers import Initializer
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    shape: Tuple[int, ...]
+    dtype: Any
+    initializer: Initializer
+    # Semantic axis per dim, for sharded parameters (TP linear kernels,
+    # table-parallel embeddings).  None => replicated dim.
+    dim_axes: Tuple[Optional[str], ...] = ()
+
+    def __post_init__(self):
+        if not self.dim_axes:
+            self.dim_axes = tuple(None for _ in self.shape)
+
+
+@dataclasses.dataclass
+class TensorSpec:
+    """Symbolic tensor in the op graph (the reference's ``Tensor`` /
+    LogicalRegion handle, ``include/model.h:141-156``).  4-D activations
+    are NHWC — the TPU-native layout (the reference is NCHW; the lane
+    dimension on TPU wants channels last)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: Any
+    dim_axes: Tuple[Optional[str], ...]
+    producer: Optional["Op"] = None
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"TensorSpec({self.name}, {self.shape}, {self.dtype}, axes={self.dim_axes})"
+
+
+class Op:
+    """Graph node: owns name, inputs, outputs, params."""
+
+    #: Set True for ops producing a scalar loss contribution + metrics.
+    is_loss = False
+
+    def __init__(self, name: str, inputs: Sequence[TensorSpec]):
+        self.name = name
+        self.inputs: List[TensorSpec] = list(inputs)
+        self.outputs: List[TensorSpec] = []
+
+    # -- static structure -------------------------------------------------
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        return {}
+
+    def state_specs(self) -> Dict[str, ParamSpec]:
+        """Non-trained mutable state (e.g. batchnorm running stats)."""
+        return {}
+
+    # -- execution --------------------------------------------------------
+
+    def forward(
+        self,
+        params: Dict[str, jax.Array],
+        xs: Sequence[jax.Array],
+        state: Dict[str, jax.Array],
+        training: bool,
+    ):
+        """Returns (ys: list of arrays, new_state dict).
+
+        Loss ops instead return ((loss_scalar, metrics_dict), new_state).
+        """
+        raise NotImplementedError
+
+    def _make_output(self, shape, dtype, dim_axes, idx: int = 0) -> TensorSpec:
+        t = TensorSpec(
+            name=f"{self.name}:out{idx}" if idx else f"{self.name}:out",
+            shape=tuple(shape),
+            dtype=dtype,
+            dim_axes=tuple(dim_axes),
+            producer=self,
+        )
+        self.outputs.append(t)
+        return t

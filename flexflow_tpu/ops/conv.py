@@ -1,0 +1,186 @@
+"""Convolution / pooling / flatten operators.
+
+Reference equivalents: ``src/ops/conv_2d.cu`` (cudnnConvolution* with
+per-shard 4-D (w,h,c,n) task grids and implicit halo exchange via
+aliased Legion partitions), ``src/ops/pool_2d.cu`` (cudnnPooling*),
+``src/ops/flat.cu`` (partition-by-image reshuffle).  Here the kernels
+are single XLA HLO ops — ``conv_general_dilated`` / ``reduce_window`` —
+and spatial (h/w) splits become GSPMD spatial partitioning: XLA inserts
+the halo exchanges the reference got from Legion repartitioning
+(``conv_2d.cu:177-209``).  Layout is NHWC/HWIO (TPU-native; channels on
+the lane dim), not the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.initializers import GlorotUniform, ZeroInitializer
+from flexflow_tpu.ops.activations import apply_activation, check_activation
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+class Conv2D(Op):
+    """2-D convolution (+bias, +fused activation).
+
+    Reference: ``src/ops/conv_2d.cu:46-210`` (ctor), ``:480-547`` (fwd
+    task), ``:593-684`` (bwd tasks).  Weights are replicated across
+    data-parallel shards and sharded on out-channel under a ``c`` split;
+    gradient summation over replicas (the reference's replicated grad
+    regions, ``model.cc:378-400``) is XLA's psum from autodiff.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        activation: Optional[str] = None,
+        use_bias: bool = True,
+        kernel_initializer=None,
+        bias_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 4, f"conv2d input must be NHWC, got {x.shape}"
+        check_activation(activation)
+        n, h, w, cin = x.shape
+        self.attrs = dict(
+            out_channels=out_channels,
+            kernel=(kernel_h, kernel_w),
+            stride=(stride_h, stride_w),
+            padding=(padding_h, padding_w),
+            activation=activation,
+            use_bias=use_bias,
+        )
+        self.in_channels = cin
+        # HWIO layout: fan_in = kh*kw*cin, fan_out = kh*kw*cout.
+        self.kernel_initializer = kernel_initializer or GlorotUniform(
+            fan_in=kernel_h * kernel_w * cin,
+            fan_out=kernel_h * kernel_w * out_channels,
+        )
+        self.bias_initializer = bias_initializer or ZeroInitializer()
+        out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
+        out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
+        self._make_output((n, out_h, out_w, out_channels), x.dtype, ("n", "h", "w", "c"))
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        kh, kw = self.attrs["kernel"]
+        cout = self.attrs["out_channels"]
+        specs = {
+            "kernel": ParamSpec(
+                (kh, kw, self.in_channels, cout),
+                self.outputs[0].dtype,
+                self.kernel_initializer,
+                (None, None, None, "c"),
+            )
+        }
+        if self.attrs["use_bias"]:
+            specs["bias"] = ParamSpec(
+                (cout,), self.outputs[0].dtype, self.bias_initializer, ("c",)
+            )
+        return specs
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        sh, sw = self.attrs["stride"]
+        ph, pw = self.attrs["padding"]
+        # bf16 inputs accumulate in f32 on the MXU by default; no
+        # preferred_element_type (its conv transpose rule rejects the
+        # mixed-dtype cotangent).
+        y = lax.conv_general_dilated(
+            x,
+            params["kernel"],
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=CONV_DIMS,
+        )
+        if self.attrs["use_bias"]:
+            y = y + params["bias"]
+        return [apply_activation(y, self.attrs["activation"])], state
+
+
+class Pool2D(Op):
+    """Max/average pooling via ``lax.reduce_window``.
+
+    Reference: ``src/ops/pool_2d.cu`` (cudnnPoolingForward/Backward).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int,
+        padding_w: int,
+        pool_type: str = "max",
+        activation: Optional[str] = None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 4
+        assert pool_type in ("max", "avg")
+        check_activation(activation)
+        n, h, w, c = x.shape
+        self.attrs = dict(
+            kernel=(kernel_h, kernel_w),
+            stride=(stride_h, stride_w),
+            padding=(padding_h, padding_w),
+            pool_type=pool_type,
+            activation=activation,
+        )
+        out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
+        out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
+        self._make_output((n, out_h, out_w, c), x.dtype, ("n", "h", "w", "c"))
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        kh, kw = self.attrs["kernel"]
+        sh, sw = self.attrs["stride"]
+        ph, pw = self.attrs["padding"]
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        padding = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        if self.attrs["pool_type"] == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            # cuDNN AVG_COUNT_INCLUDE_PADDING semantics: divide by window size.
+            y = s / (kh * kw)
+        return [apply_activation(y, self.attrs["activation"])], state
+
+
+class Flat(Op):
+    """Flatten NHWC → (N, H*W*C), bridging the conv grid to the FC grid.
+
+    The reference performs this as a pure Legion repartition through a
+    rect-image partition (``src/ops/flat.cu:81-124``) — zero kernel
+    code; here it is a reshape and the cross-shard reshuffle, if any,
+    is an XLA resharding collective.  The flattened feature dim is
+    tagged None (replicated): a downstream TP linear re-shards it via
+    its own contraction.
+    """
+
+    def __init__(self, name: str, x: TensorSpec):
+        super().__init__(name, [x])
+        assert x.ndim == 4
+        n, h, w, c = x.shape
+        self._make_output((n, h * w * c), x.dtype, ("n", None))
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        return [x.reshape(x.shape[0], -1)], state

@@ -1,0 +1,118 @@
+"""Embedding operators.
+
+Reference: ``src/ops/embedding.cu`` — custom gather fwd / atomicAdd
+scatter bwd kernels (``embedding.cu:128-158``) over a sample-dim-only
+task grid, with *table* parallelism done purely by mapper placement
+(DLRM pins each table to one GPU, ``dlrm_strategy.cc:11-19``).
+
+TPU-native design: the gather is ``jnp.take``; the scatter-add gradient
+is XLA's gather transpose (deterministic, no atomics).  Table/expert
+parallelism is first-class via :class:`MultiEmbedding`, which stacks
+all tables into one (T, vocab, dim) parameter sharded T-ways on the
+``c`` axis — the GSPMD equivalent of per-table placement, with the
+all-to-all the mapper's copies implied now emitted by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from flexflow_tpu.initializers import NormInitializer
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+
+class Embedding(Op):
+    """Single-table embedding lookup with bag aggregation.
+
+    Input: int indices (batch, bag); output (batch, out_dim) after
+    sum/avg over the bag dim (the reference's aggr modes).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        num_entries: int,
+        out_dim: int,
+        aggr: str = "sum",
+        dtype=jnp.float32,
+        kernel_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 2, f"embedding input must be (batch, bag), got {x.shape}"
+        assert aggr in ("sum", "avg")
+        self.attrs = dict(num_entries=num_entries, out_dim=out_dim, aggr=aggr)
+        self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
+        self._make_output((x.shape[0], out_dim), dtype, ("n", "c"))
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        a = self.attrs
+        return {
+            "table": ParamSpec(
+                (a["num_entries"], a["out_dim"]),
+                self.outputs[0].dtype,
+                self.kernel_initializer,
+                (None, "c"),
+            )
+        }
+
+    def forward(self, params, xs, state, training):
+        (idx,) = xs
+        rows = jnp.take(params["table"], idx, axis=0)  # (batch, bag, dim)
+        if self.attrs["aggr"] == "sum":
+            y = jnp.sum(rows, axis=1)
+        else:
+            y = jnp.mean(rows, axis=1)
+        return [y], state
+
+
+class MultiEmbedding(Op):
+    """T same-shaped tables stacked into one sharded parameter — the
+    expert/table-parallel form used by DLRM.
+
+    Input: int indices (batch, T); output (batch, T, out_dim).  The
+    stacked dim is tagged 'c', so a strategy ``{"c": T}`` gives exactly
+    the reference's one-table-per-device placement
+    (``dlrm_strategy.cc:5-36``) with XLA generating the resulting
+    gather/all-to-all over ICI.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        num_tables: int,
+        num_entries: int,
+        out_dim: int,
+        dtype=jnp.float32,
+        kernel_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 2 and x.shape[1] == num_tables
+        self.attrs = dict(
+            num_tables=num_tables, num_entries=num_entries, out_dim=out_dim
+        )
+        self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
+        self._make_output((x.shape[0], num_tables, out_dim), dtype, ("n", "c", None))
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        a = self.attrs
+        return {
+            "tables": ParamSpec(
+                (a["num_tables"], a["num_entries"], a["out_dim"]),
+                self.outputs[0].dtype,
+                self.kernel_initializer,
+                ("c", None, None),
+            )
+        }
+
+    def forward(self, params, xs, state, training):
+        (idx,) = xs  # (batch, T)
+        tables = params["tables"]  # (T, vocab, dim)
+        # Gather row idx[b, t] from table t: one_hot-free take_along_axis.
+        # (T, vocab, dim) indexed by (batch, T) → (batch, T, dim).
+        t_range = jnp.arange(tables.shape[0])[None, :]  # (1, T)
+        y = tables[t_range, idx]  # advanced indexing → batched gather
+        return [y], state

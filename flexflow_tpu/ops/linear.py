@@ -1,0 +1,69 @@
+"""Fully-connected (dense) operator.
+
+Reference: ``src/ops/linear.cu`` — a 2-D ``(c_out, n)`` task grid (TP×DP),
+kernel stored out-dim-major, input broadcast to c-shards via an aliased
+partition (``linear.cu:100-138``) and replica input-grads reduced by a
+second backward task (``linear.cu:494-520``).  On TPU the whole dance is
+one ``dot_general``: sharding the kernel's out-dim over the ``c`` mesh
+axes makes XLA all-gather the input and reduce-scatter/psum the input
+gradient — the ``backward2`` Saxpy tree for free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from flexflow_tpu.initializers import GlorotUniform, ZeroInitializer
+from flexflow_tpu.ops.activations import apply_activation, check_activation
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+
+class Linear(Op):
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        out_dim: int,
+        activation: Optional[str] = None,
+        use_bias: bool = True,
+        kernel_initializer=None,
+        bias_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 2, f"linear input must be (batch, features), got {x.shape}"
+        check_activation(activation)
+        n, cin = x.shape
+        self.in_dim = cin
+        self.attrs = dict(out_dim=out_dim, activation=activation, use_bias=use_bias)
+        self.kernel_initializer = kernel_initializer or GlorotUniform()
+        self.bias_initializer = bias_initializer or ZeroInitializer()
+        self._make_output((n, out_dim), x.dtype, ("n", "c"))
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        out_dim = self.attrs["out_dim"]
+        # Kernel is (out, in) — out-dim-major like the reference
+        # (``linear.cu`` stores the kernel transposed) — and sharded on
+        # its out-dim under a c-split.
+        specs = {
+            "kernel": ParamSpec(
+                (out_dim, self.in_dim),
+                self.outputs[0].dtype,
+                self.kernel_initializer,
+                ("c", None),
+            )
+        }
+        if self.attrs["use_bias"]:
+            specs["bias"] = ParamSpec(
+                (out_dim,), self.outputs[0].dtype, self.bias_initializer, ("c",)
+            )
+        return specs
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        # bf16 operands accumulate in f32 on the MXU by default.
+        y = jnp.dot(x, params["kernel"].T)
+        if self.attrs["use_bias"]:
+            y = y + params["bias"]
+        return [apply_activation(y, self.attrs["activation"])], state

@@ -1,0 +1,95 @@
+"""Loss operators producing (loss, PerfMetrics) pairs.
+
+Reference: ``src/ops/softmax.cu`` (cudnnSoftmaxForward ACCURATE fused
+with cross-entropy; backward subtracts the one-hot and scales by 1/N,
+``softmax.cu:91-160``) and ``src/ops/mse_loss.cu`` (loss + accuracy
+counters accumulated with device atomicAdd into a PerfMetrics struct,
+``mse_loss.cu:61-125``, returned as a Legion future and fold-summed,
+``model.cc:597-627``).  Here metrics are ordinary scalars in the jit
+output — the future-chaining machinery collapses into the return value
+— and the backward is autodiff of the fused logsumexp form.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ops.base import Op, TensorSpec
+
+
+class SoftmaxCrossEntropy(Op):
+    """Softmax + cross-entropy against int labels, mean over batch."""
+
+    is_loss = True
+
+    def __init__(self, name: str, logits: TensorSpec, labels: TensorSpec):
+        super().__init__(name, [logits, labels])
+        assert logits.ndim == 2
+        assert labels.shape == (logits.shape[0],), (
+            f"labels must be (batch,), got {labels.shape}"
+        )
+        # Loss op still exposes the softmax probabilities as an output
+        # (the reference's softmax op output region).
+        self._make_output(logits.shape, logits.dtype, ("n", "c"))
+
+    def forward(self, params, xs, state, training):
+        logits, labels = xs
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        logp = logits - lse
+        nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        loss = jnp.mean(nll)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = jnp.sum((pred == labels).astype(jnp.int32))
+        metrics = {
+            "train_loss": loss,
+            "train_correct": correct,
+            "train_all": jnp.int32(labels.shape[0]),
+        }
+        return (loss, metrics, [jnp.exp(logp).astype(self.outputs[0].dtype)]), state
+
+
+class MSELoss(Op):
+    """Mean-squared-error with the reference's accuracy bookkeeping.
+
+    Single-category labels (label dim 1): prediction correct iff
+    |pred - label| rounds to the label (0/1 threshold at 0.5) —
+    reference ``single_category_calc_loss``; multi-category: argmax
+    match — reference ``multi_category_calc_loss``
+    (``mse_loss.cu:61-125``).  ``scale`` mirrors the reference's
+    AGGR_MODE scaling of the backward pass.
+    """
+
+    is_loss = True
+
+    def __init__(self, name: str, pred: TensorSpec, label: TensorSpec, reduction: str = "mean"):
+        super().__init__(name, [pred, label])
+        assert pred.shape == label.shape, (pred.shape, label.shape)
+        assert reduction in ("mean", "sum")
+        self.reduction = reduction
+        self._make_output((), jnp.float32, ())
+
+    def forward(self, params, xs, state, training):
+        pred, label = xs
+        pred = pred.astype(jnp.float32)
+        label = label.astype(jnp.float32)
+        se = jnp.square(pred - label)
+        loss = jnp.mean(se) if self.reduction == "mean" else jnp.sum(se)
+        if pred.ndim == 2 and pred.shape[1] == 1:
+            correct = jnp.sum((jnp.abs(pred - label) < 0.5).astype(jnp.int32))
+            total = pred.shape[0]
+        elif pred.ndim == 2:
+            correct = jnp.sum(
+                (jnp.argmax(pred, axis=1) == jnp.argmax(label, axis=1)).astype(jnp.int32)
+            )
+            total = pred.shape[0]
+        else:
+            correct = jnp.int32(0)
+            total = pred.shape[0] if pred.ndim >= 1 else 1
+        metrics = {
+            "train_loss": loss,
+            "train_correct": correct,
+            "train_all": jnp.int32(total),
+        }
+        return (loss, metrics, [loss]), state

@@ -1,0 +1,77 @@
+"""Batch normalization.
+
+Reference: ``src/ops/batch_norm.cu`` — cudnnBatchNormalizationForward
+Training/Backward with per-shard running mean/var cached in
+``BatchNormMeta`` (``model.h:428-436``).  Here batch statistics are
+computed over (n, h, w); under a sharded batch XLA turns the mean/var
+reductions into cross-replica psums automatically, which fixes a
+subtle reference deficiency (per-shard-only statistics).  Running
+stats live in the op state pytree and are updated functionally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from flexflow_tpu.initializers import OnesInitializer, ZeroInitializer
+from flexflow_tpu.ops.activations import apply_activation
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+
+class BatchNorm(Op):
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        relu: bool = False,
+        momentum: float = 0.9,
+        eps: float = 1e-5,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 4
+        self.attrs = dict(relu=relu, momentum=momentum, eps=eps)
+        self.channels = x.shape[3]
+        self._make_output(x.shape, x.dtype, ("n", "h", "w", "c"))
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        c = self.channels
+        dt = self.outputs[0].dtype
+        return {
+            "scale": ParamSpec((c,), dt, OnesInitializer(), ("c",)),
+            "bias": ParamSpec((c,), dt, ZeroInitializer(), ("c",)),
+        }
+
+    def state_specs(self) -> Dict[str, ParamSpec]:
+        c = self.channels
+        dt = self.outputs[0].dtype
+        return {
+            "running_mean": ParamSpec((c,), dt, ZeroInitializer(), ("c",)),
+            "running_var": ParamSpec((c,), dt, OnesInitializer(), ("c",)),
+        }
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        eps = self.attrs["eps"]
+        if training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            var = jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - jnp.square(mean)
+            m = self.attrs["momentum"]
+            new_state = {
+                "running_mean": (m * state["running_mean"] + (1 - m) * mean).astype(x.dtype),
+                "running_var": (m * state["running_var"] + (1 - m) * var).astype(x.dtype),
+            }
+        else:
+            mean = state["running_mean"].astype(jnp.float32)
+            var = state["running_var"].astype(jnp.float32)
+            new_state = state
+        inv = jnp.reciprocal(jnp.sqrt(var + eps))
+        y = (x.astype(jnp.float32) - mean) * inv * params["scale"].astype(
+            jnp.float32
+        ) + params["bias"].astype(jnp.float32)
+        y = y.astype(x.dtype)
+        if self.attrs["relu"]:
+            y = apply_activation(y, "relu")
+        return [y], new_state

@@ -1,0 +1,61 @@
+"""Structural tensor operators (concat, reshape).
+
+Reference: ``src/ops/concat.cu`` — strided-copy kernels over an n-D
+task grid (``concat.cu:194-215`` fwd, bwd splits back).  Here concat is
+``jnp.concatenate`` (XLA fuses the copies); the backward split is its
+autodiff transpose.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ops.base import Op, TensorSpec
+
+
+class Concat(Op):
+    def __init__(self, name: str, inputs: Sequence[TensorSpec], axis: int):
+        super().__init__(name, inputs)
+        ndim = inputs[0].ndim
+        if axis < 0:
+            axis += ndim
+        self.axis = axis
+        for t in inputs:
+            assert t.ndim == ndim
+            for d in range(ndim):
+                if d != axis:
+                    assert t.shape[d] == inputs[0].shape[d], (
+                        f"concat {name}: mismatched dim {d}: "
+                        f"{t.shape} vs {inputs[0].shape}"
+                    )
+        out_shape = list(inputs[0].shape)
+        out_shape[axis] = sum(t.shape[axis] for t in inputs)
+        # The concatenated dim inherits no sharding tag (safe under
+        # unequal part sizes); other dims keep the first input's tags.
+        dim_axes = list(inputs[0].dim_axes)
+        dim_axes[axis] = None
+        self._make_output(tuple(out_shape), inputs[0].dtype, tuple(dim_axes))
+
+    def forward(self, params, xs, state, training):
+        return [jnp.concatenate(list(xs), axis=self.axis)], state
+
+
+class Reshape(Op):
+    """Free-form reshape; batch dim must be preserved."""
+
+    def __init__(self, name: str, x: TensorSpec, shape: Sequence[int],
+                 dim_axes: Optional[Sequence[Optional[str]]] = None):
+        super().__init__(name, [x])
+        shape = tuple(shape)
+        assert shape[0] == x.shape[0], "reshape must preserve the batch dim"
+        import numpy as np
+        assert int(np.prod(shape)) == int(np.prod(x.shape))
+        if dim_axes is None:
+            dim_axes = ("n",) + tuple(None for _ in shape[1:])
+        self._make_output(shape, x.dtype, tuple(dim_axes))
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        return [x.reshape(self.outputs[0].shape)], state

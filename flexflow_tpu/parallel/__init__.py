@@ -1,0 +1,4 @@
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.parallel.mesh import MeshPlan, build_mesh_plan
+
+__all__ = ["ParallelConfig", "StrategyStore", "MeshPlan", "build_mesh_plan"]

@@ -1,0 +1,154 @@
+"""Mesh planning: compile per-op strategies onto one canonical device mesh.
+
+The reference's FFMapper routes every point of an op's task index space
+to the GPU listed in the op's strategy (reference:
+``src/mapper/mapper.cc:54-112``).  The TPU-native equivalent keeps ONE
+canonical ``jax.sharding.Mesh`` whose axes are the prime factors of the
+device count; a per-op ``(n, c, h, w)`` degree vector is realized by
+assigning each semantic axis a subset of mesh axes whose sizes multiply
+to the requested degree.  Any divisor of the device count is exactly
+representable this way, so every reference strategy (power-of-two GPU
+grids) compiles.  Ops with different strategies simply get different
+``PartitionSpec``s; the resharding copies Legion would generate between
+mismatched partitions (e.g. ``src/ops/flat.cu:81-124``) become
+XLA-inserted collectives over ICI.
+
+Assignment is deterministic — ``n`` consumes mesh axes from the left,
+``c`` from the right, then ``h``/``w`` — so ops sharing degrees get
+identical specs and no gratuitous resharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from flexflow_tpu.parallel.strategy import ParallelConfig
+
+
+class InfeasibleStrategyError(ValueError):
+    pass
+
+
+def _prime_factors(x: int) -> List[int]:
+    out: List[int] = []
+    d = 2
+    while d * d <= x:
+        while x % d == 0:
+            out.append(d)
+            x //= d
+        d += 1
+    if x > 1:
+        out.append(x)
+    return out
+
+
+@dataclasses.dataclass
+class MeshPlan:
+    """A canonical mesh plus the per-strategy axis assignment logic."""
+
+    mesh: Mesh
+    axis_names: Tuple[str, ...]
+    axis_sizes: Tuple[int, ...]
+
+    def __post_init__(self):
+        self._assign_cache: Dict[ParallelConfig, Dict[str, Tuple[str, ...]]] = {}
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.axis_sizes))
+
+    def assign(self, pc: ParallelConfig) -> Dict[str, Tuple[str, ...]]:
+        """Map each semantic axis of ``pc`` to a tuple of mesh axes."""
+        cached = self._assign_cache.get(pc)
+        if cached is not None:
+            return cached
+        avail: List[Tuple[str, int]] = list(zip(self.axis_names, self.axis_sizes))
+        result: Dict[str, Tuple[str, ...]] = {}
+        # n from the left, c from the right, h/w from what remains.
+        for sem, from_left in (("n", True), ("c", False), ("h", True), ("w", True)):
+            deg = pc.degree(sem)
+            picked: List[str] = []
+            for p in _prime_factors(deg):
+                idxs = range(len(avail)) if from_left else range(len(avail) - 1, -1, -1)
+                hit = next((i for i in idxs if avail[i][1] == p), None)
+                if hit is None:
+                    raise InfeasibleStrategyError(
+                        f"cannot realize degree {deg} on axis {sem!r}: prime {p} "
+                        f"unavailable in mesh {dict(zip(self.axis_names, self.axis_sizes))} "
+                        f"after assigning {result}"
+                    )
+                picked.append(avail.pop(hit)[0])
+            result[sem] = tuple(picked)
+        self._assign_cache[pc] = result
+        return result
+
+    def spec(
+        self,
+        pc: ParallelConfig,
+        dim_axes: Sequence[Optional[str]],
+        shape: Optional[Sequence[int]] = None,
+    ) -> PartitionSpec:
+        """Build a PartitionSpec for a tensor whose dims map to semantic
+        axes ``dim_axes`` (entries: 'n'/'c'/'h'/'w' or None).
+
+        When ``shape`` is given, mesh axes that do not divide the dim
+        extent are dropped (partial sharding).  The reference tolerates
+        uneven extents via Legion rect partitions (``model.cc:213-280``
+        rounds up); GSPMD wants exact divisibility, so an odd spatial
+        extent simply stays unsharded along the offending factor.
+        """
+        asg = self.assign(pc)
+        size_of = dict(zip(self.axis_names, self.axis_sizes))
+        entries = []
+        for i, sem in enumerate(dim_axes):
+            if sem is None:
+                entries.append(None)
+                continue
+            axes = asg.get(sem, ())
+            if shape is not None:
+                dim = shape[i]
+                kept, prod = [], 1
+                for ax in axes:
+                    if dim % (prod * size_of[ax]) == 0:
+                        kept.append(ax)
+                        prod *= size_of[ax]
+                axes = tuple(kept)
+            entries.append(axes if len(axes) != 1 else axes[0])
+        # PartitionSpec treats () like None.
+        entries = [None if e == () else e for e in entries]
+        return PartitionSpec(*entries)
+
+    def sharding(
+        self,
+        pc: ParallelConfig,
+        dim_axes: Sequence[Optional[str]],
+        shape: Optional[Sequence[int]] = None,
+    ) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(pc, dim_axes, shape))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+
+def build_mesh_plan(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshPlan:
+    """Factor the device count into prime-sized mesh axes ``x0..xk``."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None and num_devices > 0:
+            devices = devices[:num_devices]
+    devices = list(devices)
+    n = len(devices)
+    sizes = _prime_factors(n) or [1]
+    names = tuple(f"x{i}" for i in range(len(sizes)))
+    arr = np.array(devices).reshape(tuple(sizes))
+    mesh = Mesh(arr, names)
+    return MeshPlan(mesh=mesh, axis_names=names, axis_sizes=tuple(sizes))

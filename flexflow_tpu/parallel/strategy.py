@@ -1,0 +1,139 @@
+"""Per-operator parallelization strategies.
+
+The reference keys a ``ParallelConfig{nDims, dim[4], gpu[1024]}`` by a
+hash of the op name and falls back to plain data parallelism when an op
+has no entry (reference: ``include/config.h:39-48``,
+``src/runtime/strategy.cc:27-70``, schema ``strategy.proto:5-13``).
+
+Here a strategy names *degrees* along the semantic axes of an op —
+``n`` (sample/batch), ``c`` (channel / output-feature), ``h``/``w``
+(spatial) — plus an optional explicit device list used by the offline
+simulator and for expert/table placement.  Strategies are stored in a
+JSON file::
+
+    {"version": 1, "num_devices": 8,
+     "ops": {"conv1": {"n": 4, "c": 2}, "dense1": {"n": 2, "c": 4}}}
+
+The runtime compiles these to ``PartitionSpec``s over a canonical mesh
+(see ``flexflow_tpu/parallel/mesh.py``); Legion's mapper-driven task
+placement becomes GSPMD sharding propagation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Optional, Sequence, Tuple
+
+AXES = ("n", "c", "h", "w")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Parallel degrees along semantic axes for one op.
+
+    ``degrees[axis]`` is how many ways the op is split along that axis;
+    missing axes mean degree 1 (replicated along it).  ``device_ids`` is
+    an optional explicit placement (reference: ``config.h:42`` gpu[]),
+    consumed by the cost simulator; the runtime realizes placement via
+    mesh coordinates instead.
+    """
+
+    n: int = 1
+    c: int = 1
+    h: int = 1
+    w: int = 1
+    device_ids: Optional[Tuple[int, ...]] = None
+
+    def degree(self, axis: str) -> int:
+        return getattr(self, axis)
+
+    @property
+    def num_parts(self) -> int:
+        return self.n * self.c * self.h * self.w
+
+    @staticmethod
+    def data_parallel(num_devices: int) -> "ParallelConfig":
+        """The reference's DataParallelismID fallback
+        (``strategy.cc:27-40``): split the sample dim over every device."""
+        return ParallelConfig(n=num_devices)
+
+    def to_json(self) -> Dict:
+        d = {a: self.degree(a) for a in AXES if self.degree(a) != 1}
+        if self.device_ids is not None:
+            d["device_ids"] = list(self.device_ids)
+        return d
+
+    @staticmethod
+    def from_json(d: Dict) -> "ParallelConfig":
+        ids = d.get("device_ids")
+        return ParallelConfig(
+            n=int(d.get("n", 1)),
+            c=int(d.get("c", 1)),
+            h=int(d.get("h", 1)),
+            w=int(d.get("w", 1)),
+            device_ids=tuple(ids) if ids is not None else None,
+        )
+
+
+class StrategyStore:
+    """Name → ParallelConfig table with a data-parallel fallback.
+
+    Mirrors ``FFConfig::find_parallel_config`` + ``load_strategies_from_file``
+    (reference: ``src/runtime/strategy.cc:27-70``), with JSON replacing
+    protobuf.
+    """
+
+    def __init__(self, num_devices: int, table: Optional[Dict[str, ParallelConfig]] = None):
+        self.num_devices = num_devices
+        self.table: Dict[str, ParallelConfig] = dict(table or {})
+
+    def find(self, op_name: str) -> ParallelConfig:
+        pc = self.table.get(op_name)
+        if pc is None:
+            return ParallelConfig.data_parallel(self.num_devices)
+        return pc
+
+    def set(self, op_name: str, pc: ParallelConfig) -> None:
+        assert pc.num_parts <= self.num_devices, (
+            f"strategy for {op_name!r} uses {pc.num_parts} parts "
+            f"but only {self.num_devices} devices exist"
+        )
+        self.table[op_name] = pc
+
+    def __contains__(self, op_name: str) -> bool:
+        return op_name in self.table
+
+    # -- (de)serialization ------------------------------------------------
+
+    def save(self, path: str) -> None:
+        payload = {
+            "version": 1,
+            "num_devices": self.num_devices,
+            "ops": {k: v.to_json() for k, v in self.table.items()},
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @staticmethod
+    def load(path: str, num_devices: Optional[int] = None) -> "StrategyStore":
+        with open(path) as f:
+            payload = json.load(f)
+        nd = num_devices if num_devices is not None else int(payload["num_devices"])
+        table = {k: ParallelConfig.from_json(v) for k, v in payload.get("ops", {}).items()}
+        return StrategyStore(nd, table)
+
+    @staticmethod
+    def data_parallel(num_devices: int) -> "StrategyStore":
+        return StrategyStore(num_devices, {})
+
+
+def dlrm_strategy(num_devices: int, num_tables: int) -> StrategyStore:
+    """The DLRM strategy generator (reference:
+    ``src/runtime/dlrm_strategy.cc:5-36``): embedding tables placed one
+    per device (expert/table parallelism — here: the stacked table dim
+    sharded ``c``-ways), MLPs/concat/loss data parallel."""
+    store = StrategyStore(num_devices)
+    store.set("embeddings", ParallelConfig(c=min(num_devices, num_tables)))
+    return store

@@ -1,0 +1,4 @@
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.trainer import Trainer
+
+__all__ = ["Executor", "Trainer"]

@@ -1,0 +1,228 @@
+"""Graph → jitted-step compiler.
+
+This is the TPU-native replacement for the reference's runtime layer:
+``FFModel::forward/backward/update/zero_gradients``
+(``src/runtime/model.cc:538-595``) driving per-op Legion index launches
+through the FFMapper.  Here the whole step — forward over the op graph,
+autodiff backward, SGD update, metric reduction — is ONE traced program
+under ``jax.jit`` (the reference's ``begin_trace/end_trace`` around the
+DLRM step, ``dlrm.cc:151-156``, made total), and the per-op
+``(n,c,h,w)`` strategy becomes a ``with_sharding_constraint`` on every
+op output so GSPMD places compute and inserts the ICI collectives that
+Legion coherence + the mapper produced on GPUs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.ops.base import Op, TensorSpec
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.mesh import MeshPlan, build_mesh_plan
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+
+def _merge_metrics(acc: Dict[str, jax.Array], m: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    out = dict(acc)
+    for k, v in m.items():
+        out[k] = out[k] + v if k in out else v
+    return out
+
+
+class Executor:
+    """Compiles an FFModel + StrategyStore onto a MeshPlan."""
+
+    def __init__(
+        self,
+        model: FFModel,
+        config: Optional[FFConfig] = None,
+        strategy: Optional[StrategyStore] = None,
+        mesh_plan: Optional[MeshPlan] = None,
+        optimizer: Optional[SGDOptimizer] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.model = model
+        self.config = config or model.config
+        if mesh_plan is None:
+            nd = self.config.resolve_num_devices() if devices is None else len(devices)
+            mesh_plan = build_mesh_plan(nd, devices=devices)
+        self.plan = mesh_plan
+        self.strategy = strategy or StrategyStore.data_parallel(self.plan.num_devices)
+        self.optimizer = optimizer or SGDOptimizer(
+            lr=self.config.learning_rate, weight_decay=self.config.weight_decay
+        )
+        self._consumer: Dict[str, Op] = {}
+        for op in model.layers:
+            for t in op.inputs:
+                self._consumer.setdefault(t.name, op)
+
+    # -- sharding assembly -------------------------------------------------
+
+    def _pc(self, op: Op) -> ParallelConfig:
+        return self.strategy.find(op.name)
+
+    def output_sharding(self, op: Op, t: TensorSpec) -> NamedSharding:
+        return self.plan.sharding(self._pc(op), t.dim_axes, t.shape)
+
+    def param_sharding(self, op: Op, spec) -> NamedSharding:
+        return self.plan.sharding(self._pc(op), spec.dim_axes, spec.shape)
+
+    def input_sharding(self, t: TensorSpec) -> NamedSharding:
+        """An input placeholder is sharded the way its first consumer
+        wants it — the analogue of the mapper slicing the loader launch
+        over the consumer op's task index space (``dlrm.cc:447-512``)."""
+        consumer = self._consumer.get(t.name)
+        if consumer is None:
+            return self.plan.replicated()
+        return self.plan.sharding(self._pc(consumer), t.dim_axes, t.shape)
+
+    def params_shardings(self):
+        return {
+            op.name: {
+                k: self.param_sharding(op, spec)
+                for k, spec in op.param_specs().items()
+            }
+            for op in self.model.layers
+            if op.param_specs()
+        }
+
+    def state_shardings(self):
+        return {
+            op.name: {
+                k: self.param_sharding(op, spec)
+                for k, spec in op.state_specs().items()
+            }
+            for op in self.model.layers
+            if op.state_specs()
+        }
+
+    def batch_shardings(self) -> Dict[str, NamedSharding]:
+        return {t.name: self.input_sharding(t) for t in self.model.input_tensors}
+
+    # -- initialization ----------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> Tuple[Any, Any, Any]:
+        """Materialize (params, opt_state, op_state) directly in their
+        target shardings (reference: initializer index tasks over the
+        weight partitions, ``initializer_kernel.cu:24-179``)."""
+        seed = self.config.seed if seed is None else seed
+
+        def init_fn(key):
+            params: Dict[str, Dict[str, jax.Array]] = {}
+            state: Dict[str, Dict[str, jax.Array]] = {}
+            for op in self.model.layers:
+                pspecs = op.param_specs()
+                if pspecs:
+                    params[op.name] = {}
+                    for k, spec in sorted(pspecs.items()):
+                        key, sub = jax.random.split(key)
+                        params[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
+                sspecs = op.state_specs()
+                if sspecs:
+                    state[op.name] = {}
+                    for k, spec in sorted(sspecs.items()):
+                        key, sub = jax.random.split(key)
+                        state[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
+            return params, state
+
+        out_sh = (self.params_shardings(), self.state_shardings())
+        params, state = jax.jit(init_fn, out_shardings=out_sh)(
+            jax.random.PRNGKey(seed)
+        )
+        opt_state = self.optimizer.init(params)
+        return params, opt_state, state
+
+    # -- forward -----------------------------------------------------------
+
+    def forward(self, params, state, batch, training: bool):
+        """Run the op graph.  Returns (loss, metrics, new_state, env)."""
+        env: Dict[str, jax.Array] = {}
+        for t in self.model.input_tensors:
+            x = batch[t.name]
+            assert x.shape == t.shape, (
+                f"input {t.name}: expected {t.shape}, got {x.shape}"
+            )
+            env[t.name] = jax.lax.with_sharding_constraint(x, self.input_sharding(t))
+        total_loss = jnp.float32(0.0)
+        metrics: Dict[str, jax.Array] = {}
+        new_state: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.layers:
+            xs = [env[t.name] for t in op.inputs]
+            p = params.get(op.name, {})
+            s = state.get(op.name, {})
+            result, s_new = op.forward(p, xs, s, training)
+            if op.is_loss:
+                loss, m, ys = result
+                total_loss = total_loss + loss
+                metrics = _merge_metrics(metrics, m)
+            else:
+                ys = result
+            for t, y in zip(op.outputs, ys):
+                y = jax.lax.with_sharding_constraint(y, self.output_sharding(op, t))
+                env[t.name] = y
+            if s_new is not s and s_new:
+                new_state[op.name] = s_new
+            elif s:
+                new_state[op.name] = s
+        return total_loss, metrics, new_state, env
+
+    # -- steps -------------------------------------------------------------
+
+    def _loss_fn(self, params, state, batch):
+        loss, metrics, new_state, _ = self.forward(params, state, batch, training=True)
+        return loss, (metrics, new_state)
+
+    def build_train_step(self):
+        """The whole iteration — fwd, bwd (autodiff), SGD — as one pure
+        function.  Reference equivalent: forward() + zero_gradients() +
+        backward() + update() (``model.cc:538-595``) under a Legion
+        trace."""
+
+        def train_step(params, opt_state, state, batch):
+            (loss, (metrics, new_state)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, state, batch)
+            new_params, new_opt = self.optimizer.update(params, opt_state, grads)
+            return new_params, new_opt, new_state, metrics
+
+        return train_step
+
+    @functools.cached_property
+    def train_step(self):
+        return jax.jit(self.build_train_step(), donate_argnums=(0, 1, 2))
+
+    @functools.cached_property
+    def eval_step(self):
+        def eval_step(params, state, batch):
+            loss, metrics, _, env = self.forward(params, state, batch, training=False)
+            return loss, metrics
+
+        return jax.jit(eval_step)
+
+    @functools.cached_property
+    def forward_step(self):
+        """Inference forward over the graph returning every op output —
+        the compile-check entry used by __graft_entry__."""
+
+        def fwd(params, state, batch):
+            loss, metrics, _, env = self.forward(params, state, batch, training=False)
+            outs = {
+                op.outputs[0].name: env[op.outputs[0].name]
+                for op in self.model.layers
+            }
+            return loss, outs
+
+        return jax.jit(fwd)
+
+    # -- data placement ----------------------------------------------------
+
+    def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
+        sh = self.batch_shardings()
+        return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}

@@ -1,0 +1,97 @@
+"""Training-loop driver with the reference's measurement protocol.
+
+The reference times N iterations between an execution fence and a
+TimingLauncher and prints ``tp = iters*batch/elapsed`` images/s
+(``cnn.cc:122-129``) / ``THROUGHPUT = samples/s`` (``dlrm.cc:159-166``).
+Here the fence is ``block_until_ready`` and the formulas are identical,
+so relative numbers are comparable.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.metrics import PerfMetrics
+from flexflow_tpu.runtime.executor import Executor
+
+
+class Trainer:
+    def __init__(self, executor: Executor):
+        self.ex = executor
+        self.metrics = PerfMetrics()
+
+    def synthetic_batch(self, seed: int = 0) -> Dict[str, jax.Array]:
+        """Device-resident synthetic inputs (reference: syntheticInput,
+        ``config.h:73``; DLRM loads random data once, ``dlrm.cc:144-150``)."""
+        rng = np.random.default_rng(seed)
+        batch = {}
+        for t in self.ex.model.input_tensors:
+            if jnp.issubdtype(t.dtype, jnp.integer):
+                # Index-like input: labels or embedding ids.  Use a small
+                # conservative range; models can overwrite.
+                hi = getattr(t, "max_value", 2)
+                arr = rng.integers(0, hi, size=t.shape).astype(np.int32)
+            else:
+                arr = rng.standard_normal(size=t.shape).astype(np.float32)
+                arr = np.asarray(arr, dtype=t.dtype)  # ml_dtypes handles bf16
+            batch[t.name] = arr
+        return self.ex.shard_batch(batch)
+
+    def fit(
+        self,
+        iterations: int,
+        batches: Optional[Iterable[Dict[str, Any]]] = None,
+        warmup: int = 1,
+        log_every: int = 0,
+    ) -> Dict[str, float]:
+        """Run ``iterations`` steps; returns throughput stats computed
+        with the reference formula."""
+        ex = self.ex
+        params, opt_state, state = ex.init()
+        if batches is None:
+            fixed = self.synthetic_batch()
+            batches = iter(lambda: fixed, None)  # infinite
+        else:
+            batches = iter(batches)
+
+        # Warmup (compile) outside the timed region — the reference's
+        # init_layers()+first-iteration cuDNN algo search equivalent.
+        m = None
+        for _ in range(warmup):
+            batch = next(batches)
+            params, opt_state, state, m = ex.train_step(params, opt_state, state, batch)
+        if m is not None:
+            jax.device_get(m)  # host readback: the only reliable fence on the relay
+
+        assert iterations > 0, "fit() needs at least one iteration"
+        start = time.perf_counter()
+        for it in range(iterations):
+            batch = next(batches)
+            params, opt_state, state, m = ex.train_step(params, opt_state, state, batch)
+            if log_every and (it + 1) % log_every == 0:
+                self.metrics.update(jax.device_get(m))
+                print(f"iter {it+1}: {self.metrics.report()}")
+        # The execution fence (dlrm.cc:159-162): a host readback of the
+        # final step's metrics; the step chain serializes through params.
+        final_m = jax.device_get(m)
+        elapsed = time.perf_counter() - start
+
+        self.metrics.update(final_m)
+        batch_size = ex.model.input_tensors[0].shape[0]
+        throughput = iterations * batch_size / elapsed
+        # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).
+        print(f"time = {elapsed:.4f}s")
+        print(f"tp = {throughput:.2f} samples/s")
+        self._final = (params, opt_state, state)
+        return {
+            "elapsed_s": elapsed,
+            "samples_per_s": throughput,
+            "iterations": iterations,
+            "batch_size": batch_size,
+            "loss": float(self.metrics.avg_loss),
+        }

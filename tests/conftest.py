@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The reference exercises multi-GPU logic without a cluster via Legion's
+proc abstraction; our analogue (SURVEY.md §4) is jax's host-platform
+device multiplexing.  Must run before jax initializes its backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU backend and overrides
+# jax_platforms at import; override it back before any backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,67 @@
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.parallel.mesh import InfeasibleStrategyError, build_mesh_plan
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_prime_factor_mesh():
+    plan = build_mesh_plan(8)
+    assert plan.axis_sizes == (2, 2, 2)
+    assert plan.num_devices == 8
+
+
+def test_single_device_mesh():
+    plan = build_mesh_plan(1)
+    assert plan.num_devices == 1
+    spec = plan.spec(ParallelConfig(n=1), ("n", None))
+    assert spec == P(None, None)
+
+
+def test_dp_assignment():
+    plan = build_mesh_plan(8)
+    pc = ParallelConfig(n=8)
+    spec = plan.spec(pc, ("n", "h", "w", "c"))
+    assert spec[0] == ("x0", "x1", "x2")
+    assert spec[1] is None and spec[2] is None and spec[3] is None
+
+
+def test_hybrid_assignment():
+    plan = build_mesh_plan(8)
+    pc = ParallelConfig(n=2, c=4)
+    spec = plan.spec(pc, ("n", "c"))
+    assert spec[0] == "x0"
+    assert set(spec[1]) == {"x1", "x2"}
+
+
+def test_infeasible_strategy():
+    plan = build_mesh_plan(8)
+    with pytest.raises(InfeasibleStrategyError):
+        plan.assign(ParallelConfig(n=3))
+    with pytest.raises(InfeasibleStrategyError):
+        plan.assign(ParallelConfig(n=8, c=2))
+
+
+def test_strategy_store_fallback():
+    store = StrategyStore.data_parallel(8)
+    pc = store.find("whatever")
+    assert pc.n == 8 and pc.c == 1
+
+
+def test_strategy_store_roundtrip(tmp_path):
+    store = StrategyStore(8)
+    store.set("conv1", ParallelConfig(n=2, h=2, w=2))
+    store.set("dense1", ParallelConfig(n=2, c=4))
+    path = str(tmp_path / "strategy.json")
+    store.save(path)
+    loaded = StrategyStore.load(path)
+    assert loaded.num_devices == 8
+    assert loaded.find("conv1") == ParallelConfig(n=2, h=2, w=2)
+    assert loaded.find("dense1") == ParallelConfig(n=2, c=4)
+    # fallback still DP
+    assert loaded.find("unknown").n == 8

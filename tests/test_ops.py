@@ -1,0 +1,201 @@
+"""Per-op numeric unit tests — jax/numpy oracles (SURVEY.md §4 plan (1))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.runtime.executor import Executor
+
+
+def run_graph(ff, batch, n_devices=1):
+    ex = Executor(ff, devices=jax.devices()[:n_devices])
+    params, opt_state, state = ex.init()
+    loss, metrics, new_state, env = ex.forward(params, state, batch, training=True)
+    return params, env, loss, metrics
+
+
+def test_conv2d_matches_manual(rng):
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor((2, 8, 8, 3), name="x")
+    lbl = ff.create_tensor((2,), dtype=jnp.int32, name="y")
+    t = ff.conv2d(x, 4, 3, 3, 1, 1, 1, 1, activation=None, name="c")
+    ff.softmax(ff.flat(ff.pool2d(t, 8, 8, 8, 8, 0, 0, pool_type="avg")), lbl)
+
+    batch = {"x": jnp.array(rng.standard_normal((2, 8, 8, 3)), jnp.float32),
+             "y": jnp.zeros((2,), jnp.int32)}
+    params, env, loss, _ = run_graph(ff, batch)
+    out = env["c:out"]
+    assert out.shape == (2, 8, 8, 4)
+    # Oracle: lax conv directly.
+    ref = jax.lax.conv_general_dilated(
+        batch["x"], params["c"]["kernel"], (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + params["c"]["bias"]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pool2d_max_and_avg(rng):
+    ff = FFModel()
+    x = ff.create_tensor((2, 4, 4, 2), name="x")
+    lbl = ff.create_tensor((2, 8), name="y")
+    pm = ff.pool2d(x, 2, 2, 2, 2, 0, 0, pool_type="max", name="pmax")
+    pa = ff.pool2d(x, 2, 2, 2, 2, 0, 0, pool_type="avg", name="pavg")
+    ff.mse_loss(ff.flat(pm, name="f1"), lbl)
+    xs = rng.standard_normal((2, 4, 4, 2)).astype(np.float32)
+    batch = {"x": jnp.array(xs), "y": jnp.zeros((2, 8), jnp.float32)}
+    _, env, _, _ = run_graph(ff, batch)
+    blocks = xs.reshape(2, 2, 2, 2, 2, 2)  # n, h2, kh, w2, kw, c
+    np.testing.assert_allclose(env["pmax:out"], blocks.max(axis=(2, 4)), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(env["pavg:out"], blocks.mean(axis=(2, 4)), rtol=1e-6, atol=1e-6)
+
+
+def test_linear_matches_manual(rng):
+    ff = FFModel()
+    x = ff.create_tensor((4, 16), name="x")
+    y = ff.create_tensor((4, 8), name="y")
+    t = ff.dense(x, 8, activation=None, name="fc")
+    ff.mse_loss(t, y)
+    xs = rng.standard_normal((4, 16)).astype(np.float32)
+    batch = {"x": jnp.array(xs), "y": jnp.zeros((4, 8), jnp.float32)}
+    params, env, loss, metrics = run_graph(ff, batch)
+    ref = xs @ np.asarray(params["fc"]["kernel"]).T + np.asarray(params["fc"]["bias"])
+    np.testing.assert_allclose(env["fc:out"], ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(loss), float(np.mean(ref**2)), rtol=1e-5)
+
+
+def test_batchnorm_normalizes(rng):
+    ff = FFModel()
+    x = ff.create_tensor((8, 4, 4, 3), name="x")
+    y = ff.create_tensor((8, 48), name="y")
+    t = ff.batch_norm(x, relu=False, name="bn")
+    ff.mse_loss(ff.flat(t), y)
+    xs = (rng.standard_normal((8, 4, 4, 3)) * 5 + 3).astype(np.float32)
+    batch = {"x": jnp.array(xs), "y": jnp.zeros((8, 48), jnp.float32)}
+    _, env, _, _ = run_graph(ff, batch)
+    out = np.asarray(env["bn:out"])
+    np.testing.assert_allclose(out.mean(axis=(0, 1, 2)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=(0, 1, 2)), 1.0, atol=1e-2)
+
+
+def test_embedding_gather_sum(rng):
+    ff = FFModel()
+    idx = ff.create_tensor((4, 2), dtype=jnp.int32, name="idx")
+    y = ff.create_tensor((4, 6), name="y")
+    t = ff.embedding(idx, num_entries=10, out_dim=6, aggr="sum", name="emb")
+    ff.mse_loss(t, y)
+    ids = rng.integers(0, 10, size=(4, 2)).astype(np.int32)
+    batch = {"idx": jnp.array(ids), "y": jnp.zeros((4, 6), jnp.float32)}
+    params, env, _, _ = run_graph(ff, batch)
+    table = np.asarray(params["emb"]["table"])
+    ref = table[ids].sum(axis=1)
+    np.testing.assert_allclose(env["emb:out"], ref, rtol=1e-6)
+
+
+def test_multi_embedding_gather(rng):
+    ff = FFModel()
+    idx = ff.create_tensor((4, 3), dtype=jnp.int32, name="idx")
+    y = ff.create_tensor((4, 3 * 5), name="y")
+    t = ff.multi_embedding(idx, num_tables=3, num_entries=7, out_dim=5, name="tables")
+    ff.mse_loss(ff.reshape(t, (4, 15)), y)
+    ids = rng.integers(0, 7, size=(4, 3)).astype(np.int32)
+    batch = {"idx": jnp.array(ids), "y": jnp.zeros((4, 15), jnp.float32)}
+    params, env, _, _ = run_graph(ff, batch)
+    tables = np.asarray(params["tables"]["tables"])
+    ref = np.stack([tables[t_, ids[:, t_]] for t_ in range(3)], axis=1)
+    np.testing.assert_allclose(env["tables:out"], ref, rtol=1e-6)
+
+
+def test_concat(rng):
+    ff = FFModel()
+    a = ff.create_tensor((2, 3), name="a")
+    b = ff.create_tensor((2, 5), name="b")
+    y = ff.create_tensor((2, 8), name="y")
+    t = ff.concat([a, b], axis=1, name="cat")
+    ff.mse_loss(t, y)
+    av = rng.standard_normal((2, 3)).astype(np.float32)
+    bv = rng.standard_normal((2, 5)).astype(np.float32)
+    batch = {"a": jnp.array(av), "b": jnp.array(bv), "y": jnp.zeros((2, 8), jnp.float32)}
+    _, env, _, _ = run_graph(ff, batch)
+    np.testing.assert_allclose(env["cat:out"], np.concatenate([av, bv], axis=1))
+
+
+def test_softmax_ce_loss_and_accuracy(rng):
+    ff = FFModel()
+    x = ff.create_tensor((4, 3), name="x")
+    lbl = ff.create_tensor((4,), dtype=jnp.int32, name="lbl")
+    ff.softmax(x, lbl, name="sm")
+    logits = rng.standard_normal((4, 3)).astype(np.float32)
+    labels = np.array([0, 1, 2, 0], np.int32)
+    batch = {"x": jnp.array(logits), "lbl": jnp.array(labels)}
+    _, env, loss, metrics = run_graph(ff, batch)
+    # Oracle
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    ref_loss = -np.mean(np.log(p[np.arange(4), labels]))
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(env["sm:out"]), p, rtol=1e-5, atol=1e-6)
+    assert int(metrics["train_all"]) == 4
+    assert int(metrics["train_correct"]) == int((p.argmax(1) == labels).sum())
+
+
+def test_mse_single_category_metrics(rng):
+    ff = FFModel()
+    x = ff.create_tensor((4, 1), name="x")
+    y = ff.create_tensor((4, 1), name="y")
+    ff.mse_loss(x, y)
+    pred = np.array([[0.1], [0.9], [0.4], [0.6]], np.float32)
+    lab = np.array([[0.0], [1.0], [1.0], [1.0]], np.float32)
+    _, env, loss, metrics = run_graph(ff, {"x": jnp.array(pred), "y": jnp.array(lab)})
+    np.testing.assert_allclose(float(loss), np.mean((pred - lab) ** 2), rtol=1e-6)
+    assert int(metrics["train_correct"]) == 3  # |0.4-1.0| >= 0.5 is wrong
+
+
+def test_sgd_momentum_matches_pytorch_semantics(rng):
+    import torch
+
+    from flexflow_tpu.optim import SGDOptimizer
+
+    w0 = rng.standard_normal((5,)).astype(np.float32)
+    g = [rng.standard_normal((5,)).astype(np.float32) for _ in range(3)]
+
+    opt = SGDOptimizer(lr=0.1, momentum=0.9, nesterov=True, weight_decay=0.01)
+    params = {"w": jnp.array(w0)}
+    opt_state = opt.init(params)
+    for gi in g:
+        params, opt_state = opt.update(params, opt_state, {"w": jnp.array(gi)})
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, nesterov=True, weight_decay=0.01)
+    for gi in g:
+        topt.zero_grad()
+        tw.grad = torch.tensor(gi)
+        topt.step()
+    np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_glorot_conv_fan_uses_hwio_layout(rng):
+    """Regression: HWIO conv kernels must use fan_in=kh*kw*cin."""
+    import jax
+    from flexflow_tpu.ops.conv import Conv2D
+    from flexflow_tpu.ops.base import TensorSpec
+
+    x = TensorSpec("x", (1, 8, 8, 64), jnp.float32, ("n", "h", "w", "c"))
+    op = Conv2D("c", x, 192, 5, 5, 1, 1, 2, 2)
+    spec = op.param_specs()["kernel"]
+    k = spec.initializer(jax.random.PRNGKey(0), spec.shape, spec.dtype)
+    bound = float(np.abs(np.asarray(k)).max())
+    expected = np.sqrt(6.0 / (5 * 5 * 64 + 5 * 5 * 192))
+    assert 0.8 * expected < bound <= expected * 1.001
+
+
+def test_autogenerated_name_never_collides():
+    ff = FFModel()
+    x = ff.create_tensor((4, 4), name="x")
+    ff.dense(x, 4, name="dense0")
+    t = ff.dense(x, 8)  # auto-name must skip the taken "dense0"
+    names = [op.name for op in ff.layers]
+    assert len(names) == len(set(names))
+    assert t.producer.name != "dense0"
