@@ -1,0 +1,9 @@
+from flexflow_tpu.data.loader import ArrayDataLoader, synthetic_arrays
+from flexflow_tpu.data.criteo import load_criteo_h5, make_dlrm_arrays
+
+__all__ = [
+    "ArrayDataLoader",
+    "synthetic_arrays",
+    "load_criteo_h5",
+    "make_dlrm_arrays",
+]

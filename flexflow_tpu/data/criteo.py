@@ -1,0 +1,78 @@
+"""Criteo / DLRM dataset ingestion.
+
+Reference: the DLRM DataLoader reads an HDF5 file with datasets
+``X_int`` (float dense features, N×D), ``X_cat`` (int categorical
+ids, N×T) and ``y`` (labels, N) — ``dlrm.cc:239-281`` — and otherwise
+generates a random dataset (``dlrm.cc:234-236``).  This module maps
+those onto the input-tensor names `build_dlrm` creates:
+``dense_input``, ``sparse_input`` (uniform vocabs, stacked) or
+``sparse_{i}`` (heterogeneous), and ``label``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def load_criteo_h5(path: str, max_samples: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Read the reference's H5 schema (``dlrm.cc:239-281``)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        n = f["y"].shape[0]
+        if max_samples is not None:
+            n = min(n, max_samples)
+        x_int = np.asarray(f["X_int"][:n], dtype=np.float32)
+        x_cat = np.asarray(f["X_cat"][:n], dtype=np.int64)
+        y = np.asarray(f["y"][:n], dtype=np.float32)
+    return {"X_int": x_int, "X_cat": x_cat, "y": y.reshape(-1, 1)}
+
+
+def make_dlrm_arrays(
+    dlrm_config,
+    num_samples: int,
+    path: Optional[str] = None,
+    seed: int = 0,
+) -> Dict[str, np.ndarray]:
+    """Dataset dict keyed by `build_dlrm` input names.  With no path,
+    random data (the ``run_random.sh`` benchmark mode)."""
+    num_tables = len(dlrm_config.embedding_size)
+    uniform = len(set(dlrm_config.embedding_size)) == 1
+    if path is not None:
+        raw = load_criteo_h5(path, max_samples=num_samples)
+        assert raw["X_cat"].shape[1] == num_tables, (
+            f"dataset has {raw['X_cat'].shape[1]} sparse features, "
+            f"config expects {num_tables}"
+        )
+        dense = raw["X_int"]
+        cat = raw["X_cat"]
+        label = raw["y"]
+    else:
+        rng = np.random.default_rng(seed)
+        dense = rng.standard_normal((num_samples, dlrm_config.mlp_bot[0])).astype(
+            np.float32
+        )
+        cat = np.stack(
+            [
+                rng.integers(0, v, size=num_samples)
+                for v in dlrm_config.embedding_size
+            ],
+            axis=1,
+        )
+        label = rng.integers(0, 2, size=(num_samples, 1)).astype(np.float32)
+
+    for i, v in enumerate(dlrm_config.embedding_size):
+        hi = int(cat[:, i].max(initial=0))
+        assert hi < v, (
+            f"sparse feature {i}: dataset id {hi} >= configured vocab {v} "
+            f"(--arch-embedding-size mismatch)"
+        )
+    out: Dict[str, np.ndarray] = {"dense_input": dense, "label": label}
+    if uniform:
+        out["sparse_input"] = cat.astype(np.int32)
+    else:
+        for i in range(num_tables):
+            out[f"sparse_{i}"] = cat[:, i : i + 1].astype(np.int32)
+    return out

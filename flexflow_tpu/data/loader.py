@@ -1,0 +1,94 @@
+"""Host-resident data pipeline.
+
+Reference: the DLRM DataLoader (``examples/DLRM/dlrm.cc:226-330``)
+loads the ENTIRE dataset once into zero-copy pinned DRAM
+(``MAP_TO_ZC_MEMORY``) and per iteration index-launches gather tasks
+that copy each shard's rows to its GPU (``dlrm.cc:427-512``,
+``dlrm.cu:20-50``).  The TPU-native shape of that pattern: the dataset
+stays in host RAM as numpy arrays; ``next_batch`` slices a batch and
+``Executor.shard_batch`` device-puts each tensor directly in its
+consumer op's sharding, so each chip receives only its shard over PCIe
+— no full-batch staging on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class ArrayDataLoader:
+    """Batches a dict of equal-length host arrays keyed by input-tensor
+    name.  ``reset()`` reshuffles per epoch (reference:
+    ``data_loader.reset()`` + ``ff.reset_metrics()``, ``dlrm.cc:141-143``)."""
+
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        # Tail rows beyond the last full batch are dropped each epoch:
+        # jit recompiles per batch shape, so ragged final batches are
+        # hostile on TPU (and the reference's loaders are fixed-shape).
+        sizes = {k: len(v) for k, v in arrays.items()}
+        assert len(set(sizes.values())) == 1, f"ragged arrays: {sizes}"
+        self.arrays = arrays
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self.num_samples = next(iter(sizes.values()))
+        assert self.num_samples >= batch_size, (
+            f"dataset has {self.num_samples} rows < batch {batch_size}"
+        )
+        self._order = np.arange(self.num_samples)
+        self._pos = 0
+        if shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self) -> None:
+        self._pos = 0
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def next_batch(self) -> Dict[str, np.ndarray]:
+        """Wraps around at epoch end (callers doing epoch accounting use
+        ``batches_per_epoch`` + ``reset``)."""
+        if self._pos + self.batch_size > self.num_samples:
+            self.reset()
+        idx = self._order[self._pos : self._pos + self.batch_size]
+        self._pos += self.batch_size
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            yield self.next_batch()
+
+
+def synthetic_arrays(
+    model,
+    num_samples: int,
+    seed: int = 0,
+    int_high: Optional[Dict[str, int]] = None,
+) -> Dict[str, np.ndarray]:
+    """Random host data matching a model's input tensors (reference:
+    synthetic-input mode, ``config.h:73``; DLRM random dataset,
+    ``dlrm.cc:234-236``).  ``int_high[name]`` bounds integer inputs
+    (vocab sizes / class counts)."""
+    rng = np.random.default_rng(seed)
+    int_high = int_high or {}
+    out = {}
+    for t in model.input_tensors:
+        shape = (num_samples,) + tuple(t.shape[1:])
+        if np.issubdtype(np.dtype(t.dtype), np.integer):
+            hi = int_high.get(t.name, 2)
+            out[t.name] = rng.integers(0, hi, size=shape).astype(np.int32)
+        else:
+            out[t.name] = rng.standard_normal(size=shape).astype(np.dtype(t.dtype))
+    return out

@@ -1,0 +1,96 @@
+"""Candle-Uno — multi-input-tower cancer-drug-response MLP.
+
+Reference: ``examples/candle_uno/candle_uno.{h,cc}`` — six input
+features (dose scalar, cell RNA-seq, 2×drug descriptors/fingerprints);
+cell/drug features pass through per-input feature towers
+(``build_feature_model``, 3×1000 dense), all encodings concat, then a
+3×1000 dense trunk and a 1-unit head into MSE loss
+(``candle_uno.cc:82-112``).  This is the reference's testbed for
+hybrid per-op strategies over a multi-tower graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.ops.base import TensorSpec
+
+
+@dataclasses.dataclass
+class CandleConfig:
+    """Defaults mirror ``candle_uno.h:20-37``."""
+
+    dense_layers: List[int] = dataclasses.field(default_factory=lambda: [1000] * 3)
+    dense_feature_layers: List[int] = dataclasses.field(
+        default_factory=lambda: [1000] * 3
+    )
+    feature_shapes: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {
+            "dose": 1,
+            "cell.rnaseq": 942,
+            "drug.descriptors": 5270,
+            "drug.fingerprints": 2048,
+        }
+    )
+    input_features: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "dose1": "dose",
+            "cell.rnaseq": "cell.rnaseq",
+            "drug1.descriptors": "drug.descriptors",
+            "drug1.fingerprints": "drug.fingerprints",
+            "drug2.descriptors": "drug.descriptors",
+            "drug2.fingerprints": "drug.fingerprints",
+        }
+    )
+
+    @staticmethod
+    def parse_args(argv: Sequence[str]) -> "CandleConfig":
+        cfg = CandleConfig()
+        argv = list(argv)
+        for i, a in enumerate(argv):
+            if a in ("--dense-layers", "--dense-feature-layers"):
+                if i + 1 >= len(argv):
+                    raise ValueError(f"flag {a} expects a value")
+                widths = [int(w) for w in argv[i + 1].split("-")]
+                if a == "--dense-layers":
+                    cfg.dense_layers = widths
+                else:
+                    cfg.dense_feature_layers = widths
+        return cfg
+
+
+def build_candle_uno(
+    batch_size: int = 64,
+    candle: Optional[CandleConfig] = None,
+    config: Optional[FFConfig] = None,
+) -> FFModel:
+    candle = candle or CandleConfig()
+    ff = FFModel(config or FFConfig(batch_size=batch_size))
+
+    # cell.*/drug.* feature types get an encoder tower (candle_uno.cc:70-81).
+    tower_types = {
+        ft for ft in candle.feature_shapes
+        if "." in ft and ft.split(".")[0] in ("cell", "drug")
+    }
+
+    encoded: List[TensorSpec] = []
+    for in_name, fea_type in candle.input_features.items():
+        shape = candle.feature_shapes[fea_type]
+        safe = in_name.replace(".", "_")
+        t = ff.create_tensor((batch_size, shape), name=f"input_{safe}")
+        if fea_type in tower_types:
+            for j, width in enumerate(candle.dense_feature_layers):
+                t = ff.dense(t, width, activation="relu",
+                             name=f"tower_{safe}_dense{j}")
+        encoded.append(t)
+
+    out = ff.concat(encoded, axis=1, name="concat")
+    for j, width in enumerate(candle.dense_layers):
+        out = ff.dense(out, width, activation="relu", name=f"trunk_dense{j}")
+    out = ff.dense(out, 1, activation=None, name="head")
+    label = ff.create_tensor((batch_size, 1), name="label")
+    ff.mse_loss(out, label, reduction="mean", name="mse_loss")
+    return ff

@@ -103,8 +103,12 @@ class Executor:
             if op.state_specs()
         }
 
-    def batch_shardings(self) -> Dict[str, NamedSharding]:
+    @functools.cached_property
+    def _batch_shardings(self) -> Dict[str, NamedSharding]:
         return {t.name: self.input_sharding(t) for t in self.model.input_tensors}
+
+    def batch_shardings(self) -> Dict[str, NamedSharding]:
+        return self._batch_shardings
 
     # -- initialization ----------------------------------------------------
 
@@ -224,5 +228,10 @@ class Executor:
     # -- data placement ----------------------------------------------------
 
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
-        sh = self.batch_shardings()
-        return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+        """Device-put each declared input in its consumer's sharding;
+        keys that are not model inputs pass through untouched (forward
+        ignores them)."""
+        sh = self._batch_shardings
+        return {
+            k: jax.device_put(v, sh[k]) if k in sh else v for k, v in batch.items()
+        }

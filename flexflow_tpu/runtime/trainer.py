@@ -57,7 +57,10 @@ class Trainer:
             fixed = self.synthetic_batch()
             batches = iter(lambda: fixed, None)  # infinite
         else:
-            batches = iter(batches)
+            raw = iter(batches)
+            # Place each host batch in its consumers' shardings (no-op
+            # for already-placed arrays) — the ZC-memory gather path.
+            batches = (ex.shard_batch(b) for b in raw)
 
         # Warmup (compile) outside the timed region — the reference's
         # init_layers()+first-iteration cuDNN algo search equivalent.

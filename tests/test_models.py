@@ -1,0 +1,153 @@
+"""Model-zoo tests: graph shapes, one train step each, DLRM table
+parallelism on the 8-dev mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.models import (
+    CandleConfig,
+    DLRMConfig,
+    build_candle_uno,
+    build_densenet121,
+    build_dlrm,
+    build_inception_v3,
+    build_resnet101,
+    build_vgg16,
+    dlrm_strategy,
+)
+from flexflow_tpu.runtime.executor import Executor
+
+
+def _one_step(ff, batch, n_devices=1, strategy=None):
+    ex = Executor(ff, strategy=strategy, devices=jax.devices()[:n_devices])
+    params, opt_state, state = ex.init()
+    batch = ex.shard_batch(batch)
+    params, opt_state, state, m = ex.train_step(params, opt_state, state, batch)
+    return jax.device_get(m)
+
+
+def test_dlrm_default_shapes_and_step(rng):
+    # Default config: 1 table vocab 4, bot 4-2, top 8-2... needs concat
+    # width 2+1*2=4 vs mlp_top[0]=8? Reference default is inconsistent
+    # for 1 table; use an explicit consistent config.
+    cfg = DLRMConfig(
+        sparse_feature_size=4,
+        embedding_size=[16, 16, 16, 16],
+        mlp_bot=[8, 4],
+        mlp_top=[4 + 4 * 4, 8, 1],
+    )
+    ff = build_dlrm(batch_size=8, dlrm=cfg)
+    batch = {
+        "dense_input": rng.standard_normal((8, 8)).astype(np.float32),
+        "sparse_input": rng.integers(0, 16, size=(8, 4)).astype(np.int32),
+        "label": rng.random((8, 1)).astype(np.float32),
+    }
+    m = _one_step(ff, batch)
+    assert np.isfinite(m["train_loss"])
+    assert m["train_all"] == 8
+
+
+def test_dlrm_table_parallel_matches_dp(rng):
+    cfg = DLRMConfig(
+        sparse_feature_size=4,
+        embedding_size=[32] * 8,
+        mlp_bot=[8, 4],
+        mlp_top=[4 + 8 * 4, 16, 1],
+    )
+    batch = {
+        "dense_input": rng.standard_normal((8, 8)).astype(np.float32),
+        "sparse_input": rng.integers(0, 32, size=(8, 8)).astype(np.int32),
+        "label": rng.random((8, 1)).astype(np.float32),
+    }
+    m_single = _one_step(build_dlrm(batch_size=8, dlrm=cfg), dict(batch), 1)
+    store = dlrm_strategy(8, cfg)
+    assert "embeddings" in store  # table-parallel entry exists
+    m_ep = _one_step(build_dlrm(batch_size=8, dlrm=cfg), dict(batch), 8, store)
+    np.testing.assert_allclose(
+        m_single["train_loss"], m_ep["train_loss"], rtol=2e-5, atol=1e-6
+    )
+
+
+def test_dlrm_heterogeneous_vocabs(rng):
+    cfg = DLRMConfig(
+        sparse_feature_size=4,
+        embedding_size=[8, 16, 32],
+        mlp_bot=[8, 4],
+        mlp_top=[4 + 3 * 4, 8, 1],
+    )
+    ff = build_dlrm(batch_size=4, dlrm=cfg)
+    batch = {
+        "dense_input": rng.standard_normal((4, 8)).astype(np.float32),
+        "label": rng.random((4, 1)).astype(np.float32),
+    }
+    for i, v in enumerate(cfg.embedding_size):
+        batch[f"sparse_{i}"] = rng.integers(0, v, size=(4, 1)).astype(np.int32)
+    m = _one_step(ff, batch)
+    assert np.isfinite(m["train_loss"])
+
+
+def test_dlrm_config_parse_args():
+    cfg = DLRMConfig.parse_args(
+        "--arch-sparse-feature-size 64 --arch-embedding-size 1000-2000 "
+        "--arch-mlp-bot 13-512-64 --arch-mlp-top 192-256-1 "
+        "--sigmoid-top 1 --arch-interaction-op cat".split()
+    )
+    assert cfg.sparse_feature_size == 64
+    assert cfg.embedding_size == [1000, 2000]
+    assert cfg.mlp_bot == [13, 512, 64]
+    assert cfg.mlp_top == [192, 256, 1]
+    assert cfg.sigmoid_top == 1
+
+
+def test_candle_uno_builds_and_steps(rng):
+    # Shrink the towers for test speed; keep the 6-input structure.
+    cfg = CandleConfig(
+        dense_layers=[32, 32],
+        dense_feature_layers=[16],
+        feature_shapes={
+            "dose": 1, "cell.rnaseq": 24,
+            "drug.descriptors": 40, "drug.fingerprints": 16,
+        },
+    )
+    ff = build_candle_uno(batch_size=8, candle=cfg)
+    # 6 inputs + label
+    assert len(ff.input_tensors) == 7
+    batch = {
+        t.name: rng.standard_normal(t.shape).astype(np.float32)
+        for t in ff.input_tensors
+    }
+    m = _one_step(ff, batch, n_devices=8)
+    assert np.isfinite(m["train_loss"])
+
+
+@pytest.mark.parametrize(
+    "builder,image_size,final_hw",
+    [
+        (build_vgg16, 224, 7),
+        (build_inception_v3, 299, 8),
+        (build_densenet121, 224, 7),
+        (build_resnet101, 224, 7),
+    ],
+)
+def test_cnn_catalog_shapes(builder, image_size, final_hw):
+    ff = builder(batch_size=2, image_size=image_size, num_classes=10)
+    pre_flat = ff.find_op("avgpool" if builder is not build_vgg16 else "pool4")
+    out = pre_flat.outputs[0]
+    if builder is not build_vgg16:
+        assert out.shape[1] == 1 and out.shape[2] == 1
+    logits = ff.layers[-1].inputs[0]
+    assert logits.shape == (2, 10)
+
+
+def test_inception_small_train_step(rng):
+    # Inception at reduced size: verify a full step runs (compile-heavy
+    # models are exercised shape-only above).
+    ff = build_inception_v3(batch_size=2, image_size=128, num_classes=4)
+    batch = {
+        "image": rng.standard_normal((2, 128, 128, 3)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(2,)).astype(np.int32),
+    }
+    m = _one_step(ff, batch)
+    assert np.isfinite(m["train_loss"])
