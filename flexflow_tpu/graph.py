@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.ops import (
+    LSTM,
     BatchNorm,
     Concat,
     Conv2D,
@@ -31,6 +32,7 @@ from flexflow_tpu.ops import (
     Reshape,
     SoftmaxCrossEntropy,
     TensorSpec,
+    WordEmbedding,
 )
 
 
@@ -183,6 +185,38 @@ class FFModel:
             MultiEmbedding(self._unique("embeddings", name), x, num_tables,
                            num_entries, out_dim, **kw)
         )
+
+    def word_embedding(
+        self,
+        x: TensorSpec,
+        num_entries: int,
+        out_dim: int,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        """Token embedding (batch, seq) -> (batch, seq, dim) (reference:
+        the NMT embed op, ``nmt/embed.cu``)."""
+        return self._add(
+            WordEmbedding(self._unique("word_embedding", name), x, num_entries,
+                          out_dim, **kw)
+        )
+
+    def lstm(
+        self,
+        x: TensorSpec,
+        hidden_size: int,
+        initial_state=None,
+        name: Optional[str] = None,
+        **kw,
+    ):
+        """LSTM over (batch, seq, features); returns (y, hT, cT)
+        (reference: the NMT LSTM op family, ``nmt/lstm.cu``; sequence
+        chunking + pipelining is the 's' strategy axis — see
+        ``ops/rnn.py``)."""
+        op = LSTM(self._unique("lstm", name), x, hidden_size,
+                  initial_state=initial_state, **kw)
+        self.layers.append(op)
+        return op.outputs[0], op.outputs[1], op.outputs[2]
 
     def concat(self, inputs: Sequence[TensorSpec], axis: int, name: Optional[str] = None) -> TensorSpec:
         return self._add(Concat(self._unique("concat", name), inputs, axis))

@@ -1,0 +1,89 @@
+"""NMT: seq2seq encoder-decoder LSTM stack.
+
+Reference: ``nmt/nmt.cc`` + ``nmt/rnn.cu`` — a 2-layer encoder/decoder
+LSTM over chunked sequences (``LSTM_PER_NODE_LENGTH=10`` steps per op,
+``rnn.h:21-23``), word embeddings per side (``nmt/embed.cu``), a
+vocab-dim tensor-parallel projection (``nmt/linear.cu``,
+``rnn.cu:240-253``) and data-parallel softmax+CE
+(``nmt/softmax_data_parallel.cu``).  The reference wires encoder final
+(hx, cx) into the decoder chunk chain (``rnn.cu:304-319``).
+
+Here the whole stack is five graph ops per side; sequence chunking and
+the chunk pipeline are the ``s`` strategy degree on each LSTM op, and
+the hierarchical SharedVariable gradient reduction (``rnn.cu:650-703``)
+is XLA's psum over the (n, s) mesh axes.
+
+Reference default shapes (``nmt.cc:40-44``): batch 64/worker, 2 layers,
+seq 20-40, hidden/embed 1024-2048, vocab 32k.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+
+def build_nmt(
+    batch_size: int = 64,
+    src_len: int = 20,
+    tgt_len: int = 20,
+    vocab_size: int = 32 * 1024,
+    embed_dim: int = 1024,
+    hidden_size: int = 1024,
+    num_layers: int = 2,
+    config: Optional[FFConfig] = None,
+) -> FFModel:
+    ff = FFModel(config or FFConfig(batch_size=batch_size))
+    src = ff.create_tensor((batch_size, src_len), dtype=jnp.int32,
+                           name="src", dim_axes=("n", "s"))
+    tgt = ff.create_tensor((batch_size, tgt_len), dtype=jnp.int32,
+                           name="tgt", dim_axes=("n", "s"))
+    lbl = ff.create_tensor((batch_size, tgt_len), dtype=jnp.int32,
+                           name="label", dim_axes=("n", "s"))
+
+    x = ff.word_embedding(src, vocab_size, embed_dim, name="src_embed")
+    enc_states = []
+    for i in range(num_layers):
+        x, hT, cT = ff.lstm(x, hidden_size, name=f"enc_lstm{i}")
+        enc_states.append((hT, cT))
+
+    y = ff.word_embedding(tgt, vocab_size, embed_dim, name="tgt_embed")
+    for i in range(num_layers):
+        y, _, _ = ff.lstm(y, hidden_size, initial_state=enc_states[i],
+                          name=f"dec_lstm{i}")
+
+    logits = ff.dense(y, vocab_size, name="vocab_proj")
+    ff.softmax(logits, lbl, name="softmax")
+    return ff
+
+
+def nmt_strategy(
+    num_devices: int, dp: Optional[int] = None, sp: Optional[int] = None,
+    num_layers: int = 2,
+) -> StrategyStore:
+    """The reference's GlobalConfig placement (``nmt.cc:269-308``):
+    embeddings pinned, LSTMs sharded over (batch, sequence-chunk)
+    pipelines, vocab projection tensor-parallel over the vocab dim."""
+    if dp is None and sp is None:
+        sp = 1
+        dp = num_devices
+        while dp > sp and dp % 2 == 0:
+            dp //= 2
+            sp *= 2
+    elif dp is None:
+        dp = max(1, num_devices // sp)
+    elif sp is None:
+        sp = max(1, num_devices // dp)
+    assert dp * sp <= num_devices
+    store = StrategyStore(num_devices)
+    for side in ("enc", "dec"):
+        for i in range(num_layers):
+            store.set(f"{side}_lstm{i}", ParallelConfig(n=dp, s=sp))
+    store.set("vocab_proj", ParallelConfig(n=dp, c=sp))
+    store.set("softmax", ParallelConfig(n=dp * sp))
+    return store

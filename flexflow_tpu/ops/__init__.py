@@ -1,9 +1,10 @@
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 from flexflow_tpu.ops.conv import Conv2D, Flat, Pool2D
-from flexflow_tpu.ops.embedding import Embedding, MultiEmbedding
+from flexflow_tpu.ops.embedding import Embedding, MultiEmbedding, WordEmbedding
 from flexflow_tpu.ops.linear import Linear
 from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
 from flexflow_tpu.ops.norm import BatchNorm
+from flexflow_tpu.ops.rnn import LSTM
 from flexflow_tpu.ops.tensor_ops import Concat, Reshape
 
 __all__ = [
@@ -17,6 +18,8 @@ __all__ = [
     "Linear",
     "Embedding",
     "MultiEmbedding",
+    "WordEmbedding",
+    "LSTM",
     "Concat",
     "Reshape",
     "SoftmaxCrossEntropy",

@@ -10,8 +10,9 @@ there are no hand-written bwd tasks; XLA emits the transposed kernels
 the reference wrote by hand (e.g. ``linear.cu:388-488``).
 
 Semantic sharding axes: each tensor dim is tagged 'n' (sample), 'c'
-(channel/feature), 'h', 'w' or None; the mesh plan maps tags to mesh
-axes per the op's ParallelConfig (see parallel/mesh.py).
+(channel/feature), 'h', 'w', 's' (sequence) or None; the mesh plan
+maps tags to mesh axes per the op's ParallelConfig (see
+parallel/mesh.py).
 """
 
 from __future__ import annotations
@@ -78,6 +79,20 @@ class Op:
     def state_specs(self) -> Dict[str, ParamSpec]:
         """Non-trained mutable state (e.g. batchnorm running stats)."""
         return {}
+
+    # -- mesh binding -----------------------------------------------------
+
+    def bind_mesh(self, plan, pc) -> None:
+        """Called by the executor before tracing ``forward`` with the
+        MeshPlan and this op's ParallelConfig.  Most ops ignore it —
+        GSPMD places them from sharding constraints alone.  Ops that
+        need *explicit* collectives (pipelined sequence-parallel scans,
+        ring attention) stash the mesh axes here and issue
+        ``shard_map``/``ppermute`` themselves — the analogue of the
+        reference ops that talk to the mapper directly
+        (``RnnMapper::assign_to_gpu``, ``rnn_mapper.cc:131-135``)."""
+        self._plan = plan
+        self._pc = pc
 
     # -- execution --------------------------------------------------------
 

@@ -116,3 +116,42 @@ class MultiEmbedding(Op):
         t_range = jnp.arange(tables.shape[0])[None, :]  # (1, T)
         y = tables[t_range, idx]  # advanced indexing → batched gather
         return [y], state
+
+
+class WordEmbedding(Op):
+    """Token embedding over (batch, seq) int ids → (batch, seq, dim).
+
+    Reference: the NMT word-embedding op (``nmt/embed.cu`` — custom
+    gather fwd / scatter-add bwd kernels, ``embed.cu:152-186``).  The
+    scatter-add gradient is XLA's gather transpose; sequence sharding
+    (axis tag 's') flows straight through the lookup.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        num_entries: int,
+        out_dim: int,
+        dtype=jnp.float32,
+        kernel_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 2, f"word embedding input must be (batch, seq), got {x.shape}"
+        self.attrs = dict(num_entries=num_entries, out_dim=out_dim)
+        self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
+        self._make_output((x.shape[0], x.shape[1], out_dim), dtype, ("n", "s", None))
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        a = self.attrs
+        return {
+            "table": ParamSpec(
+                (a["num_entries"], a["out_dim"]),
+                self.outputs[0].dtype,
+                self.kernel_initializer,
+            )
+        }
+
+    def forward(self, params, xs, state, training):
+        (idx,) = xs
+        return [jnp.take(params["table"], idx, axis=0)], state

@@ -32,14 +32,18 @@ class Linear(Op):
         bias_initializer=None,
     ):
         super().__init__(name, [x])
-        assert x.ndim == 2, f"linear input must be (batch, features), got {x.shape}"
+        assert x.ndim >= 2, f"linear input must be (batch, ..., features), got {x.shape}"
         check_activation(activation)
-        n, cin = x.shape
+        cin = x.shape[-1]
         self.in_dim = cin
         self.attrs = dict(out_dim=out_dim, activation=activation, use_bias=use_bias)
         self.kernel_initializer = kernel_initializer or GlorotUniform()
         self.bias_initializer = bias_initializer or ZeroInitializer()
-        self._make_output((n, out_dim), x.dtype, ("n", "c"))
+        # ND inputs (e.g. (batch, seq, features) in the NMT vocab
+        # projection, ``nmt/linear.cu``) contract the last dim only.
+        self._make_output(
+            x.shape[:-1] + (out_dim,), x.dtype, x.dim_axes[:-1] + ("c",)
+        )
 
     def param_specs(self) -> Dict[str, ParamSpec]:
         out_dim = self.attrs["out_dim"]

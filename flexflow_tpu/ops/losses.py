@@ -25,27 +25,31 @@ class SoftmaxCrossEntropy(Op):
 
     def __init__(self, name: str, logits: TensorSpec, labels: TensorSpec):
         super().__init__(name, [logits, labels])
-        assert logits.ndim == 2
-        assert labels.shape == (logits.shape[0],), (
-            f"labels must be (batch,), got {labels.shape}"
+        assert logits.ndim >= 2
+        assert labels.shape == logits.shape[:-1], (
+            f"labels must be {logits.shape[:-1]}, got {labels.shape}"
         )
         # Loss op still exposes the softmax probabilities as an output
-        # (the reference's softmax op output region).
-        self._make_output(logits.shape, logits.dtype, ("n", "c"))
+        # (the reference's softmax op output region).  ND logits (the
+        # per-token NMT case, ``nmt/softmax_data_parallel.cu``) are
+        # averaged over every leading dim.
+        self._make_output(logits.shape, logits.dtype, logits.dim_axes)
 
     def forward(self, params, xs, state, training):
         logits, labels = xs
         logits = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
         logp = logits - lse
-        nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        nll = -jnp.take_along_axis(
+            logp, labels[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
         loss = jnp.mean(nll)
         pred = jnp.argmax(logits, axis=-1)
         correct = jnp.sum((pred == labels).astype(jnp.int32))
         metrics = {
             "train_loss": loss,
             "train_correct": correct,
-            "train_all": jnp.int32(labels.shape[0]),
+            "train_all": jnp.int32(labels.size),
         }
         return (loss, metrics, [jnp.exp(logp).astype(self.outputs[0].dtype)]), state
 

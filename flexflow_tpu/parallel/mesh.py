@@ -4,7 +4,7 @@ The reference's FFMapper routes every point of an op's task index space
 to the GPU listed in the op's strategy (reference:
 ``src/mapper/mapper.cc:54-112``).  The TPU-native equivalent keeps ONE
 canonical ``jax.sharding.Mesh`` whose axes are the prime factors of the
-device count; a per-op ``(n, c, h, w)`` degree vector is realized by
+device count; a per-op ``(n, c, h, w, s)`` degree vector is realized by
 assigning each semantic axis a subset of mesh axes whose sizes multiply
 to the requested degree.  Any divisor of the device count is exactly
 representable this way, so every reference strategy (power-of-two GPU
@@ -14,8 +14,14 @@ mismatched partitions (e.g. ``src/ops/flat.cu:81-124``) become
 XLA-inserted collectives over ICI.
 
 Assignment is deterministic — ``n`` consumes mesh axes from the left,
-``c`` from the right, then ``h``/``w`` — so ops sharing degrees get
-identical specs and no gratuitous resharding.
+``c`` and ``s`` from the right, then ``h``/``w`` — so ops sharing
+degrees get identical specs and no gratuitous resharding.  Each
+assigned tuple is then canonicalized to MESH-DEFINITION order: for a
+tuple of axis names, ``lax.ppermute`` flattens device ids in mesh
+order regardless of listing, while ``axis_index``/``PartitionSpec``
+follow the listing, so mesh-ordering the tuple is what keeps explicit
+collectives (the pipelined LSTM, ring attention) consistent with the
+data layout.
 """
 
 from __future__ import annotations
@@ -70,8 +76,10 @@ class MeshPlan:
             return cached
         avail: List[Tuple[str, int]] = list(zip(self.axis_names, self.axis_sizes))
         result: Dict[str, Tuple[str, ...]] = {}
-        # n from the left, c from the right, h/w from what remains.
-        for sem, from_left in (("n", True), ("c", False), ("h", True), ("w", True)):
+        # n from the left, c/s from the right, h/w from what remains.
+        for sem, from_left in (
+            ("n", True), ("c", False), ("s", False), ("h", True), ("w", True)
+        ):
             deg = pc.degree(sem)
             picked: List[str] = []
             for p in _prime_factors(deg):
@@ -84,7 +92,12 @@ class MeshPlan:
                         f"after assigning {result}"
                     )
                 picked.append(avail.pop(hit)[0])
-            result[sem] = tuple(picked)
+            # Canonicalize to mesh-definition order: lax.ppermute over a
+            # tuple of axis names flattens in MESH order regardless of
+            # the listing, while axis_index/PartitionSpec follow the
+            # listing — sorting makes every convention agree (pinned by
+            # the pipelined-LSTM equivalence tests).
+            result[sem] = tuple(sorted(picked, key=self.axis_names.index))
         self._assign_cache[pc] = result
         return result
 

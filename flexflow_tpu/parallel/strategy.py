@@ -25,7 +25,7 @@ import dataclasses
 import json
 from typing import Dict, Optional, Sequence, Tuple
 
-AXES = ("n", "c", "h", "w")
+AXES = ("n", "c", "h", "w", "s")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,12 +37,21 @@ class ParallelConfig:
     an optional explicit placement (reference: ``config.h:42`` gpu[]),
     consumed by the cost simulator; the runtime realizes placement via
     mesh coordinates instead.
+
+    ``s`` is the sequence/pipeline axis — the TPU generalization of the
+    reference's structural sequence decomposition (NMT chops sequences
+    into per-chunk ops placed on different GPUs, ``rnn.h:21-23``,
+    ``rnn.cu:304-319``); here it is a first-class strategy degree that
+    sequence ops (LSTM, attention) realize with explicit collectives
+    (``ppermute`` pipelines / ring attention) over the assigned mesh
+    axes.
     """
 
     n: int = 1
     c: int = 1
     h: int = 1
     w: int = 1
+    s: int = 1
     device_ids: Optional[Tuple[int, ...]] = None
 
     def degree(self, axis: str) -> int:
@@ -50,7 +59,7 @@ class ParallelConfig:
 
     @property
     def num_parts(self) -> int:
-        return self.n * self.c * self.h * self.w
+        return self.n * self.c * self.h * self.w * self.s
 
     @staticmethod
     def data_parallel(num_devices: int) -> "ParallelConfig":
@@ -72,6 +81,7 @@ class ParallelConfig:
             c=int(d.get("c", 1)),
             h=int(d.get("h", 1)),
             w=int(d.get("w", 1)),
+            s=int(d.get("s", 1)),
             device_ids=tuple(ids) if ids is not None else None,
         )
 

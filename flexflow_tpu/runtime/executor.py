@@ -158,6 +158,7 @@ class Executor:
         metrics: Dict[str, jax.Array] = {}
         new_state: Dict[str, Dict[str, jax.Array]] = {}
         for op in self.model.layers:
+            op.bind_mesh(self.plan, self._pc(op))
             xs = [env[t.name] for t in op.inputs]
             p = params.get(op.name, {})
             s = state.get(op.name, {})
@@ -218,8 +219,9 @@ class Executor:
         def fwd(params, state, batch):
             loss, metrics, _, env = self.forward(params, state, batch, training=False)
             outs = {
-                op.outputs[0].name: env[op.outputs[0].name]
+                t.name: env[t.name]
                 for op in self.model.layers
+                for t in op.outputs
             }
             return loss, outs
 
