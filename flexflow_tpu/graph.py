@@ -19,16 +19,20 @@ import jax.numpy as jnp
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.ops import (
     LSTM,
+    Add,
     BatchNorm,
     Concat,
     Conv2D,
     Embedding,
     Flat,
+    LayerNorm,
     Linear,
     MSELoss,
     MultiEmbedding,
+    MultiHeadAttention,
     Op,
     Pool2D,
+    PositionEmbedding,
     Reshape,
     SoftmaxCrossEntropy,
     TensorSpec,
@@ -217,6 +221,30 @@ class FFModel:
                   initial_state=initial_state, **kw)
         self.layers.append(op)
         return op.outputs[0], op.outputs[1], op.outputs[2]
+
+    def multihead_attention(
+        self,
+        x: TensorSpec,
+        num_heads: int,
+        causal: bool = True,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        """Self-attention; under an 's' strategy degree this runs ring
+        attention over the mesh (see ``ops/attention.py``)."""
+        return self._add(
+            MultiHeadAttention(self._unique("attention", name), x, num_heads,
+                               causal=causal, **kw)
+        )
+
+    def layer_norm(self, x: TensorSpec, name: Optional[str] = None, **kw) -> TensorSpec:
+        return self._add(LayerNorm(self._unique("layernorm", name), x, **kw))
+
+    def position_embedding(self, x: TensorSpec, name: Optional[str] = None, **kw) -> TensorSpec:
+        return self._add(PositionEmbedding(self._unique("pos_embedding", name), x, **kw))
+
+    def add(self, a: TensorSpec, b: TensorSpec, name: Optional[str] = None) -> TensorSpec:
+        return self._add(Add(self._unique("add", name), a, b))
 
     def concat(self, inputs: Sequence[TensorSpec], axis: int, name: Optional[str] = None) -> TensorSpec:
         return self._add(Concat(self._unique("concat", name), inputs, axis))

@@ -1,0 +1,81 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+The reference has no transformer (2018 codebase); SURVEY.md §2.7
+directs the rebuild to generalize its sequence parallelism (chunked
+LSTM ops with P2P state handoff) to ring-attention context parallelism.
+This model family is that generalization: pre-LN GPT-style blocks whose
+attention runs the ring path of ``ops/attention.py`` under an ``s``
+strategy degree, composing with data parallelism (``n``) and
+Megatron-style tensor parallelism (``c`` on the MLP/projection dims).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+
+def build_transformer_lm(
+    batch_size: int = 8,
+    seq_len: int = 2048,
+    vocab_size: int = 32 * 1024,
+    d_model: int = 512,
+    num_heads: int = 8,
+    num_layers: int = 6,
+    d_ff: Optional[int] = None,
+    config: Optional[FFConfig] = None,
+) -> FFModel:
+    d_ff = d_ff or 4 * d_model
+    ff = FFModel(config or FFConfig(batch_size=batch_size))
+    tok = ff.create_tensor((batch_size, seq_len), dtype=jnp.int32,
+                           name="tokens", dim_axes=("n", "s"))
+    lbl = ff.create_tensor((batch_size, seq_len), dtype=jnp.int32,
+                           name="label", dim_axes=("n", "s"))
+    x = ff.word_embedding(tok, vocab_size, d_model, name="embed")
+    x = ff.position_embedding(x, name="pos")
+    for i in range(num_layers):
+        a = ff.layer_norm(x, name=f"blk{i}_ln1")
+        a = ff.multihead_attention(a, num_heads, causal=True, name=f"blk{i}_attn")
+        x = ff.add(x, a, name=f"blk{i}_res1")
+        m = ff.layer_norm(x, name=f"blk{i}_ln2")
+        m = ff.dense(m, d_ff, activation="gelu", name=f"blk{i}_mlp_up")
+        m = ff.dense(m, d_model, name=f"blk{i}_mlp_down")
+        x = ff.add(x, m, name=f"blk{i}_res2")
+    x = ff.layer_norm(x, name="ln_f")
+    logits = ff.dense(x, vocab_size, name="lm_head")
+    ff.softmax(logits, lbl, name="softmax")
+    return ff
+
+
+def transformer_strategy(
+    num_devices: int,
+    num_layers: int,
+    dp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+) -> StrategyStore:
+    """dp × sp (ring/context) × tp (Megatron) hybrid; attention and
+    token-level ops get (n=dp, s=sp); MLP and lm_head get (n=dp, c=tp)."""
+    assert dp * sp <= num_devices and dp * tp <= num_devices
+    store = StrategyStore(num_devices)
+    seq_pc = ParallelConfig(n=dp, s=sp)
+    tp_pc = ParallelConfig(n=dp, c=tp)
+    store.set("embed", seq_pc)
+    store.set("pos", seq_pc)
+    for i in range(num_layers):
+        store.set(f"blk{i}_ln1", seq_pc)
+        store.set(f"blk{i}_attn", seq_pc)
+        store.set(f"blk{i}_res1", seq_pc)
+        store.set(f"blk{i}_ln2", seq_pc)
+        store.set(f"blk{i}_mlp_up", tp_pc)
+        store.set(f"blk{i}_mlp_down", seq_pc)
+        store.set(f"blk{i}_res2", seq_pc)
+    store.set("ln_f", seq_pc)
+    store.set("lm_head", tp_pc)
+    store.set("softmax", seq_pc)
+    return store

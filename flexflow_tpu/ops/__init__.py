@@ -1,3 +1,4 @@
+from flexflow_tpu.ops.attention import LayerNorm, MultiHeadAttention, PositionEmbedding
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 from flexflow_tpu.ops.conv import Conv2D, Flat, Pool2D
 from flexflow_tpu.ops.embedding import Embedding, MultiEmbedding, WordEmbedding
@@ -5,7 +6,7 @@ from flexflow_tpu.ops.linear import Linear
 from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
 from flexflow_tpu.ops.norm import BatchNorm
 from flexflow_tpu.ops.rnn import LSTM
-from flexflow_tpu.ops.tensor_ops import Concat, Reshape
+from flexflow_tpu.ops.tensor_ops import Add, Concat, Reshape
 
 __all__ = [
     "Op",
@@ -20,7 +21,11 @@ __all__ = [
     "MultiEmbedding",
     "WordEmbedding",
     "LSTM",
+    "Add",
     "Concat",
+    "LayerNorm",
+    "MultiHeadAttention",
+    "PositionEmbedding",
     "Reshape",
     "SoftmaxCrossEntropy",
     "MSELoss",

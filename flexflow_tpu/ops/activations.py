@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-VALID_ACTIVATIONS = (None, "none", "relu", "sigmoid", "tanh")
+VALID_ACTIVATIONS = (None, "none", "relu", "sigmoid", "tanh", "gelu")
 
 
 def check_activation(activation) -> None:
@@ -30,4 +30,8 @@ def apply_activation(x, activation):
         return jnp.reciprocal(1 + jnp.exp(-x))
     if activation == "tanh":
         return jnp.tanh(x)
+    if activation == "gelu":
+        import jax
+
+        return jax.nn.gelu(x)
     raise ValueError(f"unknown activation {activation!r}")

@@ -1,0 +1,236 @@
+"""Attention operators: multi-head attention with ring context parallelism.
+
+The reference predates transformers; its long-context mechanism is the
+NMT sequence decomposition — per-chunk ops with P2P state handoff
+(``rnn.h:21-23``, ``rnn.cu:304-319``).  SURVEY.md §2.7 calls for that
+mechanism generalized to attention: **ring attention** over the ICI
+torus.  Under an ``s``-degree strategy each device owns one sequence
+chunk of Q/K/V; K/V blocks rotate around the ring via ``lax.ppermute``
+while each device's queries accumulate attention with a streaming
+(flash-style) log-sum-exp, so the full T×T score matrix never
+materializes and sequence length scales with the number of devices.
+
+Tensor parallelism composes orthogonally: the projection weights carry
+a 'c' tag on their head/output dim, so a ``c``-degree strategy gives
+Megatron-style head-parallel attention via GSPMD (the analogue of the
+reference Linear's column split, ``linear.cu:100-138``) — no explicit
+collectives needed there.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from flexflow_tpu.initializers import GlorotUniform, OnesInitializer, ZeroInitializer
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+_NEG_INF = -1e30
+
+
+class LayerNorm(Op):
+    """Layer normalization over the last (feature) dim."""
+
+    def __init__(self, name: str, x: TensorSpec, eps: float = 1e-5):
+        super().__init__(name, [x])
+        self.attrs = dict(eps=eps)
+        self._make_output(x.shape, x.dtype, x.dim_axes)
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        d = self.inputs[0].shape[-1]
+        dt = self.outputs[0].dtype
+        return {
+            "scale": ParamSpec((d,), dt, OnesInitializer()),
+            "bias": ParamSpec((d,), dt, ZeroInitializer()),
+        }
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.attrs["eps"])
+        y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return [y.astype(x.dtype)], state
+
+
+class PositionEmbedding(Op):
+    """Adds a learned (seq, dim) position table to (batch, seq, dim)."""
+
+    def __init__(self, name: str, x: TensorSpec, initializer=None):
+        super().__init__(name, [x])
+        assert x.ndim == 3
+        self.initializer = initializer or GlorotUniform()
+        self._make_output(x.shape, x.dtype, x.dim_axes)
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        _, t, d = self.inputs[0].shape
+        return {
+            "table": ParamSpec((t, d), self.outputs[0].dtype, self.initializer,
+                               ("s", None))
+        }
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        return [x + params["table"][None]], state
+
+
+def _streaming_attention_block(q, k, v, scores_mask, m, denom, acc):
+    """One flash-attention accumulation step in f32.
+
+    q: (b, h, tq, hd); k/v: (b, h, tk, hd); scores_mask: (tq, tk) bool
+    (True = attend) or None; m/denom: (b, h, tq); acc: (b, h, tq, hd).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if scores_mask is not None:
+        scores = jnp.where(scores_mask[None, None], scores, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    acc = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    denom = denom * corr + jnp.sum(p, axis=-1)
+    return m_new, denom, acc
+
+
+class MultiHeadAttention(Op):
+    """Self-attention over (batch, seq, dim).
+
+    ``s``-degree strategies run the ring-attention path; otherwise a
+    plain fused attention that GSPMD shards over batch (and heads,
+    via the 'c'-tagged projection weights).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        num_heads: int,
+        causal: bool = True,
+        use_bias: bool = True,
+        kernel_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 3, f"attention input must be (batch, seq, dim), got {x.shape}"
+        d = x.shape[-1]
+        assert d % num_heads == 0, (d, num_heads)
+        self.attrs = dict(num_heads=num_heads, causal=causal, use_bias=use_bias)
+        self.kernel_initializer = kernel_initializer or GlorotUniform()
+        self._make_output(x.shape, x.dtype, x.dim_axes)
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        d = self.inputs[0].shape[-1]
+        dt = self.outputs[0].dtype
+        ki = self.kernel_initializer
+        specs = {
+            "wq": ParamSpec((d, d), dt, ki, (None, "c")),
+            "wk": ParamSpec((d, d), dt, ki, (None, "c")),
+            "wv": ParamSpec((d, d), dt, ki, (None, "c")),
+            "wo": ParamSpec((d, d), dt, ki, ("c", None)),
+        }
+        if self.attrs["use_bias"]:
+            specs["bq"] = ParamSpec((d,), dt, ZeroInitializer(), ("c",))
+            specs["bk"] = ParamSpec((d,), dt, ZeroInitializer(), ("c",))
+            specs["bv"] = ParamSpec((d,), dt, ZeroInitializer(), ("c",))
+            specs["bo"] = ParamSpec((d,), dt, ZeroInitializer())
+        return specs
+
+    # -- helpers -----------------------------------------------------------
+
+    def _project(self, params, x):
+        q = x @ params["wq"]
+        k = x @ params["wk"]
+        v = x @ params["wv"]
+        if self.attrs["use_bias"]:
+            q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        return q, k, v
+
+    def _split_heads(self, x):
+        b, t, d = x.shape
+        h = self.attrs["num_heads"]
+        return x.reshape(b, t, h, d // h).transpose(0, 2, 1, 3).astype(jnp.float32)
+
+    def _merge_heads(self, x, dtype):
+        b, h, t, hd = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, t, h * hd).astype(dtype)
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        pc = getattr(self, "_pc", None)
+        S = pc.s if pc is not None else 1
+        q, k, v = self._project(params, x)
+        if S <= 1:
+            out = self._attend_dense(q, k, v, x.dtype)
+        else:
+            out = self._attend_ring(q, k, v, x.dtype)
+        y = out @ params["wo"]
+        if self.attrs["use_bias"]:
+            y = y + params["bo"]
+        return [y], state
+
+    def _attend_dense(self, q, k, v, dtype):
+        q, k, v = map(self._split_heads, (q, k, v))
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if self.attrs["causal"]:
+            t = scores.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        return self._merge_heads(out, dtype)
+
+    # -- ring attention (context parallelism) ------------------------------
+
+    def _attend_ring(self, q, k, v, dtype):
+        plan, pc = self._plan, self._pc
+        (s_entry, S), (n_entry, _) = plan.local_degrees(pc, "s", "n")
+        batch, seq, d = q.shape
+        assert seq % S == 0, f"{self.name}: seq {seq} not divisible by s={S}"
+        spec = PartitionSpec(n_entry, s_entry, None)
+        causal = self.attrs["causal"]
+
+        def local_fn(q, k, v):
+            # q/k/v: (b_loc, t_loc, d) — this device's sequence chunk.
+            s_idx = lax.axis_index(tuple(s_entry))
+            qh = self._split_heads(q)
+            kh = self._split_heads(k)
+            vh = self._split_heads(v)
+            b, h, t, hd = qh.shape
+            m = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+            denom = jnp.zeros((b, h, t), jnp.float32)
+            acc = jnp.zeros((b, h, t, hd), jnp.float32)
+            q_pos = s_idx * t + jnp.arange(t)
+            ring = [(i, (i + 1) % S) for i in range(S)]
+            k_cur, v_cur = kh, vh
+            # Unrolled ring: step j holds the K/V chunk of device
+            # (s_idx - j) mod S; XLA overlaps the ppermute with the
+            # matmuls of the previous step.
+            for j in range(S):
+                k_idx = (s_idx - j) % S
+                if causal:
+                    k_pos = k_idx * t + jnp.arange(t)
+                    mask = k_pos[None, :] <= q_pos[:, None]
+                else:
+                    mask = None
+                m, denom, acc = _streaming_attention_block(
+                    qh, k_cur, v_cur, mask, m, denom, acc
+                )
+                if j < S - 1:
+                    k_cur = lax.ppermute(k_cur, tuple(s_entry), ring)
+                    v_cur = lax.ppermute(v_cur, tuple(s_entry), ring)
+            out = acc / denom[..., None]
+            return self._merge_heads(out, dtype)
+
+        return jax.shard_map(
+            local_fn,
+            mesh=plan.mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)

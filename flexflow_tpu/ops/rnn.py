@@ -131,15 +131,7 @@ class LSTM(Op):
 
     def _forward_pipelined(self, x, h0, c0, wx, wh, b, fb):
         plan, pc = self._plan, self._pc
-        asg = plan.assign(pc)
-        s_axes, n_axes = asg["s"], asg["n"]
-        sizes = dict(zip(plan.axis_names, plan.axis_sizes))
-        S = 1
-        for ax in s_axes:
-            S *= sizes[ax]
-        N = 1
-        for ax in n_axes:
-            N *= sizes[ax]
+        (s_entry, S), (n_entry, N) = plan.local_degrees(pc, "s", "n")
         batch, seq, _ = x.shape
         assert seq % S == 0, f"{self.name}: seq {seq} not divisible by s={S}"
         M = self.attrs["num_microbatches"] or S
@@ -149,8 +141,6 @@ class LSTM(Op):
             f"{M} microbatches"
         )
 
-        n_entry = tuple(n_axes) if n_axes else None
-        s_entry = tuple(s_axes)
         x_spec = PartitionSpec(n_entry, s_entry, None)
         st_spec = PartitionSpec(n_entry, None)
         rep = PartitionSpec()

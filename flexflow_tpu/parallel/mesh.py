@@ -101,6 +101,22 @@ class MeshPlan:
         self._assign_cache[pc] = result
         return result
 
+    def local_degrees(self, pc: ParallelConfig, *axes: str):
+        """For explicit-collective ops (pipelined LSTM, ring attention):
+        per requested semantic axis, the (mesh-axis tuple or None,
+        total degree) realized by this plan.  Returns a list parallel
+        to ``axes``."""
+        asg = self.assign(pc)
+        size_of = dict(zip(self.axis_names, self.axis_sizes))
+        out = []
+        for sem in axes:
+            names = asg.get(sem, ())
+            deg = 1
+            for ax in names:
+                deg *= size_of[ax]
+            out.append((tuple(names) if names else None, deg))
+        return out
+
     def spec(
         self,
         pc: ParallelConfig,
