@@ -1,0 +1,116 @@
+"""Native (C++) components and their build/load machinery.
+
+The reference framework's runtime is entirely native (C++/CUDA on
+Legion); the TPU rebuild keeps the compute path in XLA but implements
+the offline strategy-search core natively too (``ffsim.cc``, the
+counterpart of the reference's standalone simulator binary,
+``scripts/simulator.cc`` + ``scripts/Makefile:1-2``).  The shared
+library is compiled on first use with the system toolchain and loaded
+via ctypes — no pybind11 dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ffsim.cc")
+_LIB = os.path.join(_HERE, "_ffsim.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _needs_build() -> bool:
+    return (not os.path.exists(_LIB)) or (
+        os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    )
+
+
+def build_ffsim(force: bool = False) -> str:
+    """Compile ``ffsim.cc`` into ``_ffsim.so`` if missing or stale."""
+    with _lock:
+        if force or _needs_build():
+            # Per-process temp name so concurrent builds (e.g. parallel
+            # test workers sharing the checkout) can't clobber each
+            # other mid-compile; os.replace is atomic.
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                _SRC, "-o", tmp,
+            ]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"ffsim build failed:\n{proc.stderr}"
+                )
+            os.replace(tmp, _LIB)
+    return _LIB
+
+
+def load_ffsim() -> ctypes.CDLL:
+    """Build (if needed) and load the simulator library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build_ffsim()
+    lib = ctypes.CDLL(path)
+    lib.ffsim_search.restype = ctypes.c_void_p
+    lib.ffsim_search.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_uint, ctypes.c_double,
+    ]
+    lib.ffsim_simulate.restype = ctypes.c_void_p
+    lib.ffsim_simulate.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+    ]
+    lib.ffsim_free.restype = None
+    lib.ffsim_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def _call_returning_text(fn, *args) -> str:
+    lib = load_ffsim()
+    ptr = fn(*args)
+    try:
+        text = ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+    finally:
+        lib.ffsim_free(ptr)
+    if text.startswith("error:"):
+        raise ValueError(f"ffsim: {text}")
+    return text
+
+
+def ffsim_search(problem: str, iters: int, seed: int, alpha: float) -> dict:
+    """Run the native MCMC search.  Returns
+    ``{"init_us": float, "best_us": float, "assign": [int, ...]}``."""
+    lib = load_ffsim()
+    text = _call_returning_text(
+        lib.ffsim_search, problem.encode(), iters, seed, alpha
+    )
+    out = {}
+    for line in text.splitlines():
+        key, *vals = line.split()
+        if key == "assign":
+            out["assign"] = [int(v) for v in vals]
+        else:
+            out[key] = float(vals[0])
+    return out
+
+
+def ffsim_simulate(problem: str, assign) -> float:
+    """Simulate one fixed per-op config assignment; returns time in us."""
+    lib = load_ffsim()
+    arr = (ctypes.c_int * len(assign))(*assign)
+    text = _call_returning_text(
+        lib.ffsim_simulate, problem.encode(), arr, len(assign)
+    )
+    return float(text.split()[1])

@@ -1,0 +1,339 @@
+// ffsim: event-driven parallelization-strategy simulator + MCMC search.
+//
+// Native C++ core of the offline strategy autotuner, the TPU-native
+// counterpart of the reference's standalone simulator binary
+// (reference: scripts/simulator.cc — per-op shard tasks, inter-shard
+// communication tasks costed by rect-intersection volume / bandwidth,
+// greedy earliest-start list scheduling over per-device timelines, and
+// Metropolis MCMC over single-op strategy rewrites with exp(-alpha*d)
+// acceptance, simulator.cc:896-1051,1444-1470).
+//
+// The Python layer (flexflow_tpu/search/) builds a problem description
+// from an FFModel graph — per-op candidate (n,c,h,w,s) degree vectors
+// with analytic-or-measured per-shard compute costs and mesh-consistent
+// device placements — and this library searches it.  Exchange format is
+// a whitespace-separated text protocol (see search/problem.py).
+//
+// Exposed C ABI (ctypes):
+//   char* ffsim_search(const char* problem, long iters, unsigned seed,
+//                      double alpha);
+//   char* ffsim_simulate(const char* problem, const int* assign, int n);
+//   void  ffsim_free(char* p);
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kAxes = 5;  // n, c, h, w, s
+constexpr double kMsgLatencyUs = 1.0;  // per-message fixed cost
+
+struct Cfg {
+  int deg[kAxes];
+  int parts;
+  double cost_us;   // per-shard compute time (fwd+bwd folded in)
+  double sync_us;   // gradient-reduction time charged after the op
+  std::vector<int> devs;  // device of each shard, row-major over degrees
+};
+
+struct OpT {
+  std::string name;
+  std::vector<Cfg> cfgs;
+};
+
+struct EdgeT {
+  int src, dst;
+  double bytes_per_elem;
+  std::vector<int64_t> dims;      // tensor extents
+  std::vector<int> src_axis;      // semantic axis per dim on the producer
+  std::vector<int> dst_axis;      // ... on the consumer; -1 = whole extent
+                                  // (e.g. a contracted dim is read in full
+                                  // by every consumer shard — the
+                                  // reference's aliased input partitions
+                                  // for TP linear, linear.cu:100-138)
+};
+
+struct Problem {
+  int ndev = 1;
+  int dev_per_node = 1;
+  double bw_intra = 1.0;  // bytes per us, same-node (ICI)
+  double bw_inter = 1.0;  // bytes per us, cross-node (DCN)
+  std::vector<OpT> ops;
+  std::vector<EdgeT> edges;
+  std::vector<std::vector<int>> in_edges;  // per dst op -> edge indices
+};
+
+bool parse_problem(const char* text, Problem& p, std::string& err) {
+  std::istringstream in(text);
+  std::string tok;
+  if (!(in >> tok) || tok != "ffsim") { err = "bad magic"; return false; }
+  int version;
+  in >> version;
+  int nops = 0, nedges = 0;
+  while (in >> tok) {
+    if (tok == "ndevices") {
+      in >> p.ndev;
+    } else if (tok == "devices_per_node") {
+      in >> p.dev_per_node;
+    } else if (tok == "bw_intra") {
+      in >> p.bw_intra;
+    } else if (tok == "bw_inter") {
+      in >> p.bw_inter;
+    } else if (tok == "nops") {
+      in >> nops;
+      p.ops.reserve(nops);
+    } else if (tok == "op") {
+      int id, ncfg;
+      OpT op;
+      in >> id >> ncfg >> op.name;
+      if (id != (int)p.ops.size()) { err = "op ids must be dense"; return false; }
+      op.cfgs.reserve(ncfg);
+      for (int c = 0; c < ncfg; ++c) {
+        std::string kw;
+        in >> kw;
+        if (kw != "cfg") { err = "expected cfg"; return false; }
+        Cfg cfg;
+        cfg.parts = 1;
+        for (int a = 0; a < kAxes; ++a) {
+          in >> cfg.deg[a];
+          if (cfg.deg[a] < 1) { err = "degrees must be >= 1"; return false; }
+          cfg.parts *= cfg.deg[a];
+        }
+        in >> cfg.cost_us >> cfg.sync_us;
+        cfg.devs.resize(cfg.parts);
+        for (int s = 0; s < cfg.parts; ++s) {
+          in >> cfg.devs[s];
+          if (cfg.devs[s] < 0 || cfg.devs[s] >= p.ndev) {
+            err = "device id out of range";
+            return false;
+          }
+        }
+        op.cfgs.push_back(std::move(cfg));
+      }
+      p.ops.push_back(std::move(op));
+    } else if (tok == "nedges") {
+      in >> nedges;
+      p.edges.reserve(nedges);
+    } else if (tok == "edge") {
+      EdgeT e;
+      int nd;
+      in >> e.src >> e.dst >> e.bytes_per_elem >> nd;
+      e.dims.resize(nd);
+      e.src_axis.resize(nd);
+      e.dst_axis.resize(nd);
+      for (int d = 0; d < nd; ++d) in >> e.dims[d];
+      for (int d = 0; d < nd; ++d) in >> e.src_axis[d];
+      for (int d = 0; d < nd; ++d) in >> e.dst_axis[d];
+      if (e.src < 0 || e.dst < 0 || e.src >= e.dst) {
+        err = "edges must go forward (src < dst)";
+        return false;
+      }
+      p.edges.push_back(std::move(e));
+    } else {
+      err = "unknown token: " + tok;
+      return false;
+    }
+  }
+  if ((int)p.ops.size() != nops) { err = "nops mismatch"; return false; }
+  if ((int)p.edges.size() != nedges) { err = "nedges mismatch"; return false; }
+  p.in_edges.assign(p.ops.size(), {});
+  for (int i = 0; i < (int)p.edges.size(); ++i) {
+    if (p.edges[i].dst >= (int)p.ops.size()) { err = "edge dst oob"; return false; }
+    p.in_edges[p.edges[i].dst].push_back(i);
+  }
+  return true;
+}
+
+// Decompose shard linear index into per-axis coordinates (row-major
+// over [n, c, h, w, s], n outermost).
+inline void shard_coords(const Cfg& c, int shard, int out[kAxes]) {
+  for (int a = kAxes - 1; a >= 0; --a) {
+    out[a] = shard % c.deg[a];
+    shard /= c.deg[a];
+  }
+}
+
+// Intersection volume (elements) of two shards' rectangles on a tensor.
+// A shard's rect along dim d mapped to semantic axis a is the coord[a]-th
+// of deg[a] contiguous integer slabs of the extent — the analogue of the
+// reference's Legion rect partitions intersected per comm edge.
+double overlap_volume(const EdgeT& e, const Cfg& sc, int si, const Cfg& dc,
+                      int di) {
+  int scoord[kAxes], dcoord[kAxes];
+  shard_coords(sc, si, scoord);
+  shard_coords(dc, di, dcoord);
+  double vol = 1.0;
+  for (size_t d = 0; d < e.dims.size(); ++d) {
+    int64_t ext = e.dims[d];
+    int64_t lo1 = 0, hi1 = ext, lo2 = 0, hi2 = ext;
+    int sa = e.src_axis[d], da = e.dst_axis[d];
+    if (sa >= 0) {
+      lo1 = scoord[sa] * ext / sc.deg[sa];
+      hi1 = (scoord[sa] + 1) * ext / sc.deg[sa];
+    }
+    if (da >= 0) {
+      lo2 = dcoord[da] * ext / dc.deg[da];
+      hi2 = (dcoord[da] + 1) * ext / dc.deg[da];
+    }
+    int64_t ov = std::min(hi1, hi2) - std::max(lo1, lo2);
+    if (ov <= 0) return 0.0;
+    vol *= (double)ov;
+  }
+  return vol;
+}
+
+// Greedy earliest-start list scheduling of shard tasks + comm tasks over
+// per-device compute timelines and per-(src,dst) channel timelines.
+double simulate(const Problem& p, const std::vector<int>& assign) {
+  const int n = (int)p.ops.size();
+  std::vector<double> dev_free(p.ndev, 0.0);
+  std::vector<double> chan(p.ndev * p.ndev, 0.0);
+  std::vector<std::vector<double>> finish(n);
+  std::vector<double> ready;
+  double makespan = 0.0;
+  for (int oi = 0; oi < n; ++oi) {
+    const Cfg& cfg = p.ops[oi].cfgs[assign[oi]];
+    ready.assign(cfg.parts, 0.0);
+    for (int ei : p.in_edges[oi]) {
+      const EdgeT& e = p.edges[ei];
+      const Cfg& scfg = p.ops[e.src].cfgs[assign[e.src]];
+      const std::vector<double>& sfin = finish[e.src];
+      for (int i = 0; i < scfg.parts; ++i) {
+        for (int j = 0; j < cfg.parts; ++j) {
+          double vol = overlap_volume(e, scfg, i, cfg, j);
+          if (vol <= 0.0) continue;
+          int sd = scfg.devs[i], dd = cfg.devs[j];
+          if (sd == dd) {
+            ready[j] = std::max(ready[j], sfin[i]);
+            continue;
+          }
+          bool same_node = (sd / p.dev_per_node) == (dd / p.dev_per_node);
+          double bw = same_node ? p.bw_intra : p.bw_inter;
+          double t = vol * e.bytes_per_elem / bw + kMsgLatencyUs;
+          double& ch = chan[sd * p.ndev + dd];
+          double start = std::max(sfin[i], ch);
+          ch = start + t;
+          ready[j] = std::max(ready[j], start + t);
+        }
+      }
+    }
+    finish[oi].resize(cfg.parts);
+    double op_end = 0.0;
+    for (int j = 0; j < cfg.parts; ++j) {
+      int d = cfg.devs[j];
+      double start = std::max(ready[j], dev_free[d]);
+      double fin = start + cfg.cost_us;
+      dev_free[d] = fin;
+      finish[oi][j] = fin;
+      op_end = std::max(op_end, fin);
+    }
+    if (cfg.sync_us > 0.0) {
+      // Gradient reduction over this op's replica group: charge every
+      // participating device after the op's last shard (the reference
+      // folds this into the optimizer-update gather,
+      // optimizer_kernel.cu:118-129).
+      for (int j = 0; j < cfg.parts; ++j) {
+        int d = cfg.devs[j];
+        dev_free[d] = std::max(dev_free[d], op_end + cfg.sync_us);
+      }
+      op_end += cfg.sync_us;
+    }
+    makespan = std::max(makespan, op_end);
+  }
+  return makespan;
+}
+
+char* dup_result(const std::string& s) {
+  char* out = (char*)std::malloc(s.size() + 1);
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Metropolis MCMC over per-op config choices (reference acceptance rule:
+// accept better always, worse with prob exp(-alpha * delta / current),
+// simulator.cc:1444-1470).  Starts from config 0 for every op (the
+// Python layer puts the data-parallel fallback first).  Returns a text
+// blob: "init_us I\nbest_us B\nassign i0 i1 ...\n" or "error: ...".
+char* ffsim_search(const char* problem, long iters, unsigned seed,
+                   double alpha) {
+  Problem p;
+  std::string err;
+  if (!parse_problem(problem, p, err)) {
+    return dup_result("error: " + err);
+  }
+  const int n = (int)p.ops.size();
+  std::vector<int> cur(n, 0), best;
+  double cur_t = simulate(p, cur);
+  double init_t = cur_t;
+  double best_t = cur_t;
+  best = cur;
+  std::mt19937 rng(seed);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  // Only ops with >1 candidate are worth rewriting.
+  std::vector<int> movable;
+  for (int i = 0; i < n; ++i)
+    if (p.ops[i].cfgs.size() > 1) movable.push_back(i);
+  if (!movable.empty()) {
+    for (long it = 0; it < iters; ++it) {
+      int oi = movable[rng() % movable.size()];
+      int old = cur[oi];
+      int ncfg = (int)p.ops[oi].cfgs.size();
+      int nxt = (int)(rng() % (ncfg - 1));
+      if (nxt >= old) ++nxt;
+      cur[oi] = nxt;
+      double t = simulate(p, cur);
+      bool accept = t < cur_t ||
+                    unif(rng) < std::exp(-alpha * (t - cur_t) /
+                                         std::max(cur_t, 1e-9));
+      if (accept) {
+        cur_t = t;
+        if (t < best_t) {
+          best_t = t;
+          best = cur;
+        }
+      } else {
+        cur[oi] = old;
+      }
+    }
+  }
+  std::ostringstream out;
+  out << "init_us " << init_t << "\nbest_us " << best_t << "\nassign";
+  for (int i = 0; i < n; ++i) out << ' ' << best[i];
+  out << '\n';
+  return dup_result(out.str());
+}
+
+// Simulate one fixed assignment; returns "time_us T\n" or "error: ...".
+char* ffsim_simulate(const char* problem, const int* assign, int n) {
+  Problem p;
+  std::string err;
+  if (!parse_problem(problem, p, err)) {
+    return dup_result("error: " + err);
+  }
+  if (n != (int)p.ops.size()) {
+    return dup_result("error: assignment length mismatch");
+  }
+  std::vector<int> a(assign, assign + n);
+  for (int i = 0; i < n; ++i) {
+    if (a[i] < 0 || a[i] >= (int)p.ops[i].cfgs.size()) {
+      return dup_result("error: config index out of range");
+    }
+  }
+  std::ostringstream out;
+  out << "time_us " << simulate(p, a) << '\n';
+  return dup_result(out.str());
+}
+
+void ffsim_free(char* ptr) { std::free(ptr); }
+
+}  // extern "C"

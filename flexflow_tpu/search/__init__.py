@@ -1,0 +1,105 @@
+"""Offline parallelization-strategy search.
+
+The "automatic parallelization" half of the reference framework: an
+event-driven simulator costed by a device model plus Metropolis MCMC
+over per-op strategy rewrites (reference: ``scripts/simulator.cc``,
+acceptance rule ``simulator.cc:1444-1470``), emitting a strategy table
+the runtime consumes.  The simulator core is native C++
+(``flexflow_tpu/native/ffsim.cc``); this package builds problems from
+FFModel graphs and maps results back to a ``StrategyStore``.
+
+Usage::
+
+    result = search_strategy(model, num_devices=8)
+    result.store.save("strategy.json")   # -s strategy.json at train time
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.native import ffsim_search, ffsim_simulate
+from flexflow_tpu.parallel.mesh import MeshPlan
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.search.cost_model import DeviceModel
+from flexflow_tpu.search.problem import (
+    SearchProblem,
+    build_problem,
+    build_virtual_plan,
+)
+
+__all__ = [
+    "DeviceModel",
+    "SearchResult",
+    "search_strategy",
+    "simulate_strategy",
+    "build_problem",
+    "build_virtual_plan",
+]
+
+
+@dataclasses.dataclass
+class SearchResult:
+    store: StrategyStore
+    #: Simulated step time of the data-parallel baseline (us) — the
+    #: reference's ``dpCompTime`` printout (``simulator.cc:117``).
+    dp_time_us: float
+    #: Simulated step time of the best found strategy (us).
+    best_time_us: float
+    assignment: Dict[str, ParallelConfig]
+
+    @property
+    def speedup(self) -> float:
+        return self.dp_time_us / max(self.best_time_us, 1e-9)
+
+
+def search_strategy(
+    model: FFModel,
+    num_devices: int,
+    iters: int = 50_000,
+    seed: int = 0,
+    alpha: float = 5.0,
+    device_model: Optional[DeviceModel] = None,
+    max_candidates: int = 64,
+) -> SearchResult:
+    """MCMC-search a per-op strategy table for ``model`` on
+    ``num_devices`` devices.  Runs entirely offline (no TPU needed)."""
+    plan = build_virtual_plan(num_devices)
+    prob = build_problem(model, plan, device_model, max_candidates)
+    res = ffsim_search(prob.text, iters, seed, alpha)
+    table: Dict[str, ParallelConfig] = {}
+    for op, cands, idx in zip(prob.ops, prob.candidates, res["assign"]):
+        table[op.name] = cands[idx]
+    store = StrategyStore(num_devices, table)
+    return SearchResult(
+        store=store,
+        dp_time_us=res["init_us"],
+        best_time_us=res["best_us"],
+        assignment=table,
+    )
+
+
+def simulate_strategy(
+    model: FFModel,
+    store: StrategyStore,
+    num_devices: Optional[int] = None,
+    device_model: Optional[DeviceModel] = None,
+) -> float:
+    """Simulated step time (us) of an explicit strategy table — the
+    what-if query the reference's VERBOSE simulator mode answers
+    (``simulator.cc:1012-1031``)."""
+    nd = num_devices or store.num_devices
+    plan = build_virtual_plan(nd)
+    prob = build_problem(model, plan, device_model)
+    assign: List[int] = []
+    for op, cands in zip(prob.ops, prob.candidates):
+        pc = store.find(op.name)
+        try:
+            assign.append(cands.index(pc))
+        except ValueError:
+            # Not enumerated (e.g. explicit device_ids): fall back to
+            # the op's DP candidate.
+            assign.append(0)
+    return ffsim_simulate(prob.text, assign)

@@ -1,0 +1,80 @@
+"""Standalone strategy-search CLI.
+
+The reference ships its autotuner as a separate binary
+(``scripts/simulator.cc`` via ``scripts/Makefile:1-2``) and a strategy
+generator (``src/runtime/dlrm_strategy.cc``); this is both::
+
+    python -m flexflow_tpu.search --model alexnet -b 64 \
+        --devices 8 --iters 50000 -o strategy.json
+
+The emitted JSON is consumed at train time via ``-s strategy.json``
+(``FFConfig.parse_args``).
+"""
+
+import argparse
+import sys
+
+
+def build_model(name: str, batch_size: int):
+    if name == "alexnet":
+        from flexflow_tpu.models.alexnet import build_alexnet
+        return build_alexnet(batch_size=batch_size)
+    if name == "vgg16":
+        from flexflow_tpu.models.cnn_catalog import build_vgg16
+        return build_vgg16(batch_size=batch_size)
+    if name == "inception":
+        from flexflow_tpu.models.cnn_catalog import build_inception_v3
+        return build_inception_v3(batch_size=batch_size)
+    if name == "densenet":
+        from flexflow_tpu.models.cnn_catalog import build_densenet121
+        return build_densenet121(batch_size=batch_size)
+    if name == "resnet101":
+        from flexflow_tpu.models.cnn_catalog import build_resnet101
+        return build_resnet101(batch_size=batch_size)
+    if name == "dlrm":
+        from flexflow_tpu.models.dlrm import build_dlrm, dlrm_random_benchmark_config
+        return build_dlrm(batch_size=batch_size, dlrm=dlrm_random_benchmark_config())
+    if name == "candle_uno":
+        from flexflow_tpu.models.candle_uno import build_candle_uno
+        return build_candle_uno(batch_size=batch_size)
+    if name == "transformer":
+        from flexflow_tpu.models.transformer import build_transformer_lm
+        return build_transformer_lm(batch_size=batch_size)
+    if name == "nmt":
+        from flexflow_tpu.models.nmt import build_nmt
+        return build_nmt(batch_size=batch_size)
+    raise SystemExit(f"unknown model {name!r}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="flexflow_tpu.search")
+    ap.add_argument("--model", required=True,
+                    help="alexnet|vgg16|inception|densenet|resnet101|"
+                         "dlrm|candle_uno|transformer|nmt")
+    ap.add_argument("-b", "--batch-size", type=int, default=64)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=50_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--alpha", type=float, default=5.0)
+    ap.add_argument("-o", "--output", default="strategy.json")
+    args = ap.parse_args(argv)
+
+    from flexflow_tpu.search import search_strategy
+
+    model = build_model(args.model, args.batch_size)
+    res = search_strategy(
+        model, num_devices=args.devices, iters=args.iters,
+        seed=args.seed, alpha=args.alpha,
+    )
+    res.store.save(args.output)
+    print(f"dp      = {res.dp_time_us:.1f} us/step (simulated)")
+    print(f"best    = {res.best_time_us:.1f} us/step (simulated)")
+    print(f"speedup = {res.speedup:.2f}x")
+    for name, pc in res.assignment.items():
+        degs = {a: pc.degree(a) for a in "nchws" if pc.degree(a) > 1}
+        print(f"  {name:24s} {degs or 'replicated'}")
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

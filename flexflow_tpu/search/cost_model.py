@@ -1,0 +1,182 @@
+"""Analytic per-op cost model for the strategy search.
+
+The reference measures per-op, per-degree compute times with live
+cuDNN/cuBLAS microbenchmarks (reference: ``scripts/cnn.h:204+``,
+``measure_conv2d_time`` et al.) and feeds them to the simulator.  On
+TPU the equivalent measured mode exists too (``measure.py``), but the
+default is a roofline model: an op's time is
+``max(flops / MXU_rate, bytes / HBM_rate)`` plus a fixed per-task
+overhead — the standard TPU performance mental model (MXU-bound vs
+HBM-bandwidth-bound).  Costs only need to *rank* strategies, as in the
+reference, where the simulator's absolute times are not validated
+against wall clock either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ops import (
+    LSTM,
+    Conv2D,
+    Embedding,
+    Linear,
+    MultiEmbedding,
+    MultiHeadAttention,
+    Op,
+    WordEmbedding,
+)
+from flexflow_tpu.ops.attention import PositionEmbedding
+
+#: Lookup-table ops: forward is a gather, so the table parameter is
+#: neither contracted (no MXU flops) nor streamed in full from HBM —
+#: only the selected rows (~= the output) move.  The *gradient* is
+#: still table-dense when replicated (the reference's scatter-add into
+#: the whole grad region, ``embedding.cu:128-158``), so tables keep
+#: their full weight in the sync cost.
+LOOKUP_OPS = (Embedding, MultiEmbedding, WordEmbedding, PositionEmbedding)
+
+#: fwd+bwd multiplier: backward is ~2x forward flops (two GEMMs per
+#: fwd GEMM — the reference's bwd tasks run data- and filter-grad
+#: kernels per fwd kernel, e.g. ``linear.cu:388-488``).
+FWD_BWD_FACTOR = 3.0
+
+
+@dataclasses.dataclass
+class DeviceModel:
+    """TPU chip + interconnect constants (v5e-flavored defaults).
+
+    Rates are per-microsecond so simulated times are in us.  The 4:1
+    shape of intra:inter bandwidth mirrors the reference simulator's
+    NVLink:IB ratio (``simulator.cc:37-38``), here ICI:DCN.
+    """
+
+    mxu_flops_per_us: float = 1.97e14 / 1e6 * 0.5  # bf16 peak, 50% eff.
+    hbm_bytes_per_us: float = 8.19e11 / 1e6
+    ici_bytes_per_us: float = 4.5e10 / 1e6
+    dcn_bytes_per_us: float = 2.5e9 / 1e6
+    task_overhead_us: float = 2.0
+    devices_per_node: int = 256  # one v5e pod slice = one ICI domain
+
+
+@dataclasses.dataclass
+class OpCost:
+    flops: float          # forward flops
+    bytes: float          # forward activation+param traffic, bytes
+    param_bytes: Dict[str, Tuple[float, Tuple]]  # name -> (bytes, dim_axes)
+    #: bytes of the primary input for ops that contract it against a
+    #: ``c``-sharded weight: under TP each shard computes a full-size
+    #: partial input-gradient that must be reduced across the c-group
+    #: (the reference's replica-grad ``backward2`` saxpy-reduction,
+    #: ``linear.cu:494-520``).
+    contracted_input_bytes: float = 0.0
+
+
+def contracted_input_dims(op: Op) -> Tuple[int, ...]:
+    """Dims of ``op.inputs[0]`` that are contracted (read in full by
+    every c-shard): the feature dim of Linear/Attention, the channel
+    dim of NHWC Conv2D."""
+    if isinstance(op, (Linear, MultiHeadAttention)):
+        return (op.inputs[0].ndim - 1,)
+    if isinstance(op, Conv2D):
+        return (3,)
+    return ()
+
+
+def _dtype_size(dtype) -> int:
+    return int(np.dtype(dtype).itemsize)
+
+
+def op_cost(op: Op) -> OpCost:
+    """Forward flops/bytes for one op from its declared shapes.
+
+    Dense-compute flops follow from the parameters: every weight of
+    size ``prod(W)`` is contracted against each of the output's
+    non-feature positions, i.e. ``2 * prod(out dims not tagged 'c') *
+    prod(W)`` — exact for conv (``2*N*Ho*Wo*kh*kw*Cin*Cout``), linear,
+    LSTM gates, and attention projections.  Attention adds its
+    ``O(seq^2)`` score/value term explicitly.
+    """
+    out = op.outputs[0]
+    esize = _dtype_size(out.dtype)
+    non_c = 1.0
+    for ext, ax in zip(out.shape, out.dim_axes):
+        if ax != "c":
+            non_c *= ext
+    flops = 0.0
+    bytes_ = 0.0
+    params: Dict[str, Tuple[float, Tuple]] = {}
+    lookup = isinstance(op, LOOKUP_OPS)
+    for name, spec in op.param_specs().items():
+        psize = float(np.prod(spec.shape)) if spec.shape else 1.0
+        pbytes = psize * _dtype_size(spec.dtype)
+        params[name] = (pbytes, tuple(spec.dim_axes))
+        if lookup:
+            # Gather: touches ~output-many rows, already counted below.
+            continue
+        if len(spec.shape) >= 2:
+            flops += 2.0 * non_c * psize
+        bytes_ += pbytes
+    if isinstance(op, MultiHeadAttention):
+        b, s, d = op.inputs[0].shape
+        flops += 4.0 * b * float(s) ** 2 * d  # QK^T and PV
+    if isinstance(op, LSTM):
+        # Sequential scan: MXU utilization is poor for the per-step
+        # small GEMMs; charge 4x.
+        flops *= 4.0
+    for t in op.inputs:
+        bytes_ += float(np.prod(t.shape)) * _dtype_size(t.dtype)
+    for t in op.outputs:
+        bytes_ += float(np.prod(t.shape)) * _dtype_size(t.dtype)
+    cib = 0.0
+    if contracted_input_dims(op) and op.inputs:
+        x = op.inputs[0]
+        cib = float(np.prod(x.shape)) * _dtype_size(x.dtype)
+    return OpCost(
+        flops=flops, bytes=bytes_, param_bytes=params,
+        contracted_input_bytes=cib,
+    )
+
+
+def shard_cost_us(cost: OpCost, parts: int, dev: DeviceModel) -> float:
+    """Per-shard fwd+bwd compute time under an even ``parts``-way split."""
+    f = cost.flops * FWD_BWD_FACTOR / parts
+    b = cost.bytes * FWD_BWD_FACTOR / parts
+    return dev.task_overhead_us + max(
+        f / dev.mxu_flops_per_us, b / dev.hbm_bytes_per_us
+    )
+
+
+def sync_cost_us(cost: OpCost, degrees: Dict[str, int], dev: DeviceModel) -> float:
+    """Gradient-reduction time for one op under the given degrees.
+
+    A parameter sharded along semantic axes A is replicated across the
+    product of the remaining degrees ``r``; its gradient needs a ring
+    all-reduce over the replica group: ``2*(r-1)/r * shard_bytes / bw``
+    (the reference's replica-grad gather in the optimizer,
+    ``optimizer_kernel.cu:118-123``, generalized to a ring over ICI).
+    """
+    parts = 1
+    for d in degrees.values():
+        parts *= d
+    total = 0.0
+    for _, (pbytes, dim_axes) in cost.param_bytes.items():
+        shard_deg = 1
+        for ax in dim_axes:
+            if ax is not None:
+                shard_deg *= degrees.get(ax, 1)
+        replicas = max(1, parts // max(shard_deg, 1))
+        if replicas <= 1:
+            continue
+        shard_bytes = pbytes / max(shard_deg, 1)
+        total += 2.0 * (replicas - 1) / replicas * shard_bytes / dev.ici_bytes_per_us
+    c = degrees.get("c", 1)
+    if c > 1 and cost.contracted_input_bytes > 0:
+        # TP input-grad reduce-scatter across the c-group.
+        total += (
+            2.0 * (c - 1) / c * cost.contracted_input_bytes / dev.ici_bytes_per_us
+        )
+    return total

@@ -1,0 +1,187 @@
+"""FFModel graph → ffsim problem serialization.
+
+Builds the text problem the native simulator consumes (see
+``flexflow_tpu/native/ffsim.cc``): per-op candidate ``(n,c,h,w,s)``
+degree vectors with roofline shard costs and mesh-consistent device
+placements, plus producer→consumer tensor edges whose shard-rect
+intersections the simulator costs as communication (the reference's
+``intersect(rect)/bandwidth`` comm tasks, ``simulator.cc:896-908``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.ops import Op
+from flexflow_tpu.parallel.mesh import InfeasibleStrategyError, MeshPlan, _prime_factors
+from flexflow_tpu.parallel.strategy import AXES, ParallelConfig
+from flexflow_tpu.search.cost_model import (
+    DeviceModel,
+    contracted_input_dims,
+    op_cost,
+    shard_cost_us,
+    sync_cost_us,
+)
+
+AXIS_INDEX = {a: i for i, a in enumerate(AXES)}
+
+
+def build_virtual_plan(num_devices: int) -> MeshPlan:
+    """A MeshPlan with axis bookkeeping but no jax Mesh — the offline
+    search plans for a device count that need not be attached (the
+    reference simulator likewise models 2x4 GPUs from one process,
+    ``simulator.cc:32-33``)."""
+    sizes = _prime_factors(num_devices) or [1]
+    names = tuple(f"x{i}" for i in range(len(sizes)))
+    return MeshPlan(mesh=None, axis_names=names, axis_sizes=tuple(sizes))
+
+
+def shard_devices(plan: MeshPlan, pc: ParallelConfig) -> List[int]:
+    """Device id of every shard of ``pc``, row-major over (n,c,h,w,s).
+
+    Mirrors how the runtime's mesh assignment places shards (the
+    FFMapper ``slice_task`` analogue, ``mapper.cc:54-112``): each
+    semantic coordinate decomposes into its assigned mesh-axis
+    coordinates; unassigned mesh axes sit at coordinate 0 (first
+    replica)."""
+    if pc.device_ids is not None:
+        assert len(pc.device_ids) == pc.num_parts
+        return list(pc.device_ids)
+    asg = plan.assign(pc)
+    size_of = dict(zip(plan.axis_names, plan.axis_sizes))
+    axis_pos = {nm: i for i, nm in enumerate(plan.axis_names)}
+    degs = [pc.degree(a) for a in AXES]
+    devs: List[int] = []
+    for k in range(pc.num_parts):
+        rem = k
+        coords: Dict[str, int] = {}
+        for a, d in zip(reversed(AXES), reversed(degs)):
+            coords[a] = rem % d
+            rem //= d
+        mesh_coord = [0] * len(plan.axis_names)
+        for a in AXES:
+            c = coords[a]
+            for nm in reversed(asg.get(a, ())):
+                mesh_coord[axis_pos[nm]] = c % size_of[nm]
+                c //= size_of[nm]
+        flat = 0
+        for i, sz in enumerate(plan.axis_sizes):
+            flat = flat * sz + mesh_coord[i]
+        devs.append(flat)
+    return devs
+
+
+def enumerate_candidates(
+    op: Op, plan: MeshPlan, max_candidates: int = 64
+) -> List[ParallelConfig]:
+    """All feasible degree vectors for ``op`` over its semantic axes.
+
+    An axis is usable if it tags a dim of the op's primary output; a
+    degree is usable if it divides every tagged extent (keeps shards
+    even, the reference's rect partitions round instead) and the mesh
+    can realize the combination.  Candidate 0 is the data-parallel
+    fallback (largest feasible pure-``n`` split) so the search starts
+    from — and ``init_us`` reports — the DP baseline, like the
+    reference's ``dpCompTime`` (``simulator.cc:117``).
+    """
+    ndev = plan.num_devices
+    out = op.outputs[0]
+    axis_min_extent: Dict[str, int] = {}
+    for ext, ax in zip(out.shape, out.dim_axes):
+        if ax is not None:
+            axis_min_extent[ax] = min(ext, axis_min_extent.get(ax, ext))
+    options: Dict[str, List[int]] = {}
+    for ax, ext in axis_min_extent.items():
+        options[ax] = [d for d in range(1, ndev + 1) if ext % d == 0 and ndev % d == 0]
+    axes = [a for a in AXES if a in options]
+    combos: List[ParallelConfig] = []
+    for degs in itertools.product(*(options[a] for a in axes)):
+        parts = int(np.prod(degs)) if degs else 1
+        if parts > ndev:
+            continue
+        pc = ParallelConfig(**dict(zip(axes, degs)))
+        try:
+            plan.assign(pc)
+        except InfeasibleStrategyError:
+            continue
+        combos.append(pc)
+    # DP fallback first (largest pure-n split), then by ascending parts.
+    n_only = [pc for pc in combos if pc.num_parts == pc.n]
+    dp = max(n_only, key=lambda pc: pc.n, default=ParallelConfig())
+    rest = sorted(
+        (pc for pc in combos if pc != dp),
+        key=lambda pc: (-pc.num_parts, pc.n, pc.c, pc.h, pc.w, pc.s),
+    )
+    return [dp] + rest[: max_candidates - 1]
+
+
+@dataclasses.dataclass
+class SearchProblem:
+    text: str
+    ops: List[Op]
+    candidates: List[List[ParallelConfig]]
+
+
+def build_problem(
+    model: FFModel,
+    plan: MeshPlan,
+    dev: Optional[DeviceModel] = None,
+    max_candidates: int = 64,
+) -> SearchProblem:
+    dev = dev or DeviceModel()
+    ops = list(model.layers)
+    op_index = {op.name: i for i, op in enumerate(ops)}
+    lines: List[str] = [
+        "ffsim 1",
+        f"ndevices {plan.num_devices}",
+        f"devices_per_node {min(dev.devices_per_node, plan.num_devices)}",
+        f"bw_intra {dev.ici_bytes_per_us}",
+        f"bw_inter {dev.dcn_bytes_per_us}",
+        f"nops {len(ops)}",
+    ]
+    candidates: List[List[ParallelConfig]] = []
+    for i, op in enumerate(ops):
+        cands = enumerate_candidates(op, plan, max_candidates)
+        candidates.append(cands)
+        cost = op_cost(op)
+        name = op.name.replace(" ", "_")
+        lines.append(f"op {i} {len(cands)} {name}")
+        for pc in cands:
+            degrees = {a: pc.degree(a) for a in AXES}
+            c_us = shard_cost_us(cost, pc.num_parts, dev)
+            s_us = sync_cost_us(cost, degrees, dev)
+            devs = shard_devices(plan, pc)
+            degs = " ".join(str(pc.degree(a)) for a in AXES)
+            devs_s = " ".join(map(str, devs))
+            lines.append(f"cfg {degs} {c_us:.4f} {s_us:.4f} {devs_s}")
+    edges: List[str] = []
+    for j, op in enumerate(ops):
+        contracted = set(contracted_input_dims(op))
+        for ti, t in enumerate(op.inputs):
+            if t.producer is None:
+                continue  # placeholder: fed by the data loader
+            i = op_index[t.producer.name]
+            assert i < j, f"graph must be topologically ordered: {t.name}"
+            bpe = int(np.dtype(t.dtype).itemsize)
+            nd = len(t.shape)
+            dims = " ".join(str(e) for e in t.shape)
+            src_axes = " ".join(
+                str(AXIS_INDEX[a]) if a is not None else "-1" for a in t.dim_axes
+            )
+            # Consumer-side rects: a contracted dim is read in full by
+            # every shard (broadcast), so it maps to no axis.
+            dst_axes = " ".join(
+                "-1" if (ti == 0 and d in contracted) or a is None
+                else str(AXIS_INDEX[a])
+                for d, a in enumerate(t.dim_axes)
+            )
+            edges.append(f"edge {i} {j} {bpe} {nd} {dims} {src_axes} {dst_axes}")
+    lines.append(f"nedges {len(edges)}")
+    lines.extend(edges)
+    lines.append("")
+    return SearchProblem(text="\n".join(lines), ops=ops, candidates=candidates)

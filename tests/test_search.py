@@ -1,0 +1,180 @@
+"""Strategy-search subsystem: native simulator semantics + MCMC search.
+
+The reference's equivalent is the offline simulator binary
+(``scripts/simulator.cc``): event-driven list scheduling of shard +
+comm tasks and Metropolis search.  The hand-computed schedule cases
+here pin the scheduler's exact semantics (device timelines, channel
+contention, rect-intersection comm volumes).
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.models.alexnet import build_alexnet
+from flexflow_tpu.native import ffsim_search, ffsim_simulate
+from flexflow_tpu.parallel.strategy import AXES, ParallelConfig, StrategyStore
+from flexflow_tpu.search import search_strategy, simulate_strategy
+from flexflow_tpu.search.problem import build_virtual_plan, shard_devices
+
+
+def _problem(lines):
+    return "\n".join(lines) + "\n"
+
+
+class TestSimulatorSemantics:
+    def test_single_op_compute_only(self):
+        # One op, 2 shards of 5us each on distinct devices -> 5us.
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 1",
+            "op 0 1 solo",
+            "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "nedges 0",
+        ])
+        assert ffsim_simulate(p, [0]) == pytest.approx(5.0)
+
+    def test_sync_cost_added_after_op(self):
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 1",
+            "op 0 1 solo",
+            "cfg 2 1 1 1 1 5.0 3.0 0 1",
+            "nedges 0",
+        ])
+        assert ffsim_simulate(p, [0]) == pytest.approx(8.0)
+
+    def test_resharding_comm_hand_schedule(self):
+        # op0 n-split rows of an (8,4) f32 tensor; op1 c-splits columns
+        # and broadcasts rows.  Each cross-device transfer moves half a
+        # source shard: 8 elems * 4B / bw 10 + 1us latency = 4.2us.
+        # Comm starts when the producer shard finishes (5us); consumer
+        # shards start at 9.2 and run 7us -> makespan 16.2.
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 2",
+            "op 0 1 producer",
+            "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "op 1 1 consumer",
+            "cfg 1 2 1 1 1 7.0 0.0 0 1",
+            "nedges 1",
+            "edge 0 1 4 2 8 4 0 -1 -1 1",
+        ])
+        assert ffsim_simulate(p, [0, 0]) == pytest.approx(16.2)
+
+    def test_same_device_transfer_is_free(self):
+        # Same split on both ops, same placement: no comm, pure chain.
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 2",
+            "op 0 1 a",
+            "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "op 1 1 b",
+            "cfg 2 1 1 1 1 7.0 0.0 0 1",
+            "nedges 1",
+            "edge 0 1 4 2 8 4 0 -1 0 -1",
+        ])
+        assert ffsim_simulate(p, [0, 0]) == pytest.approx(12.0)
+
+    def test_search_picks_obvious_winner(self):
+        # Config 1 halves the time with no comm downside; MCMC must
+        # find it and report the config-0 start as the baseline.
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 1",
+            "op 0 2 solo",
+            "cfg 1 1 1 1 1 10.0 0.0 0",
+            "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "nedges 0",
+        ])
+        res = ffsim_search(p, iters=50, seed=0, alpha=5.0)
+        assert res["init_us"] == pytest.approx(10.0)
+        assert res["best_us"] == pytest.approx(5.0)
+        assert res["assign"] == [1]
+
+    def test_bad_problem_raises(self):
+        with pytest.raises(ValueError):
+            ffsim_simulate("not a problem", [0])
+
+
+class TestShardDevices:
+    def test_data_parallel_covers_all_devices(self):
+        plan = build_virtual_plan(8)
+        assert shard_devices(plan, ParallelConfig(n=8)) == list(range(8))
+
+    def test_hybrid_covers_all_devices_once(self):
+        plan = build_virtual_plan(8)
+        devs = shard_devices(plan, ParallelConfig(n=2, c=4))
+        assert sorted(devs) == list(range(8))
+
+    def test_partial_split_replicates_on_first_coords(self):
+        plan = build_virtual_plan(8)
+        devs = shard_devices(plan, ParallelConfig(n=2))
+        assert len(devs) == 2
+        assert len(set(devs)) == 2
+
+    def test_explicit_device_ids_win(self):
+        plan = build_virtual_plan(8)
+        pc = ParallelConfig(c=4, device_ids=(3, 1, 2, 0))
+        assert shard_devices(plan, pc) == [3, 1, 2, 0]
+
+
+class TestEndToEndSearch:
+    @pytest.fixture(scope="class")
+    def alexnet(self):
+        return build_alexnet(batch_size=64, image_size=229, num_classes=1000)
+
+    def test_search_beats_or_matches_dp(self, alexnet):
+        res = search_strategy(alexnet, num_devices=8, iters=3000, seed=0)
+        assert res.best_time_us <= res.dp_time_us
+        # AlexNet's FC gradient sync makes DP clearly sub-optimal — the
+        # ICML'18 result the search must reproduce in simulation.
+        assert res.speedup > 1.5
+        assert set(res.assignment) == {op.name for op in alexnet.layers}
+        for pc in res.assignment.values():
+            assert pc.num_parts <= 8
+
+    def test_store_roundtrip_and_simulate(self, alexnet, tmp_path):
+        res = search_strategy(alexnet, num_devices=8, iters=2000, seed=1)
+        path = tmp_path / "strategy.json"
+        res.store.save(str(path))
+        loaded = StrategyStore.load(str(path))
+        t = simulate_strategy(alexnet, loaded, 8)
+        assert t == pytest.approx(res.best_time_us, rel=1e-6)
+
+    def test_dp_store_matches_reported_baseline(self, alexnet):
+        res = search_strategy(alexnet, num_devices=8, iters=100, seed=0)
+        # A store with no entries = the runtime's DP fallback; candidate
+        # 0 of every op is the same config, so times must agree.
+        dp_t = simulate_strategy(alexnet, StrategyStore.data_parallel(8), 8)
+        assert dp_t == pytest.approx(res.dp_time_us, rel=1e-6)
+
+    def test_searched_strategy_runs_on_executor(self, alexnet):
+        """The emitted table must be consumable by the runtime: compile
+        and run one train step under the searched strategy on the
+        8-device CPU mesh."""
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.models.alexnet import build_alexnet as _b
+        from flexflow_tpu.optim import SGDOptimizer
+        from flexflow_tpu.runtime.executor import Executor
+
+        ff = _b(batch_size=8, image_size=67, num_classes=10)
+        res = search_strategy(ff, num_devices=8, iters=500, seed=0)
+        ex = Executor(ff, strategy=res.store, optimizer=SGDOptimizer(lr=0.01))
+        params, opt_state, state = ex.init()
+        rng = np.random.default_rng(0)
+        batch = ex.shard_batch({
+            "image": rng.standard_normal((8, 67, 67, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(8,)).astype(np.int32),
+        })
+        params, opt_state, state, metrics = ex.train_step(
+            params, opt_state, state, batch
+        )
+        jax.block_until_ready(metrics)
+        assert np.isfinite(float(metrics["train_loss"]))
