@@ -93,6 +93,7 @@ bool parse_problem(const char* text, Problem& p, std::string& err) {
       OpT op;
       in >> id >> ncfg >> op.name;
       if (id != (int)p.ops.size()) { err = "op ids must be dense"; return false; }
+      if (ncfg < 1) { err = "ops need at least one config"; return false; }
       op.cfgs.reserve(ncfg);
       for (int c = 0; c < ncfg; ++c) {
         std::string kw;
@@ -133,6 +134,13 @@ bool parse_problem(const char* text, Problem& p, std::string& err) {
       if (e.src < 0 || e.dst < 0 || e.src >= e.dst) {
         err = "edges must go forward (src < dst)";
         return false;
+      }
+      for (int d = 0; d < nd; ++d) {
+        if (e.src_axis[d] < -1 || e.src_axis[d] >= kAxes ||
+            e.dst_axis[d] < -1 || e.dst_axis[d] >= kAxes) {
+          err = "edge axis index out of range";
+          return false;
+        }
       }
       p.edges.push_back(std::move(e));
     } else {

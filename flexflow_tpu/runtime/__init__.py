@@ -1,4 +1,13 @@
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
 from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.profiler import profile_ops, report, trace
 from flexflow_tpu.runtime.trainer import Trainer
 
-__all__ = ["Executor", "Trainer"]
+__all__ = [
+    "CheckpointManager",
+    "Executor",
+    "Trainer",
+    "profile_ops",
+    "report",
+    "trace",
+]

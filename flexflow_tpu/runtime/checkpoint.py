@@ -1,0 +1,131 @@
+"""Checkpoint / resume.
+
+The reference has NO save/load path — parameters live only in Legion
+regions and die with the process (SURVEY.md §5; HDF5 is used only to
+*read* datasets, ``dlrm.cc:230+``).  This subsystem is therefore built
+from scratch for the TPU rebuild: orbax-backed, sharding-aware
+(arrays restore directly into the restoring executor's mesh/strategy
+shardings, so a run checkpointed under one parallelization strategy
+can resume under another — the checkpoint is strategy-portable the
+way Legion regions never were), with retention and latest-step
+discovery for crash-resume.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+
+def _ocp():
+    """Lazy orbax import: checkpointing is optional — training without
+    it must not require orbax to be installed."""
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class CheckpointManager:
+    """Save/restore (params, opt_state, state, step) bundles.
+
+    Usage::
+
+        ckpt = CheckpointManager("/path/ckpts", max_to_keep=3)
+        ckpt.save(step, params, opt_state, state)
+        ...
+        step, params, opt_state, state = ckpt.restore(
+            templates=(params0, opt0, state0))  # from Executor.init()
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        ocp = _ocp()
+        # Keep remote URLs (gs://, s3://...) untouched; orbax requires
+        # local paths to be absolute.
+        self.directory = (
+            directory if "://" in directory else os.path.abspath(directory)
+        )
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    # -- write -------------------------------------------------------------
+
+    def save(self, step: int, params, opt_state, state, force: bool = False) -> bool:
+        """Persist one training snapshot.  Empty subtrees (momentum-less
+        opt_state, stateless models) are simply omitted — orbax rejects
+        empty items — and reconstituted as None/{} on restore."""
+        ocp = _ocp()
+        items: Dict[str, Any] = {"params": ocp.args.StandardSave(params)}
+        if opt_state is not None and jax.tree.leaves(opt_state):
+            items["opt_state"] = ocp.args.StandardSave(opt_state)
+        if state and jax.tree.leaves(state):
+            items["state"] = ocp.args.StandardSave(state)
+        saved = self._mgr.save(step, args=ocp.args.Composite(**items), force=force)
+        self._mgr.wait_until_finished()
+        return saved
+
+    # -- read --------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def restore(
+        self,
+        templates: Tuple[Any, Any, Any],
+        step: Optional[int] = None,
+    ) -> Tuple[int, Any, Any, Any]:
+        """Restore ``(step, params, opt_state, state)``.
+
+        ``templates`` is a fresh ``Executor.init()`` result: restored
+        arrays adopt the templates' shapes/dtypes/shardings, which is
+        what makes restore work across a *different* mesh or strategy
+        than the one that saved (orbax reshards on load).
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}"
+                )
+        ocp = _ocp()
+        t_params, t_opt, t_state = templates
+        # Which items this snapshot contains.  Each Composite item is a
+        # subdirectory of the step dir; enumerate through orbax's path
+        # abstraction (epath) so remote stores (gs://) work too.
+        from etils import epath
+
+        step_dir = epath.Path(self._mgr.directory) / str(step)
+        present = {p.name for p in step_dir.iterdir() if p.is_dir()}
+        items: Dict[str, Any] = {"params": ocp.args.StandardRestore(t_params)}
+        if "opt_state" in present:
+            items["opt_state"] = ocp.args.StandardRestore(t_opt)
+        if "state" in present:
+            items["state"] = ocp.args.StandardRestore(t_state)
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        opt_state = restored["opt_state"] if "opt_state" in present else None
+        state = restored["state"] if "state" in present else {}
+        return step, restored["params"], opt_state, state
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,130 @@
+"""Profiling / tracing.
+
+The reference's profiling is (1) per-task wall-clock via cudaEvent
+pairs gated by ``--profiling`` (``conv_2d.cu:515-546``,
+``linear.cu:296-332``), (2) whole-run timing between execution fences
+(``dlrm.cc:159-163``), and (3) Legion trace capture of the step
+(``dlrm.cc:151-156``).  TPU equivalents here:
+
+- ``profile_ops``: per-op forward wall-clock — each op jitted and timed
+  in isolation with a host-readback fence (cudaEvent analogue; the
+  numbers also serve as a *measured* cost table for the strategy
+  search, replacing the reference's cuDNN microbenchmarks,
+  ``scripts/cnn.h:204+``).
+- ``trace``: ``jax.profiler`` TensorBoard trace of the real fused step
+  (what XLA actually runs; per-op eager times do not see fusion).
+- Whole-run timing lives in ``Trainer.fit`` (reference formulas).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from flexflow_tpu.runtime.executor import Executor
+
+
+@dataclasses.dataclass
+class OpProfile:
+    name: str
+    op_type: str
+    time_us: float
+    output_shapes: List[tuple]
+
+    def __str__(self):
+        shapes = ", ".join(str(s) for s in self.output_shapes)
+        return f"{self.name:28s} {self.op_type:12s} {self.time_us:10.1f} us  -> {shapes}"
+
+
+def profile_ops(
+    ex: Executor,
+    params: Any,
+    state: Any,
+    batch: Dict[str, Any],
+    reps: int = 5,
+    warmup: int = 2,
+) -> List[OpProfile]:
+    """Time every op's forward in isolation (compiled, fenced).
+
+    Mirrors the reference's per-task event timing under ``--profiling``;
+    each op runs with its real sharded inputs (produced by the previous
+    ops) so the times include the op's own collectives.
+    """
+    env: Dict[str, jax.Array] = {}
+    for t in ex.model.input_tensors:
+        env[t.name] = jax.device_put(batch[t.name], ex.input_sharding(t))
+    profiles: List[OpProfile] = []
+    for op in ex.model.layers:
+        op.bind_mesh(ex.plan, ex._pc(op))
+        xs = [env[t.name] for t in op.inputs]
+        p = params.get(op.name, {})
+        s = state.get(op.name, {})
+
+        def run(p, xs, s, _op=op):
+            result, _ = _op.forward(p, xs, s, training=False)
+            if _op.is_loss:
+                _, _, ys = result
+            else:
+                ys = result
+            return ys
+
+        fn = jax.jit(run)
+        ys = fn(p, xs, s)
+        for _ in range(warmup):
+            ys = fn(p, xs, s)
+        jax.device_get(jax.tree.leaves(ys)[0].ravel()[:1])  # fence
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ys = fn(p, xs, s)
+        jax.device_get(jax.tree.leaves(ys)[0].ravel()[:1])
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        for t, y in zip(op.outputs, ys):
+            env[t.name] = y
+        profiles.append(
+            OpProfile(
+                name=op.name,
+                op_type=type(op).__name__,
+                time_us=dt,
+                output_shapes=[tuple(t.shape) for t in op.outputs],
+            )
+        )
+    return profiles
+
+
+def report(profiles: List[OpProfile]) -> str:
+    total = sum(p.time_us for p in profiles)
+    lines = [str(p) for p in profiles]
+    lines.append(f"{'TOTAL (unfused sum)':28s} {'':12s} {total:10.1f} us")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a TensorBoard/XProf trace of everything run inside the
+    block (the jitted step as XLA executes it — fusions, collectives,
+    real device timelines).  View with ``tensorboard --logdir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def measured_cost_table(
+    ex: Executor,
+    params: Any,
+    state: Any,
+    batch: Dict[str, Any],
+    reps: int = 5,
+) -> Dict[str, float]:
+    """Per-op measured forward time (us) keyed by op name — pluggable
+    into the strategy search as a measured cost model (the reference
+    feeds ``measure_*_time`` results into its simulator the same way,
+    ``simulator.cc:1420-1440``)."""
+    return {
+        p.name: p.time_us for p in profile_ops(ex, params, state, batch, reps=reps)
+    }

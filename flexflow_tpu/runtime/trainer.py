@@ -48,11 +48,26 @@ class Trainer:
         batches: Optional[Iterable[Dict[str, Any]]] = None,
         warmup: int = 1,
         log_every: int = 0,
+        checkpoint=None,
+        save_every: int = 0,
+        resume: bool = True,
     ) -> Dict[str, float]:
         """Run ``iterations`` steps; returns throughput stats computed
-        with the reference formula."""
+        with the reference formula.
+
+        With ``checkpoint`` (a ``CheckpointManager``) the run resumes
+        from the latest saved step when ``resume`` and saves every
+        ``save_every`` steps plus once at the end — the crash-recovery
+        subsystem the reference lacks entirely (SURVEY.md §5)."""
         ex = self.ex
         params, opt_state, state = ex.init()
+        start_step = 0
+        if checkpoint is not None and resume:
+            if checkpoint.latest_step() is not None:
+                start_step, params, opt_state, state = checkpoint.restore(
+                    templates=(params, opt_state, state)
+                )
+                print(f"resumed from step {start_step}")
         if batches is None:
             fixed = self.synthetic_batch()
             batches = iter(lambda: fixed, None)  # infinite
@@ -64,14 +79,19 @@ class Trainer:
 
         # Warmup (compile) outside the timed region — the reference's
         # init_layers()+first-iteration cuDNN algo search equivalent.
+        # Warmup steps are REAL optimizer updates (train_step donates its
+        # inputs, so they can't be discarded); count them in the step
+        # numbering so checkpoint steps always equal applied updates.
         m = None
         for _ in range(warmup):
             batch = next(batches)
             params, opt_state, state, m = ex.train_step(params, opt_state, state, batch)
+        start_step += warmup
         if m is not None:
             jax.device_get(m)  # host readback: the only reliable fence on the relay
 
         assert iterations > 0, "fit() needs at least one iteration"
+        ckpt_s = 0.0  # checkpoint I/O time, excluded from throughput
         start = time.perf_counter()
         for it in range(iterations):
             batch = next(batches)
@@ -79,12 +99,25 @@ class Trainer:
             if log_every and (it + 1) % log_every == 0:
                 self.metrics.update(jax.device_get(m))
                 print(f"iter {it+1}: {self.metrics.report()}")
+            if checkpoint is not None and save_every and (it + 1) % save_every == 0:
+                jax.device_get(m)  # fence: don't bill queued compute to I/O
+                t0 = time.perf_counter()
+                checkpoint.save(start_step + it + 1, params, opt_state, state)
+                ckpt_s += time.perf_counter() - t0
         # The execution fence (dlrm.cc:159-162): a host readback of the
         # final step's metrics; the step chain serializes through params.
         final_m = jax.device_get(m)
-        elapsed = time.perf_counter() - start
+        elapsed = time.perf_counter() - start - ckpt_s
 
         self.metrics.update(final_m)
+        if checkpoint is not None:
+            checkpoint.save(start_step + iterations, params, opt_state, state)
+        if ex.config.profiling:
+            # --profiling: per-op breakdown, the reference's per-task
+            # cudaEvent timings (conv_2d.cu:515-546).
+            from flexflow_tpu.runtime.profiler import profile_ops, report
+
+            print(report(profile_ops(ex, params, state, batch)))
         batch_size = ex.model.input_tensors[0].shape[0]
         throughput = iterations * batch_size / elapsed
         # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).

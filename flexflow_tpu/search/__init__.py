@@ -63,11 +63,18 @@ def search_strategy(
     alpha: float = 5.0,
     device_model: Optional[DeviceModel] = None,
     max_candidates: int = 64,
+    measured_costs: Optional[dict] = None,
 ) -> SearchResult:
     """MCMC-search a per-op strategy table for ``model`` on
-    ``num_devices`` devices.  Runs entirely offline (no TPU needed)."""
+    ``num_devices`` devices.  Runs entirely offline (no TPU needed).
+
+    ``measured_costs``: per-op measured forward times from
+    ``flexflow_tpu.runtime.profiler.measured_cost_table`` replace the
+    roofline compute estimates (measured-microbenchmark mode)."""
     plan = build_virtual_plan(num_devices)
-    prob = build_problem(model, plan, device_model, max_candidates)
+    prob = build_problem(
+        model, plan, device_model, max_candidates, measured_costs=measured_costs
+    )
     res = ffsim_search(prob.text, iters, seed, alpha)
     table: Dict[str, ParallelConfig] = {}
     for op, cands, idx in zip(prob.ops, prob.candidates, res["assign"]):

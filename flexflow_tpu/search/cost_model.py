@@ -3,7 +3,9 @@
 The reference measures per-op, per-degree compute times with live
 cuDNN/cuBLAS microbenchmarks (reference: ``scripts/cnn.h:204+``,
 ``measure_conv2d_time`` et al.) and feeds them to the simulator.  On
-TPU the equivalent measured mode exists too (``measure.py``), but the
+TPU the equivalent measured mode is
+``flexflow_tpu.runtime.profiler.measured_cost_table`` (pass its result
+as ``measured_costs`` to ``search_strategy``), but the
 default is a roofline model: an op's time is
 ``max(flops / MXU_rate, bytes / HBM_rate)`` plus a fixed per-task
 overhead — the standard TPU performance mental model (MXU-bound vs

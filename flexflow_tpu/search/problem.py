@@ -132,8 +132,15 @@ def build_problem(
     plan: MeshPlan,
     dev: Optional[DeviceModel] = None,
     max_candidates: int = 64,
+    measured_costs: Optional[Dict[str, float]] = None,
 ) -> SearchProblem:
+    """``measured_costs`` (op name → measured full-op forward time, us;
+    from ``runtime.profiler.measured_cost_table``) overrides the
+    roofline compute estimate per op — the reference's measured-
+    microbenchmark mode (``simulator.cc:1420-1440``).  Comm and sync
+    stay model-derived."""
     dev = dev or DeviceModel()
+    measured_costs = measured_costs or {}
     ops = list(model.layers)
     op_index = {op.name: i for i, op in enumerate(ops)}
     lines: List[str] = [
@@ -151,9 +158,15 @@ def build_problem(
         cost = op_cost(op)
         name = op.name.replace(" ", "_")
         lines.append(f"op {i} {len(cands)} {name}")
+        measured = measured_costs.get(op.name)
         for pc in cands:
             degrees = {a: pc.degree(a) for a in AXES}
-            c_us = shard_cost_us(cost, pc.num_parts, dev)
+            if measured is not None:
+                from flexflow_tpu.search.cost_model import FWD_BWD_FACTOR
+
+                c_us = dev.task_overhead_us + measured * FWD_BWD_FACTOR / pc.num_parts
+            else:
+                c_us = shard_cost_us(cost, pc.num_parts, dev)
             s_us = sync_cost_us(cost, degrees, dev)
             devs = shard_devices(plan, pc)
             degs = " ".join(str(pc.degree(a)) for a in AXES)

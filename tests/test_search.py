@@ -100,6 +100,28 @@ class TestSimulatorSemantics:
         with pytest.raises(ValueError):
             ffsim_simulate("not a problem", [0])
 
+    def test_zero_config_op_raises_not_crashes(self):
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 1", "op 0 0 empty", "nedges 0",
+        ])
+        with pytest.raises(ValueError):
+            ffsim_search(p, iters=10, seed=0, alpha=5.0)
+
+    def test_bad_edge_axis_raises_not_crashes(self):
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 2",
+            "op 0 1 a", "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "op 1 1 b", "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "nedges 1",
+            "edge 0 1 4 1 8 7 0",  # src axis 7 out of range
+        ])
+        with pytest.raises(ValueError):
+            ffsim_simulate(p, [0, 0])
+
 
 class TestShardDevices:
     def test_data_parallel_covers_all_devices(self):
@@ -152,6 +174,18 @@ class TestEndToEndSearch:
         # 0 of every op is the same config, so times must agree.
         dp_t = simulate_strategy(alexnet, StrategyStore.data_parallel(8), 8)
         assert dp_t == pytest.approx(res.dp_time_us, rel=1e-6)
+
+    def test_measured_costs_override_roofline(self, alexnet):
+        """Per-op measured times (runtime.profiler.measured_cost_table
+        format) replace the roofline estimate and change the simulated
+        baseline accordingly."""
+        flat = {op.name: 1000.0 for op in alexnet.layers}
+        res = search_strategy(
+            alexnet, num_devices=8, iters=100, seed=0, measured_costs=flat
+        )
+        res2 = search_strategy(alexnet, num_devices=8, iters=100, seed=0)
+        assert res.dp_time_us != pytest.approx(res2.dp_time_us)
+        assert res.best_time_us <= res.dp_time_us
 
     def test_searched_strategy_runs_on_executor(self, alexnet):
         """The emitted table must be consumable by the runtime: compile
