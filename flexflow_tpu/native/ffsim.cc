@@ -79,6 +79,10 @@ bool parse_problem(const char* text, Problem& p, std::string& err) {
   while (in >> tok) {
     if (tok == "ndevices") {
       in >> p.ndev;
+      if (!in || p.ndev < 1 || p.ndev > 1 << 20) {
+        err = "ndevices out of range";
+        return false;
+      }
     } else if (tok == "devices_per_node") {
       in >> p.dev_per_node;
     } else if (tok == "bw_intra") {
@@ -87,26 +91,37 @@ bool parse_problem(const char* text, Problem& p, std::string& err) {
       in >> p.bw_inter;
     } else if (tok == "nops") {
       in >> nops;
+      if (!in || nops < 0 || nops > 1 << 20) { err = "nops out of range"; return false; }
       p.ops.reserve(nops);
     } else if (tok == "op") {
       int id, ncfg;
       OpT op;
       in >> id >> ncfg >> op.name;
+      if (!in) { err = "truncated op line"; return false; }
       if (id != (int)p.ops.size()) { err = "op ids must be dense"; return false; }
-      if (ncfg < 1) { err = "ops need at least one config"; return false; }
+      if (ncfg < 1 || ncfg > 1 << 20) { err = "ncfg out of range"; return false; }
       op.cfgs.reserve(ncfg);
       for (int c = 0; c < ncfg; ++c) {
         std::string kw;
         in >> kw;
         if (kw != "cfg") { err = "expected cfg"; return false; }
         Cfg cfg;
-        cfg.parts = 1;
+        long long parts = 1;
         for (int a = 0; a < kAxes; ++a) {
           in >> cfg.deg[a];
-          if (cfg.deg[a] < 1) { err = "degrees must be >= 1"; return false; }
-          cfg.parts *= cfg.deg[a];
+          if (!in || cfg.deg[a] < 1 || cfg.deg[a] > p.ndev) {
+            err = "degree out of range";
+            return false;
+          }
+          parts *= cfg.deg[a];
         }
+        if (parts < 1 || parts > p.ndev) {
+          err = "config shard count exceeds ndevices";
+          return false;
+        }
+        cfg.parts = (int)parts;
         in >> cfg.cost_us >> cfg.sync_us;
+        if (!in) { err = "truncated cfg line"; return false; }
         cfg.devs.resize(cfg.parts);
         for (int s = 0; s < cfg.parts; ++s) {
           in >> cfg.devs[s];
@@ -120,20 +135,33 @@ bool parse_problem(const char* text, Problem& p, std::string& err) {
       p.ops.push_back(std::move(op));
     } else if (tok == "nedges") {
       in >> nedges;
+      if (!in || nedges < 0 || nedges > 1 << 22) {
+        err = "nedges out of range";
+        return false;
+      }
       p.edges.reserve(nedges);
     } else if (tok == "edge") {
       EdgeT e;
       int nd;
       in >> e.src >> e.dst >> e.bytes_per_elem >> nd;
+      if (!in || nd < 1 || nd > 16) { err = "edge rank out of range"; return false; }
+      if (e.bytes_per_elem < 1 || e.bytes_per_elem > 32) {
+        err = "edge bytes_per_elem out of range";
+        return false;
+      }
       e.dims.resize(nd);
       e.src_axis.resize(nd);
       e.dst_axis.resize(nd);
       for (int d = 0; d < nd; ++d) in >> e.dims[d];
       for (int d = 0; d < nd; ++d) in >> e.src_axis[d];
       for (int d = 0; d < nd; ++d) in >> e.dst_axis[d];
+      if (!in) { err = "truncated edge line"; return false; }
       if (e.src < 0 || e.dst < 0 || e.src >= e.dst) {
         err = "edges must go forward (src < dst)";
         return false;
+      }
+      for (int d = 0; d < nd; ++d) {
+        if (e.dims[d] < 1) { err = "edge dims must be >= 1"; return false; }
       }
       for (int d = 0; d < nd; ++d) {
         if (e.src_axis[d] < -1 || e.src_axis[d] >= kAxes ||

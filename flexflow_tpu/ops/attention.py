@@ -28,9 +28,23 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from flexflow_tpu.initializers import GlorotUniform, OnesInitializer, ZeroInitializer
+from flexflow_tpu.ops import pallas_kernels
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 
 _NEG_INF = -1e30
+
+
+def _merge_lse(o1, lse1, o2, lse2):
+    """Combine two flash partial results (o_i, lse_i) -> (o, lse).
+
+    o_i: (b, h, t, hd) f32; lse_i: (b, h, t) f32 (may be -inf where a
+    chunk contributed nothing).  The standard streaming-softmax merge
+    used between ring steps.
+    """
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return o1 * w1 + o2 * w2, lse
 
 
 class LayerNorm(Op):
@@ -175,15 +189,53 @@ class MultiHeadAttention(Op):
 
     def _attend_dense(self, q, k, v, dtype):
         q, k, v = map(self._split_heads, (q, k, v))
+        causal = self.attrs["causal"]
+        out = self._flash_dense(q, k, v)
+        if out is not None:
+            return self._merge_heads(out, dtype)
         scale = 1.0 / math.sqrt(q.shape[-1])
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        if self.attrs["causal"]:
+        if causal:
             t = scores.shape[-1]
             mask = jnp.tril(jnp.ones((t, t), bool))
             scores = jnp.where(mask[None, None], scores, _NEG_INF)
         attn = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
         return self._merge_heads(out, dtype)
+
+    def _flash_dense(self, q, k, v):
+        """Run the Pallas flash kernel on the dense path, or None to
+        fall back to the einsum formulation.
+
+        A ``pallas_call`` is a Mosaic custom call with no GSPMD
+        partitioning rule, so under a multi-device mesh it must be
+        wrapped in ``shard_map`` over the axes the strategy shards
+        (batch 'n', heads via the projections' 'c' tag) — otherwise
+        XLA would all-gather q/k/v onto every device.
+        """
+        causal = self.attrs["causal"]
+        plan = getattr(self, "_plan", None)
+        if plan is None or plan.num_devices == 1:
+            if pallas_kernels.flash_supported(q.shape, q.dtype):
+                return pallas_kernels.flash_attention(q, k, v, causal)
+            return None
+        (n_entry, n_deg), (c_entry, c_deg) = plan.local_degrees(
+            self._pc, "n", "c"
+        )
+        b, h, t, hd = q.shape
+        if b % n_deg or h % c_deg:
+            return None
+        local_shape = (b // n_deg, h // c_deg, t, hd)
+        if not pallas_kernels.flash_supported(local_shape, q.dtype):
+            return None
+        spec = PartitionSpec(n_entry, c_entry, None, None)
+        return jax.shard_map(
+            lambda ql, kl, vl: pallas_kernels.flash_attention(ql, kl, vl, causal),
+            mesh=plan.mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
 
     # -- ring attention (context parallelism) ------------------------------
 
@@ -201,6 +253,9 @@ class MultiHeadAttention(Op):
             qh = self._split_heads(q)
             kh = self._split_heads(k)
             vh = self._split_heads(v)
+            use_flash = pallas_kernels.flash_supported(qh.shape, qh.dtype)
+            if use_flash:
+                return self._ring_flash(qh, kh, vh, s_idx, S, s_entry, dtype)
             b, h, t, hd = qh.shape
             m = jnp.full((b, h, t), _NEG_INF, jnp.float32)
             denom = jnp.zeros((b, h, t), jnp.float32)
@@ -234,3 +289,44 @@ class MultiHeadAttention(Op):
             out_specs=spec,
             check_vma=False,
         )(q, k, v)
+
+    def _ring_flash(self, qh, kh, vh, s_idx, S, s_entry, dtype):
+        """Ring attention with the Pallas flash kernel per chunk.
+
+        Step j computes this device's queries against the K/V chunk of
+        device (s_idx - j) mod S with a local flash call, then merges
+        the (out, lse) partials with the streaming-softmax combine.
+        Chunk-level causality is exact: the own chunk (j=0) uses the
+        in-kernel causal mask; rotated chunks are either fully visible
+        (k_idx < s_idx) or discarded by forcing their lse to -inf.
+        """
+        causal = self.attrs["causal"]
+        ring = [(i, (i + 1) % S) for i in range(S)]
+        o, lse = pallas_kernels.flash_attention_lse(qh, kh, vh, causal)
+        o = o.astype(jnp.float32)
+        k_cur, v_cur = kh, vh
+        for j in range(1, S):
+            k_cur = lax.ppermute(k_cur, tuple(s_entry), ring)
+            v_cur = lax.ppermute(v_cur, tuple(s_entry), ring)
+
+            def attend(kc=k_cur, vc=v_cur):
+                o_j, lse_j = pallas_kernels.flash_attention_lse(qh, kc, vc, False)
+                return o_j.astype(jnp.float32), lse_j
+
+            if causal:
+                # Chunk (s_idx - j) mod S is visible iff it precedes
+                # this device's chunk; skip the kernel (fwd AND bwd)
+                # entirely on devices where it is not.  The ppermute
+                # still runs, so the ring stays in lockstep.
+                def skip():
+                    return (
+                        jnp.zeros_like(o),
+                        jnp.full(o.shape[:-1], _NEG_INF, jnp.float32),
+                    )
+
+                visible = ((s_idx - j) % S) < s_idx
+                o_j, lse_j = lax.cond(visible, attend, skip)
+            else:
+                o_j, lse_j = attend()
+            o, lse = _merge_lse(o, lse, o_j, lse_j)
+        return self._merge_heads(o, dtype)

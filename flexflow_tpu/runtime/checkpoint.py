@@ -104,13 +104,10 @@ class CheckpointManager:
                 )
         ocp = _ocp()
         t_params, t_opt, t_state = templates
-        # Which items this snapshot contains.  Each Composite item is a
-        # subdirectory of the step dir; enumerate through orbax's path
-        # abstraction (epath) so remote stores (gs://) work too.
-        from etils import epath
-
-        step_dir = epath.Path(self._mgr.directory) / str(step)
-        present = {p.name for p in step_dir.iterdir() if p.is_dir()}
+        # Which items this snapshot contains — through the same orbax
+        # abstraction that wrote them (robust to layout/naming options,
+        # unlike listing the step directory ourselves).
+        present = set(self._mgr.item_metadata(step).keys())
         items: Dict[str, Any] = {"params": ocp.args.StandardRestore(t_params)}
         if "opt_state" in present:
             items["opt_state"] = ocp.args.StandardRestore(t_opt)

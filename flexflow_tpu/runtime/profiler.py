@@ -121,10 +121,19 @@ def measured_cost_table(
     batch: Dict[str, Any],
     reps: int = 5,
 ) -> Dict[str, float]:
-    """Per-op measured forward time (us) keyed by op name — pluggable
-    into the strategy search as a measured cost model (the reference
-    feeds ``measure_*_time`` results into its simulator the same way,
-    ``simulator.cc:1420-1440``)."""
+    """Per-op measured *whole-op* forward time (us) keyed by op name —
+    pluggable into the strategy search as a measured cost model (the
+    reference feeds ``measure_*_time`` results into its simulator the
+    same way, ``simulator.cc:1420-1440``).
+
+    ``profile_ops`` times each op under the executor's own strategy,
+    i.e. per-shard; the search divides by each candidate's shard count,
+    so the table normalizes back to whole-op time by multiplying with
+    the profiled strategy's shard count (exact on a single-device
+    executor, a collective-inclusive approximation on a parallel one).
+    """
+    profiles = profile_ops(ex, params, state, batch, reps=reps)
     return {
-        p.name: p.time_us for p in profile_ops(ex, params, state, batch, reps=reps)
+        op.name: p.time_us * ex._pc(op).num_parts
+        for op, p in zip(ex.model.layers, profiles)
     }

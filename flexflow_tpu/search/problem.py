@@ -21,6 +21,7 @@ from flexflow_tpu.ops import Op
 from flexflow_tpu.parallel.mesh import InfeasibleStrategyError, MeshPlan, _prime_factors
 from flexflow_tpu.parallel.strategy import AXES, ParallelConfig
 from flexflow_tpu.search.cost_model import (
+    FWD_BWD_FACTOR,
     DeviceModel,
     contracted_input_dims,
     op_cost,
@@ -162,8 +163,6 @@ def build_problem(
         for pc in cands:
             degrees = {a: pc.degree(a) for a in AXES}
             if measured is not None:
-                from flexflow_tpu.search.cost_model import FWD_BWD_FACTOR
-
                 c_us = dev.task_overhead_us + measured * FWD_BWD_FACTOR / pc.num_parts
             else:
                 c_us = shard_cost_us(cost, pc.num_parts, dev)

@@ -148,3 +148,56 @@ def test_transformer_lm_trains_hybrid(rng):
         losses.append(float(m["train_loss"]))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_dense(rng, causal):
+    """Ring path with the Pallas per-chunk flash kernel (chunks large
+    enough to clear flash_supported) must match single-device dense."""
+    ff = _mha_model(batch=2, seq=64, d=16, heads=2, causal=causal)
+    ex1 = Executor(ff, devices=jax.devices()[:1])
+    params, _, state = ex1.init(seed=0)
+    batch = _batch(rng, batch=2, seq=64, d=16)
+    _, outs1 = ex1.forward_step(params, state, batch)
+    ex8 = Executor(ff, strategy=StrategyStore(8, {"attn": ParallelConfig(s=2)}))
+    _, outs8 = ex8.forward_step(jax.tree.map(np.asarray, params), state, batch)
+    np.testing.assert_allclose(
+        np.asarray(outs1["attn:out"]), np.asarray(outs8["attn:out"]),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_ring_flash_grads_match_dense(rng):
+    ff = _mha_model(batch=2, seq=64, d=16, heads=2, causal=True)
+    opt = SGDOptimizer(lr=0.1, momentum=0.9)
+    batch = _batch(rng, batch=2, seq=64, d=16)
+    ex1 = Executor(ff, optimizer=opt, devices=jax.devices()[:1])
+    params, opt_state, state = ex1.init(seed=0)
+    p1, *_ = ex1.train_step(jax.tree.map(jnp.copy, params),
+                            jax.tree.map(jnp.copy, opt_state), state, batch)
+    ex8 = Executor(ff, optimizer=opt,
+                   strategy=StrategyStore(8, {"attn": ParallelConfig(n=2, s=2)}))
+    p8, *_ = ex8.train_step(jax.tree.map(np.asarray, params),
+                            jax.tree.map(np.asarray, opt_state), state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        p1, p8,
+    )
+
+
+def test_dense_flash_sharded_matches_single_device(rng):
+    """Dense flash on a multi-device mesh runs under shard_map (batch n
+    x heads c) and must match the single-device result."""
+    ff = _mha_model(batch=2, seq=64, d=16, heads=2, causal=True)
+    ex1 = Executor(ff, devices=jax.devices()[:1])
+    params, _, state = ex1.init(seed=0)
+    batch = _batch(rng, batch=2, seq=64, d=16)
+    _, outs1 = ex1.forward_step(params, state, batch)
+    ex8 = Executor(ff, strategy=StrategyStore(8, {"attn": ParallelConfig(n=2, c=2)}))
+    _, outs8 = ex8.forward_step(jax.tree.map(np.asarray, params), state, batch)
+    np.testing.assert_allclose(
+        np.asarray(outs1["attn:out"]), np.asarray(outs8["attn:out"]),
+        rtol=2e-5, atol=2e-5,
+    )

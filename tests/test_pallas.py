@@ -1,0 +1,111 @@
+"""Pallas flash-attention kernel vs. the naive softmax oracle.
+
+Runs the identical kernel code the TPU compiles, under the Pallas
+interpreter on the CPU test mesh (SURVEY.md §4: jax autodiff/naive
+math as the numeric oracle for every hand kernel).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.ops import pallas_kernels as pk
+
+
+def naive_attention(q, k, v, causal):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = scores.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    attn = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+
+
+def make_qkv(rng, b=2, h=2, t=64, hd=16):
+    shape = (b, h, t, hd)
+    return tuple(
+        jnp.asarray(rng.standard_normal(shape), jnp.float32) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_naive(rng, causal):
+    q, k, v = make_qkv(rng)
+    out = pk.flash_attention(q, k, v, causal)
+    ref = naive_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_lse_matches_logsumexp(rng):
+    q, k, v = make_qkv(rng, t=32)
+    _, lse = pk.flash_attention_lse(q, k, v, False)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    ref = jax.scipy.special.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_naive(rng, causal):
+    q, k, v = make_qkv(rng, t=32, hd=8)
+    cot = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal) * cot)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal) * cot)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_naive = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for gf, gn in zip(g_flash, g_naive):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gn), atol=5e-5)
+
+
+def test_flash_lse_cotangent(rng):
+    """The lse output's gradient path (used by the ring merge) is exact."""
+    q, k, v = make_qkv(rng, t=16, hd=8)
+    cot = jnp.asarray(rng.standard_normal(q.shape[:3]), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention_lse(q, k, v, False)[1] * cot)
+
+    def loss_naive(q, k, v):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        return jnp.sum(jax.scipy.special.logsumexp(scores, axis=-1) * cot)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_naive = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for gf, gn in zip(g_flash, g_naive):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gn), atol=5e-5)
+
+
+def test_flash_uneven_block_sizes(rng):
+    # t=48 forces a non-128 block divisor.
+    q, k, v = make_qkv(rng, t=48)
+    out = pk.flash_attention(q, k, v, True)
+    ref = naive_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_bfloat16(rng):
+    q, k, v = (x.astype(jnp.bfloat16) for x in make_qkv(rng, t=32))
+    out = pk.flash_attention(q, k, v, False)
+    assert out.dtype == jnp.bfloat16
+    ref = naive_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32), False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_flash_supported_gating():
+    assert pk.flash_supported((2, 2, 128, 64))
+    assert not pk.flash_supported((2, 2, 8, 64))      # too short
+    assert not pk.flash_supported((2, 128, 64))       # wrong rank
+    assert not pk.flash_supported((1, 1, 1 << 17, 128))  # K/V exceed VMEM

@@ -29,7 +29,7 @@ def _batch(ex, batch=8):
     }
 
 
-def test_profile_ops_covers_every_op(ex_factory=None):
+def test_profile_ops_covers_every_op():
     ff = _model()
     store = StrategyStore(8)
     store.set("fc1", ParallelConfig(n=2, c=4))

@@ -212,3 +212,36 @@ class TestEndToEndSearch:
         )
         jax.block_until_ready(metrics)
         assert np.isfinite(float(metrics["train_loss"]))
+
+    def test_bad_edge_rank_raises_not_crashes(self):
+        # nd = -1 previously hit vector::resize -> std::terminate.
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 2",
+            "op 0 1 a", "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "op 1 1 b", "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "nedges 1",
+            "edge 0 1 4 -1",
+        ])
+        with pytest.raises(ValueError):
+            ffsim_simulate(p, [0, 0])
+
+    def test_oversized_counts_raise_not_allocate(self):
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 2000000000",
+        ])
+        with pytest.raises(ValueError):
+            ffsim_simulate(p, [0])
+
+    def test_degree_exceeding_ndevices_raises(self):
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 1", "op 0 1 a", "cfg 4 1 1 1 1 5.0 0.0 0 1 2 3",
+            "nedges 0",
+        ])
+        with pytest.raises(ValueError):
+            ffsim_simulate(p, [0])
