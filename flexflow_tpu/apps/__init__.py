@@ -1,0 +1,7 @@
+"""Model apps — the reference's per-model binaries as CLI entry points.
+
+Each module is runnable (``python -m flexflow_tpu.apps.<name>``) and
+shares the FFConfig flag surface (``-e -b --lr --wd -d -s -ll:tpu -i``,
+``config.py``): alexnet, cnn (legacy multi-model driver), dlrm,
+candle_uno, nmt, transformer.
+"""

@@ -1,0 +1,27 @@
+"""AlexNet app (reference: ``alexnet.cc`` + legacy driver ``cnn.cc``).
+
+Example::
+
+    python -m flexflow_tpu.apps.alexnet -b 256 -i 20 --dtype bfloat16
+    python -m flexflow_tpu.apps.alexnet -s strategy.pb   # reference format
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.apps.common import run_training
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.alexnet import build_alexnet
+
+
+def main(argv=None) -> int:
+    cfg = FFConfig.parse_args(sys.argv[1:] if argv is None else argv)
+    ff = build_alexnet(batch_size=cfg.batch_size, config=cfg)
+    stats = run_training(ff, cfg, int_high={"label": 1000}, label="images")
+    print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

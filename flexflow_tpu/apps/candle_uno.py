@@ -1,0 +1,27 @@
+"""Candle-Uno app (reference: ``examples/candle_uno/candle_uno.cc``) —
+the multi-tower cancer-drug-response MLP.
+
+Example::
+
+    python -m flexflow_tpu.apps.candle_uno -b 64 -i 10
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.apps.common import run_training
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.candle_uno import CandleConfig, build_candle_uno
+
+
+def main(argv=None) -> int:
+    cfg = FFConfig.parse_args(sys.argv[1:] if argv is None else argv)
+    ff = build_candle_uno(batch_size=cfg.batch_size, candle=CandleConfig(),
+                          config=cfg)
+    run_training(ff, cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

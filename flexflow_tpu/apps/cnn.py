@@ -1,0 +1,51 @@
+"""Legacy CNN driver (reference: ``cnn.cc:42-281``) — one binary, many
+nets: AlexNet / VGG-16 / Inception-V3 / DenseNet-121 / ResNet-101
+(the reference's ``#ifdef`` model catalog).
+
+Example::
+
+    python -m flexflow_tpu.apps.cnn --model resnet101 -b 64 -i 10
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.apps.common import run_training
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.alexnet import build_alexnet
+from flexflow_tpu.models.cnn_catalog import (
+    build_densenet121,
+    build_inception_v3,
+    build_resnet101,
+    build_vgg16,
+)
+
+MODELS = {
+    "alexnet": (build_alexnet, 229),
+    "vgg16": (build_vgg16, 224),
+    "inception": (build_inception_v3, 299),
+    "densenet121": (build_densenet121, 224),
+    "resnet101": (build_resnet101, 224),
+}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    model = "alexnet"
+    if "--model" in argv:
+        i = argv.index("--model")
+        model = argv[i + 1]
+        del argv[i : i + 2]
+    if model not in MODELS:
+        raise SystemExit(f"unknown --model {model!r}; one of {sorted(MODELS)}")
+    cfg = FFConfig.parse_args(argv)
+    build, image_size = MODELS[model]
+    ff = build(batch_size=cfg.batch_size, image_size=image_size, config=cfg)
+    stats = run_training(ff, cfg, int_high={"label": 1000}, label="images")
+    print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Shared app harness.
+
+The reference builds each model into its own Legion binary whose
+``top_level_task`` parses flags, builds the graph, and drives the
+training loop with fenced timing printouts (``dlrm.cc:77-167``,
+``nmt.cc:44-83``, ``cnn.cc:42-129``).  Here every app is a
+``python -m flexflow_tpu.apps.<name>`` entry sharing this harness:
+FFConfig flags (``-e -b --lr --wd -d -s -ll:tpu -i``), strategy-file
+loading (JSON, or the reference's ``.pb`` wire format via the native
+codec), synthetic-or-dataset batches, and the reference's throughput
+formulas.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data.loader import ArrayDataLoader, synthetic_arrays
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import StrategyStore
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.trainer import Trainer
+
+
+def load_strategy(cfg: FFConfig, num_devices: int) -> Optional[StrategyStore]:
+    """``-s file.pb`` reads the reference protobuf format; anything
+    else is our JSON schema (``parallel/strategy.py``)."""
+    if not cfg.strategy_file:
+        return None
+    if cfg.strategy_file.endswith(".pb"):
+        return StrategyStore.load_pb(cfg.strategy_file, num_devices=num_devices)
+    return StrategyStore.load(cfg.strategy_file, num_devices=num_devices)
+
+
+def run_training(
+    ff: FFModel,
+    cfg: FFConfig,
+    strategy: Optional[StrategyStore] = None,
+    int_high: Optional[Dict[str, int]] = None,
+    label: str = "samples",
+    num_samples: Optional[int] = None,
+) -> Dict[str, float]:
+    """Build the executor, feed synthetic (or loader-provided) batches,
+    run ``cfg.epochs x cfg.iterations`` fenced steps, and print the
+    reference throughput lines (``cnn.cc:128-129``, ``dlrm.cc:159-166``).
+    """
+    ndev = cfg.resolve_num_devices()
+    if strategy is None:
+        strategy = load_strategy(cfg, ndev)
+    ex = Executor(
+        ff,
+        config=cfg,
+        strategy=strategy,
+        optimizer=SGDOptimizer(
+            lr=cfg.learning_rate, momentum=0.9, weight_decay=cfg.weight_decay
+        ),
+    )
+    trainer = Trainer(ex)
+    batches = None
+    if not cfg.synthetic_input and cfg.dataset_path:
+        raise SystemExit(
+            "dataset files are app-specific; this app only supports "
+            "synthetic input (drop -d)"
+        )
+    if num_samples is not None:
+        arrays = synthetic_arrays(ff, num_samples, seed=cfg.seed,
+                                  int_high=int_high)
+        # Trainer.fit shards each batch; pass host batches through.
+        batches = iter(ArrayDataLoader(arrays, cfg.batch_size, shuffle=True,
+                                       seed=cfg.seed))
+    iters = cfg.iterations * max(cfg.epochs, 1)
+    stats = trainer.fit(iterations=iters, batches=batches, warmup=1)
+    print(f"ELAPSED TIME = {stats['elapsed_s']:.4f}s")
+    print(f"THROUGHPUT = {stats['samples_per_s']:.2f} {label}/s")
+    return stats

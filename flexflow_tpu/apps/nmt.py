@@ -1,0 +1,55 @@
+"""NMT app (reference: ``nmt/nmt.cc``) — seq2seq LSTM encoder/decoder
+with sequence-pipeline + vocab tensor parallelism.
+
+Flags beyond the common set: ``--src-len --tgt-len --vocab --hidden
+--layers`` (reference defaults: seq 20-40, hidden 2048, vocab 32k,
+``nmt.cc:44``).  Prints the reference's ``time = %.4fs`` line
+(``nmt.cc:77-83``).
+
+Example::
+
+    python -m flexflow_tpu.apps.nmt -b 64 -i 10 --hidden 1024
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.apps.common import load_strategy, run_training
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.nmt import build_nmt, nmt_strategy
+
+
+def _pop_int(argv, flag, default):
+    if flag in argv:
+        i = argv.index(flag)
+        val = int(argv[i + 1])
+        del argv[i : i + 2]
+        return val
+    return default
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    src_len = _pop_int(argv, "--src-len", 20)
+    tgt_len = _pop_int(argv, "--tgt-len", 20)
+    vocab = _pop_int(argv, "--vocab", 32 * 1024)
+    hidden = _pop_int(argv, "--hidden", 1024)
+    layers = _pop_int(argv, "--layers", 2)
+    cfg = FFConfig.parse_args(argv)
+    ff = build_nmt(
+        batch_size=cfg.batch_size, src_len=src_len, tgt_len=tgt_len,
+        vocab_size=vocab, embed_dim=hidden, hidden_size=hidden,
+        num_layers=layers, config=cfg,
+    )
+    ndev = cfg.resolve_num_devices()
+    strategy = load_strategy(cfg, ndev) or nmt_strategy(ndev, num_layers=layers)
+    int_high = {"src": vocab, "tgt": vocab, "label": vocab}
+    stats = run_training(ff, cfg, strategy=strategy, int_high=int_high,
+                         label="sentence-pairs")
+    print(f"time = {stats['elapsed_s']:.4f}s")  # nmt.cc:77-83
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Transformer LM app — the long-context/ring-attention flagship (the
+reference's NMT sequence decomposition generalized to attention,
+SURVEY.md §2.7).
+
+Flags beyond the common set: ``--seq --vocab --d-model --heads
+--layers --dp --sp --tp`` (dp x sp x tp hybrid; sp shards the sequence
+via ring attention over the mesh).
+
+Example::
+
+    python -m flexflow_tpu.apps.transformer -b 8 --seq 2048 --dp 2 --sp 4
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.apps.common import load_strategy, run_training
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.transformer import (
+    build_transformer_lm,
+    transformer_strategy,
+)
+
+
+def _pop_int(argv, flag, default):
+    if flag in argv:
+        i = argv.index(flag)
+        val = int(argv[i + 1])
+        del argv[i : i + 2]
+        return val
+    return default
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    seq = _pop_int(argv, "--seq", 512)
+    vocab = _pop_int(argv, "--vocab", 32 * 1024)
+    d_model = _pop_int(argv, "--d-model", 512)
+    heads = _pop_int(argv, "--heads", 8)
+    layers = _pop_int(argv, "--layers", 4)
+    dp = _pop_int(argv, "--dp", 1)
+    sp = _pop_int(argv, "--sp", 1)
+    tp = _pop_int(argv, "--tp", 1)
+    cfg = FFConfig.parse_args(argv)
+    ff = build_transformer_lm(
+        batch_size=cfg.batch_size, seq_len=seq, vocab_size=vocab,
+        d_model=d_model, num_heads=heads, num_layers=layers, config=cfg,
+    )
+    ndev = cfg.resolve_num_devices()
+    strategy = load_strategy(cfg, ndev) or transformer_strategy(
+        ndev, num_layers=layers, dp=dp, sp=sp, tp=tp
+    )
+    int_high = {"tokens": vocab, "label": vocab}
+    stats = run_training(ff, cfg, strategy=strategy, int_high=int_high,
+                         label="sequences")
+    toks = stats["samples_per_s"] * seq
+    print(f"tokens/s = {toks:.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

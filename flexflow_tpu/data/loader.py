@@ -59,12 +59,16 @@ class ArrayDataLoader:
 
     def next_batch(self) -> Dict[str, np.ndarray]:
         """Wraps around at epoch end (callers doing epoch accounting use
-        ``batches_per_epoch`` + ``reset``)."""
+        ``batches_per_epoch`` + ``reset``).  Rows are gathered by the
+        native threaded copy (``native/ffdata.cc``, the reference DLRM
+        loader's host-gather, ``dlrm.cu:20-50``)."""
         if self._pos + self.batch_size > self.num_samples:
             self.reset()
         idx = self._order[self._pos : self._pos + self.batch_size]
         self._pos += self.batch_size
-        return {k: v[idx] for k, v in self.arrays.items()}
+        from flexflow_tpu.native import gather_rows
+
+        return {k: gather_rows(v, idx) for k, v in self.arrays.items()}
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:

@@ -2,11 +2,19 @@
 
 The reference framework's runtime is entirely native (C++/CUDA on
 Legion); the TPU rebuild keeps the compute path in XLA but implements
-the offline strategy-search core natively too (``ffsim.cc``, the
-counterpart of the reference's standalone simulator binary,
-``scripts/simulator.cc`` + ``scripts/Makefile:1-2``).  The shared
-library is compiled on first use with the system toolchain and loaded
-via ctypes — no pybind11 dependency.
+the runtime machinery around it natively too:
+
+- ``ffsim.cc`` — the offline strategy-search core (event-driven
+  simulator + MCMC), counterpart of the reference's standalone
+  simulator binary (``scripts/simulator.cc`` + ``scripts/Makefile:1-2``).
+- ``ffproto.cc`` — proto2 wire codec for the reference's strategy
+  file format (``src/runtime/strategy.proto:5-13``), so ``.pb``
+  strategy files interoperate with the reference toolchain.
+- ``ffdata.cc`` — multithreaded batch row-gather, the host half of the
+  reference's DLRM loader tasks (``examples/DLRM/dlrm.cu:20-50``).
+
+Each shared library is compiled on first use with the system toolchain
+and loaded via ctypes — no pybind11 dependency.
 """
 
 from __future__ import annotations
@@ -15,54 +23,63 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "ffsim.cc")
-_LIB = os.path.join(_HERE, "_ffsim.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
+_libs: Dict[str, ctypes.CDLL] = {}
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def _needs_build() -> bool:
-    return (not os.path.exists(_LIB)) or (
-        os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-    )
-
-
-def build_ffsim(force: bool = False) -> str:
-    """Compile ``ffsim.cc`` into ``_ffsim.so`` if missing or stale."""
+def _build(name: str, force: bool = False) -> str:
+    """Compile ``<name>.cc`` into ``_<name>.so`` if missing or stale."""
+    src = os.path.join(_HERE, f"{name}.cc")
+    lib = os.path.join(_HERE, f"_{name}.so")
     with _lock:
-        if force or _needs_build():
+        stale = force or (not os.path.exists(lib)) or (
+            os.path.getmtime(lib) < os.path.getmtime(src)
+        )
+        if stale:
             # Per-process temp name so concurrent builds (e.g. parallel
             # test workers sharing the checkout) can't clobber each
             # other mid-compile; os.replace is atomic.
-            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            tmp = f"{lib}.{os.getpid()}.tmp"
             cmd = [
                 "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                _SRC, "-o", tmp,
+                src, "-o", tmp, "-pthread",
             ]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
-                raise NativeBuildError(
-                    f"ffsim build failed:\n{proc.stderr}"
-                )
-            os.replace(tmp, _LIB)
-    return _LIB
+                raise NativeBuildError(f"{name} build failed:\n{proc.stderr}")
+            os.replace(tmp, lib)
+    return lib
 
 
-def load_ffsim() -> ctypes.CDLL:
-    """Build (if needed) and load the simulator library."""
-    global _lib
-    if _lib is not None:
-        return _lib
-    path = build_ffsim()
-    lib = ctypes.CDLL(path)
+def build_ffsim(force: bool = False) -> str:
+    return _build("ffsim", force)
+
+
+def _load(name: str, configure) -> ctypes.CDLL:
+    lib = _libs.get(name)
+    if lib is None:
+        lib = ctypes.CDLL(_build(name))
+        configure(lib)
+        _libs[name] = lib
+    return lib
+
+
+# ---------------------------------------------------------------------------
+# ffsim — strategy search
+# ---------------------------------------------------------------------------
+
+
+def _configure_ffsim(lib):
     lib.ffsim_search.restype = ctypes.c_void_p
     lib.ffsim_search.argtypes = [
         ctypes.c_char_p, ctypes.c_long, ctypes.c_uint, ctypes.c_double,
@@ -73,8 +90,21 @@ def load_ffsim() -> ctypes.CDLL:
     ]
     lib.ffsim_free.restype = None
     lib.ffsim_free.argtypes = [ctypes.c_void_p]
-    _lib = lib
-    return lib
+
+
+def load_ffsim() -> ctypes.CDLL:
+    """Build (if needed) and load the simulator library."""
+    return _load("ffsim", _configure_ffsim)
+
+
+def _take_text(lib, free_fn, ptr) -> str:
+    try:
+        text = ctypes.cast(ptr, ctypes.c_char_p).value.decode()
+    finally:
+        free_fn(ptr)
+    if text.startswith("error:"):
+        raise ValueError(text)
+    return text
 
 
 def _call_returning_text(fn, *args) -> str:
@@ -114,3 +144,121 @@ def ffsim_simulate(problem: str, assign) -> float:
         lib.ffsim_simulate, problem.encode(), arr, len(assign)
     )
     return float(text.split()[1])
+
+
+# ---------------------------------------------------------------------------
+# ffproto — reference strategy.pb wire codec
+# ---------------------------------------------------------------------------
+
+
+def _configure_ffproto(lib):
+    lib.ffproto_strategy_decode.restype = ctypes.c_void_p
+    lib.ffproto_strategy_decode.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.ffproto_strategy_encode.restype = ctypes.c_void_p
+    lib.ffproto_strategy_encode.argtypes = [ctypes.c_char_p]
+    lib.ffproto_free.restype = None
+    lib.ffproto_free.argtypes = [ctypes.c_void_p]
+
+
+def load_ffproto() -> ctypes.CDLL:
+    return _load("ffproto", _configure_ffproto)
+
+
+ProtoOp = Tuple[str, List[int], List[int]]  # (name, dims, devices)
+
+
+def proto_strategy_decode(data: bytes) -> List[ProtoOp]:
+    """Parse reference-format strategy.pb bytes into (name, dims,
+    devices) tuples (reference reader: ``strategy.cc:42-70``)."""
+    lib = load_ffproto()
+    text = _take_text(
+        lib, lib.ffproto_free, lib.ffproto_strategy_decode(data, len(data))
+    )
+    ops: List[ProtoOp] = []
+    for line in text.splitlines():
+        toks = line.split()
+        assert toks[0] == "op"
+        name = toks[1]
+        ndims = int(toks[2])
+        dims = [int(x) for x in toks[3 : 3 + ndims]]
+        ndevs = int(toks[3 + ndims])
+        devs = [int(x) for x in toks[4 + ndims : 4 + ndims + ndevs]]
+        ops.append((name, dims, devs))
+    return ops
+
+
+def proto_strategy_encode(ops: Sequence[ProtoOp]) -> bytes:
+    """Serialize (name, dims, devices) tuples to reference-format
+    strategy.pb bytes (reference writer: ``dlrm_strategy.cc:5-36``)."""
+    lines = []
+    for name, dims, devs in ops:
+        if not name or any(c.isspace() for c in name):
+            raise ValueError(f"op name empty or contains whitespace: {name!r}")
+        lines.append(
+            f"op {name} {len(dims)} {' '.join(map(str, dims))} "
+            f"{len(devs)} {' '.join(map(str, devs))}"
+        )
+    lib = load_ffproto()
+    hextext = _take_text(
+        lib, lib.ffproto_free,
+        lib.ffproto_strategy_encode("\n".join(lines).encode()),
+    )
+    return bytes.fromhex(hextext)
+
+
+# ---------------------------------------------------------------------------
+# ffdata — multithreaded batch gather
+# ---------------------------------------------------------------------------
+
+
+def _configure_ffdata(lib):
+    lib.ffdata_gather.restype = ctypes.c_longlong
+    lib.ffdata_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+
+
+def load_ffdata() -> ctypes.CDLL:
+    return _load("ffdata", _configure_ffdata)
+
+
+def gather_rows(
+    src: np.ndarray, idx: np.ndarray, nthreads: int = 0
+) -> np.ndarray:
+    """``src[idx]`` for a C-contiguous array via the native threaded
+    row copy (the reference DLRM loader's host gather,
+    ``dlrm.cu:20-50``).  Falls back to numpy for non-contiguous or
+    object-dtype input — and for hosts without a working C++ toolchain
+    (the native path is an optimization, never a requirement).
+    """
+    if not src.flags.c_contiguous or src.ndim < 1 or src.dtype.hasobject:
+        return src[idx]
+    idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx64),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    if row_bytes == 0 or len(idx64) == 0:
+        return src[idx]
+    if nthreads <= 0:
+        nthreads = min(8, os.cpu_count() or 1)
+    try:
+        lib = load_ffdata()
+    except (NativeBuildError, OSError):
+        return src[idx]
+    rc = lib.ffdata_gather(
+        src.ctypes.data_as(ctypes.c_void_p),
+        src.shape[0],
+        row_bytes,
+        idx64.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        len(idx64),
+        out.ctypes.data_as(ctypes.c_void_p),
+        nthreads,
+    )
+    if rc > 0:
+        raise IndexError(
+            f"gather index {idx64[rc - 1]} out of range [0, {src.shape[0]})"
+        )
+    if rc < 0:
+        raise ValueError("ffdata_gather: bad arguments")
+    return out

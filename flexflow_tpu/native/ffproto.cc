@@ -1,0 +1,252 @@
+// Native proto2 wire codec for the reference's strategy file format.
+//
+// The reference stores per-op parallelization strategies as protobuf
+// (schema src/runtime/strategy.proto:5-13: Strategy{repeated Op},
+// Op{name=1, repeated int32 dims=2, repeated int32 devices=3}),
+// written by standalone generators (src/runtime/dlrm_strategy.cc:5-36)
+// and read by load_strategies_from_file (src/runtime/strategy.cc:42-70).
+// This file implements the same wire format from scratch — varint +
+// length-delimited framing, accepting both packed and unpacked
+// repeated int32 — so strategy .pb files interoperate byte-for-byte
+// with the reference toolchain without a protobuf dependency.
+//
+// C ABI (ctypes): decode returns a text table ("op <name> <ndims>
+// <dims...> <ndevs> <devices...>" per line), encode takes the same
+// text and returns hex-encoded bytes; both return "error: ..." on
+// malformed input (never abort).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t kMaxLen = 64u << 20;  // 64 MB cap on any input
+constexpr long long kMaxRepeated = 1 << 20;
+
+struct OpS {
+  std::string name;
+  std::vector<long long> dims;
+  std::vector<long long> devices;
+};
+
+bool read_varint(const uint8_t* p, size_t len, size_t& off, uint64_t& v,
+                 std::string& err) {
+  v = 0;
+  int shift = 0;
+  while (off < len && shift < 64) {
+    uint8_t b = p[off++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+  }
+  err = "truncated varint";
+  return false;
+}
+
+bool skip_field(const uint8_t* p, size_t len, size_t& off, uint32_t wire,
+                std::string& err) {
+  uint64_t v;
+  switch (wire) {
+    case 0:  // varint
+      return read_varint(p, len, off, v, err);
+    case 1:  // 64-bit
+      if (off + 8 > len) { err = "truncated fixed64"; return false; }
+      off += 8;
+      return true;
+    case 2:  // length-delimited
+      if (!read_varint(p, len, off, v, err)) return false;
+      if (v > len - off) { err = "truncated bytes field"; return false; }
+      off += v;
+      return true;
+    case 5:  // 32-bit
+      if (off + 4 > len) { err = "truncated fixed32"; return false; }
+      off += 4;
+      return true;
+    default:
+      err = "unsupported wire type";
+      return false;
+  }
+}
+
+// Repeated int32: unpacked (wire 0, one per tag) or packed (wire 2).
+bool read_repeated_i32(const uint8_t* p, size_t len, size_t& off,
+                       uint32_t wire, std::vector<long long>& out,
+                       std::string& err) {
+  uint64_t v;
+  if (wire == 0) {
+    if (!read_varint(p, len, off, v, err)) return false;
+    out.push_back((long long)(int64_t)v);
+  } else if (wire == 2) {
+    if (!read_varint(p, len, off, v, err)) return false;
+    if (v > len - off) { err = "truncated packed field"; return false; }
+    size_t end = off + v;
+    while (off < end) {
+      uint64_t e;
+      if (!read_varint(p, end, off, e, err)) return false;
+      out.push_back((long long)(int64_t)e);
+    }
+  } else {
+    err = "bad wire type for repeated int32";
+    return false;
+  }
+  if ((long long)out.size() > kMaxRepeated) {
+    err = "repeated field too large";
+    return false;
+  }
+  return true;
+}
+
+bool parse_op(const uint8_t* p, size_t len, OpS& op, std::string& err) {
+  size_t off = 0;
+  uint64_t key, v;
+  while (off < len) {
+    if (!read_varint(p, len, off, key, err)) return false;
+    uint32_t field = (uint32_t)(key >> 3), wire = (uint32_t)(key & 7);
+    if (field == 1 && wire == 2) {
+      if (!read_varint(p, len, off, v, err)) return false;
+      if (v > len - off) { err = "truncated op name"; return false; }
+      op.name.assign((const char*)p + off, v);
+      off += v;
+    } else if (field == 2) {
+      if (!read_repeated_i32(p, len, off, wire, op.dims, err)) return false;
+    } else if (field == 3) {
+      if (!read_repeated_i32(p, len, off, wire, op.devices, err)) return false;
+    } else {
+      if (!skip_field(p, len, off, wire, err)) return false;
+    }
+  }
+  return true;
+}
+
+bool parse_strategy(const uint8_t* p, size_t len, std::vector<OpS>& ops,
+                    std::string& err) {
+  size_t off = 0;
+  uint64_t key, v;
+  while (off < len) {
+    if (!read_varint(p, len, off, key, err)) return false;
+    uint32_t field = (uint32_t)(key >> 3), wire = (uint32_t)(key & 7);
+    if (field == 1 && wire == 2) {
+      if (!read_varint(p, len, off, v, err)) return false;
+      if (v > len - off) { err = "truncated op message"; return false; }
+      OpS op;
+      if (!parse_op(p + off, v, op, err)) return false;
+      off += v;
+      ops.push_back(std::move(op));
+      if ((long long)ops.size() > kMaxRepeated) {
+        err = "too many ops";
+        return false;
+      }
+    } else {
+      if (!skip_field(p, len, off, wire, err)) return false;
+    }
+  }
+  return true;
+}
+
+void write_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back((char)(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out.push_back((char)v);
+}
+
+// Canonical protobuf int32 encoding: negatives sign-extend to 64 bits.
+void write_i32(std::string& out, long long v) {
+  write_varint(out, (uint64_t)(int64_t)v);
+}
+
+char* dup_out(const std::string& s) {
+  char* p = (char*)std::malloc(s.size() + 1);
+  if (p) std::memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+
+char* err_out(const std::string& e) { return dup_out("error: " + e); }
+
+}  // namespace
+
+extern "C" {
+
+// buf/len: raw .pb bytes.  Returns malloc'd text (free with
+// ffproto_free): one "op <name> <ndims> <dims...> <ndevs> <devs...>"
+// line per op, or "error: ...".
+char* ffproto_strategy_decode(const uint8_t* buf, long long len) {
+  if (len < 0 || (size_t)len > kMaxLen) return err_out("bad length");
+  std::vector<OpS> ops;
+  std::string err;
+  if (!parse_strategy(buf, (size_t)len, ops, err)) return err_out(err);
+  std::ostringstream out;
+  for (const OpS& op : ops) {
+    if (op.name.empty()) return err_out("op with empty name");
+    for (char c : op.name) {
+      if (std::isspace((unsigned char)c) || c == '\0')
+        return err_out("op name contains whitespace: " + op.name);
+    }
+    out << "op " << op.name << " " << op.dims.size();
+    for (long long d : op.dims) out << " " << d;
+    out << " " << op.devices.size();
+    for (long long d : op.devices) out << " " << d;
+    out << "\n";
+  }
+  return dup_out(out.str());
+}
+
+// text: the same line format decode emits.  Returns malloc'd
+// hex-encoded .pb bytes, or "error: ...".
+char* ffproto_strategy_encode(const char* text) {
+  if (!text) return err_out("null input");
+  std::istringstream in(text);
+  std::string tok;
+  std::string pb;
+  while (in >> tok) {
+    if (tok != "op") return err_out("expected 'op', got: " + tok);
+    OpS op;
+    long long ndims = -1, ndevs = -1;
+    if (!(in >> op.name >> ndims)) return err_out("truncated op line");
+    if (op.name.empty()) return err_out("op with empty name");
+    if (ndims < 0 || ndims > 8) return err_out("ndims out of range");
+    op.dims.resize(ndims);
+    for (long long i = 0; i < ndims; ++i)
+      if (!(in >> op.dims[i])) return err_out("truncated dims");
+    if (!(in >> ndevs)) return err_out("truncated op line");
+    if (ndevs < 0 || ndevs > kMaxRepeated)
+      return err_out("ndevs out of range");
+    op.devices.resize(ndevs);
+    for (long long i = 0; i < ndevs; ++i)
+      if (!(in >> op.devices[i])) return err_out("truncated devices");
+
+    std::string payload;
+    payload.push_back((char)0x0a);  // field 1 (name), wire 2
+    write_varint(payload, op.name.size());
+    payload += op.name;
+    for (long long d : op.dims) {
+      payload.push_back((char)0x10);  // field 2, wire 0 (unpacked int32)
+      write_i32(payload, d);
+    }
+    for (long long d : op.devices) {
+      payload.push_back((char)0x18);  // field 3, wire 0
+      write_i32(payload, d);
+    }
+    pb.push_back((char)0x0a);  // Strategy.ops, wire 2
+    write_varint(pb, payload.size());
+    pb += payload;
+  }
+  static const char* hexd = "0123456789abcdef";
+  std::string hex;
+  hex.reserve(pb.size() * 2);
+  for (unsigned char c : pb) {
+    hex.push_back(hexd[c >> 4]);
+    hex.push_back(hexd[c & 0xf]);
+  }
+  return dup_out(hex);
+}
+
+void ffproto_free(char* p) { std::free(p); }
+
+}  // extern "C"

@@ -138,6 +138,75 @@ class StrategyStore:
     def data_parallel(num_devices: int) -> "StrategyStore":
         return StrategyStore(num_devices, {})
 
+    # -- reference .pb interop --------------------------------------------
+
+    @staticmethod
+    def load_pb(path: str, num_devices: Optional[int] = None) -> "StrategyStore":
+        """Load a strategy file in the reference's protobuf format
+        (schema ``strategy.proto:5-13``, reader ``strategy.cc:42-70``),
+        so strategies emitted by the reference's generators (e.g.
+        ``dlrm_strategy.cc``) drive this runtime unchanged.
+
+        Reference dim order per op grid: 1-D ``[n]``; 2-D ``[c, n]``
+        (the Linear TPxDP grid, ``linear.cu:60-160``); 4-D
+        ``[w, h, c, n]`` (the Conv2D spatial grid, ``conv_2d.cu:46-``).
+        """
+        with open(path, "rb") as f:
+            data = f.read()
+        from flexflow_tpu.native import proto_strategy_decode
+
+        table: Dict[str, ParallelConfig] = {}
+        max_need = 1
+        for name, dims, devices in proto_strategy_decode(data):
+            if len(dims) == 1:
+                n, c, h, w = dims[0], 1, 1, 1
+            elif len(dims) == 2:
+                (c, n), h, w = dims, 1, 1
+            elif len(dims) == 4:
+                w, h, c, n = dims
+            else:
+                raise ValueError(
+                    f"op {name!r}: unsupported strategy rank {len(dims)}"
+                )
+            parts = n * c * h * w
+            if devices and len(devices) != parts:
+                # The reference asserts devices empty or == shard count
+                # (strategy.cc:60).
+                raise ValueError(
+                    f"op {name!r}: {len(devices)} devices for {parts} shards"
+                )
+            pc = ParallelConfig(
+                n=n, c=c, h=h, w=w,
+                device_ids=tuple(devices) if devices else None,
+            )
+            table[name] = pc
+            max_need = max(max_need, parts, *(d + 1 for d in devices or [0]))
+        nd = num_devices if num_devices is not None else max_need
+        store = StrategyStore(nd)
+        for name, pc in table.items():
+            store.set(name, pc)
+        return store
+
+    def save_pb(self, path: str) -> None:
+        """Write this table in the reference's protobuf format.  The
+        ``s`` (sequence) axis has no reference counterpart and must be
+        1; spatial strategies serialize as the 4-D conv grid."""
+        from flexflow_tpu.native import proto_strategy_encode
+
+        ops = []
+        for name, pc in sorted(self.table.items()):
+            if pc.s != 1:
+                raise ValueError(
+                    f"op {name!r}: s={pc.s} has no reference .pb encoding"
+                )
+            if pc.h != 1 or pc.w != 1:
+                dims = [pc.w, pc.h, pc.c, pc.n]
+            else:
+                dims = [pc.c, pc.n]
+            ops.append((name, dims, list(pc.device_ids or ())))
+        with open(path, "wb") as f:
+            f.write(proto_strategy_encode(ops))
+
 
 def dlrm_strategy(num_devices: int, num_tables: int) -> StrategyStore:
     """The DLRM strategy generator (reference:

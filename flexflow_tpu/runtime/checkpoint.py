@@ -67,6 +67,11 @@ class CheckpointManager:
         opt_state, stateless models) are simply omitted — orbax rejects
         empty items — and reconstituted as None/{} on restore."""
         ocp = _ocp()
+        if step in self._mgr.all_steps():
+            # Same step saved already (e.g. a final forced save landing
+            # on a periodic one); orbax raises StepAlreadyExistsError
+            # even under force, so treat it as the no-op it is.
+            return False
         items: Dict[str, Any] = {"params": ocp.args.StandardSave(params)}
         if opt_state is not None and jax.tree.leaves(opt_state):
             items["opt_state"] = ocp.args.StandardSave(opt_state)

@@ -1,0 +1,159 @@
+"""Failure detection and elastic recovery.
+
+The reference has NO failure handling: ``FatalError`` aborts the whole
+process (``cuda_helper.h:5-11``), there is no retry and no
+checkpoint-restart (SURVEY.md §5).  This subsystem is built from
+scratch for the TPU rebuild:
+
+- **Failure detection** — two classes per step: *raised* failures
+  (device/runtime errors escaping the jitted step) and *silent*
+  failures (non-finite loss: divergence, bad batch, flipped bits).
+- **Recovery** — restore the latest checkpoint through
+  :class:`~flexflow_tpu.runtime.checkpoint.CheckpointManager` (whose
+  restores are sharding-portable), optionally rebuild the executor via
+  a user factory (fresh mesh/compile after a backend fault), and
+  resume; a restart budget bounds crash loops.
+- **Fault injection** — a per-step hook so tests (and chaos runs) can
+  raise at chosen steps, mirroring how the reference's
+  DISABLE_COMPUTATION builds exercise machinery without compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
+from flexflow_tpu.runtime.executor import Executor
+
+logger = logging.getLogger("ff.resilience")
+
+
+@dataclasses.dataclass
+class FailurePolicy:
+    """What counts as a failure and how hard to try to recover."""
+
+    max_restarts: int = 3
+    rollback_on_nonfinite: bool = True
+    backoff_s: float = 0.0
+    # Exception types treated as recoverable; everything else re-raises.
+    recoverable: tuple = (RuntimeError, ValueError, OSError)
+
+
+class StepFailure(RuntimeError):
+    """A detected silent failure (e.g. non-finite loss)."""
+
+
+class ResilientTrainer:
+    """Checkpointed train loop that survives step failures.
+
+    ``executor_factory`` rebuilds the Executor after a raised failure
+    (a fresh factory call re-jits against a healthy backend); plain
+    rollbacks reuse the existing executor.
+    """
+
+    def __init__(
+        self,
+        executor_factory: Callable[[], Executor],
+        checkpoint: CheckpointManager,
+        policy: Optional[FailurePolicy] = None,
+        fault_injector: Optional[Callable[[int], None]] = None,
+    ):
+        self.executor_factory = executor_factory
+        self.checkpoint = checkpoint
+        self.policy = policy or FailurePolicy()
+        self.fault_injector = fault_injector
+        # restarts = consecutive failures since the last durable
+        # progress (the crash-loop budget); total_restarts = lifetime.
+        self.restarts = 0
+        self.total_restarts = 0
+
+    # -- internals ---------------------------------------------------------
+
+    def _fresh_state(self, ex: Executor, seed: int):
+        params, opt_state, state = ex.init(seed=seed)
+        try:
+            step, params, opt_state_r, state_r = self.checkpoint.restore(
+                templates=(params, opt_state, state)
+            )
+            logger.info("resumed from checkpoint step %d", step)
+            return step, params, (
+                opt_state_r if opt_state_r is not None else opt_state
+            ), (state_r or state)
+        except FileNotFoundError:
+            return 0, params, opt_state, state
+
+    def _recover(self, ex: Optional[Executor], seed: int, why: BaseException):
+        self.restarts += 1
+        self.total_restarts += 1
+        if self.restarts > self.policy.max_restarts:
+            raise RuntimeError(
+                f"restart budget ({self.policy.max_restarts}) exhausted"
+            ) from why
+        logger.warning(
+            "step failure (%s); restart %d/%d",
+            why, self.restarts, self.policy.max_restarts,
+        )
+        if self.policy.backoff_s:
+            time.sleep(self.policy.backoff_s * self.restarts)
+        # A silent failure (bad loss) leaves the backend healthy: keep
+        # the compiled executor and just roll the state back.  Raised
+        # runtime faults get a fresh executor (new mesh/jit) instead.
+        if ex is None or not isinstance(why, StepFailure):
+            ex = self.executor_factory()
+        step, params, opt_state, state = self._fresh_state(ex, seed)
+        return ex, step, params, opt_state, state
+
+    # -- the loop ----------------------------------------------------------
+
+    def fit(
+        self,
+        iterations: int,
+        batch_fn: Callable[[int], Dict[str, Any]],
+        save_every: int = 10,
+        seed: int = 0,
+    ) -> Dict[str, Any]:
+        """Run ``iterations`` steps with detection + recovery.
+
+        ``batch_fn(step)`` supplies the batch for a step, so replayed
+        steps after a rollback see the same data (deterministic resume,
+        which the reference cannot do at all).
+        """
+        ex = self.executor_factory()
+        step, params, opt_state, state = self._fresh_state(ex, seed)
+        last_loss = math.nan
+        while step < iterations:
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector(step)
+                batch = ex.shard_batch(batch_fn(step))
+                params, opt_state, state, metrics = ex.train_step(
+                    params, opt_state, state, batch
+                )
+                loss = float(jax.device_get(metrics["train_loss"]))
+                if self.policy.rollback_on_nonfinite and not math.isfinite(loss):
+                    raise StepFailure(f"non-finite loss at step {step}: {loss}")
+            except self.policy.recoverable as e:  # noqa: PERF203
+                ex, step, params, opt_state, state = self._recover(ex, seed, e)
+                continue
+            last_loss = loss
+            step += 1
+            if save_every and step % save_every == 0:
+                self.checkpoint.save(step, params, opt_state, state)
+                # Durable forward progress: the budget bounds crash
+                # *loops*, not total faults over the job lifetime.
+                self.restarts = 0
+        self.checkpoint.save(step, params, opt_state, state, force=True)
+        return {
+            "step": step,
+            "restarts": self.total_restarts,
+            "params": params,
+            "opt_state": opt_state,
+            "state": state,
+            "loss": last_loss,
+        }

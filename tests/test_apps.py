@@ -1,0 +1,63 @@
+"""CLI app smoke tests (the reference's per-model binaries,
+``dlrm.cc``/``nmt.cc``/``cnn.cc``/``candle_uno.cc``, as modules)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.apps import alexnet, candle_uno, dlrm, nmt, transformer
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+
+def test_alexnet_app(capsys):
+    assert alexnet.main(["-b", "4", "-i", "1", "-ll:tpu", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "tp =" in out and "images/s" in out
+
+
+def test_dlrm_app_reference_arch_flags(capsys):
+    assert dlrm.main([
+        "-b", "16", "-i", "2",
+        "--arch-sparse-feature-size", "8",
+        "--arch-embedding-size", "100-100-100-100",
+        "--arch-mlp-bot", "8-16-8",
+        "--arch-mlp-top", "40-16-1",
+    ]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out
+
+
+def test_dlrm_app_loads_reference_pb_strategy(tmp_path, capsys):
+    # A reference-format .pb driving table placement end-to-end.
+    store = StrategyStore(8)
+    store.set("embeddings", ParallelConfig(c=4))
+    pb = tmp_path / "dlrm.pb"
+    store.save_pb(str(pb))
+    assert dlrm.main([
+        "-b", "16", "-i", "1", "-s", str(pb),
+        "--arch-sparse-feature-size", "8",
+        "--arch-embedding-size", "100-100-100-100",
+        "--arch-mlp-bot", "8-16-8",
+        "--arch-mlp-top", "40-16-1",
+    ]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out
+
+
+def test_nmt_app(capsys):
+    assert nmt.main([
+        "-b", "32", "-i", "1", "--hidden", "16", "--vocab", "64",
+        "--src-len", "8", "--tgt-len", "8",
+    ]) == 0
+    assert "time =" in capsys.readouterr().out
+
+
+def test_candle_uno_app(capsys):
+    assert candle_uno.main(["-b", "8", "-i", "1"]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out
+
+
+def test_transformer_app_hybrid(capsys):
+    assert transformer.main([
+        "-b", "8", "-i", "1", "--seq", "64", "--vocab", "64",
+        "--d-model", "32", "--heads", "2", "--layers", "1",
+        "--dp", "2", "--sp", "2", "--tp", "2",
+    ]) == 0
+    assert "tokens/s" in capsys.readouterr().out

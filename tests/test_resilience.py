@@ -1,0 +1,127 @@
+"""Failure detection / elastic recovery (a subsystem the reference
+lacks entirely — FatalError aborts, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer
+
+
+def _factory():
+    def make():
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 16), name="x")
+        lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+        t = ff.dense(x, 32, activation="relu", name="fc1")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        store = StrategyStore(8, {"fc1": ParallelConfig(n=2, c=4)})
+        return Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.1))
+
+    return make
+
+
+def _batch_fn(step):
+    rng = np.random.default_rng(step)  # deterministic per step
+    return {
+        "x": rng.standard_normal((8, 16)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+    }
+
+
+def test_trains_to_completion_and_checkpoints(tmp_path):
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(_factory(), ck)
+        out = rt.fit(iterations=7, batch_fn=_batch_fn, save_every=3)
+        assert out["step"] == 7 and out["restarts"] == 0
+        assert np.isfinite(out["loss"])
+        assert ck.latest_step() == 7
+
+
+def test_recovers_from_injected_fault(tmp_path):
+    fails = {"left": 2}
+
+    def inject(step):
+        if step == 5 and fails["left"] > 0:
+            fails["left"] -= 1
+            raise RuntimeError("injected device failure")
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(_factory(), ck, fault_injector=inject)
+        out = rt.fit(iterations=8, batch_fn=_batch_fn, save_every=2)
+        assert out["step"] == 8
+        assert out["restarts"] == 2
+        assert np.isfinite(out["loss"])
+
+
+def test_nonfinite_loss_rolls_back(tmp_path):
+    poisoned = {"armed": True}
+
+    def batch_fn(step):
+        b = _batch_fn(step)
+        if step == 4 and poisoned["armed"]:
+            poisoned["armed"] = False  # only the first visit is bad
+            b["x"] = np.full_like(b["x"], np.nan)
+        return b
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(_factory(), ck)
+        out = rt.fit(iterations=6, batch_fn=batch_fn, save_every=2)
+        assert out["step"] == 6
+        assert out["restarts"] == 1
+        assert np.isfinite(out["loss"])
+
+
+def test_restart_budget_exhausted_raises(tmp_path):
+    def inject(step):
+        raise RuntimeError("permanently broken")
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(
+            _factory(), ck, policy=FailurePolicy(max_restarts=2),
+            fault_injector=inject,
+        )
+        with pytest.raises(RuntimeError, match="restart budget"):
+            rt.fit(iterations=3, batch_fn=_batch_fn)
+        assert rt.restarts == 3  # 2 allowed + the one that exceeded
+
+
+def test_budget_resets_on_durable_progress(tmp_path):
+    """Isolated transient faults spread over a long run must not
+    accumulate against the crash-loop budget."""
+    def inject(step):
+        # One fault after every checkpoint: 6 faults total with budget 3.
+        if step % 3 == 2 and inject.seen.get(step, 0) == 0:
+            inject.seen[step] = 1
+            raise RuntimeError(f"transient at {step}")
+    inject.seen = {}
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(
+            _factory(), ck, policy=FailurePolicy(max_restarts=3),
+            fault_injector=inject,
+        )
+        out = rt.fit(iterations=18, batch_fn=_batch_fn, save_every=3)
+        assert out["step"] == 18
+        assert out["restarts"] == 6          # lifetime count
+        assert rt.restarts == 0              # budget counter reset
+
+
+def test_unrecoverable_exception_propagates(tmp_path):
+    class Fatal(BaseException):
+        pass
+
+    def inject(step):
+        raise Fatal("not in recoverable tuple")
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(_factory(), ck, fault_injector=inject)
+        with pytest.raises(Fatal):
+            rt.fit(iterations=2, batch_fn=_batch_fn)
+        assert rt.restarts == 0
