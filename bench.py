@@ -25,7 +25,8 @@ def main():
     from flexflow_tpu.runtime.executor import Executor
     from flexflow_tpu.runtime.trainer import Trainer
 
-    batch_size = 256
+    # Swept 256/512/1024 on v5e: 512 is the per-chip throughput peak.
+    batch_size = 512
     n_chips = len(jax.devices())
     cfg = FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
     ff = build_alexnet(batch_size=batch_size, image_size=229, num_classes=1000,

@@ -39,6 +39,10 @@ class FFConfig:
     # Numerics.  Activations/params follow the input tensors' dtype,
     # which defaults to this (FFModel.create_tensor).
     compute_dtype: str = "float32"  # "bfloat16" for the TPU fast path
+    # Rematerialization: recompute per-op activations in the backward
+    # pass instead of keeping them in HBM (jax.checkpoint per layer) —
+    # trades MXU FLOPs for HBM footprint on memory-bound models.
+    remat: bool = False
     seed: int = 1234  # the reference NMT fixed seed (nmt/rnn.cu:345-349)
     # Synthetic input (reference: config.h:73 syntheticInput)
     synthetic_input: bool = True
@@ -86,6 +90,8 @@ class FFConfig:
                 cfg.num_nodes = int(_next())
             elif a == "--profiling":
                 cfg.profiling = True
+            elif a == "--remat":
+                cfg.remat = True
             elif a in ("-i", "--iterations"):
                 cfg.iterations = int(_next())
             elif a == "--dtype":

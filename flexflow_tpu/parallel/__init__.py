@@ -1,4 +1,12 @@
-from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.parallel.distributed import build_hybrid_mesh_plan, initialize
 from flexflow_tpu.parallel.mesh import MeshPlan, build_mesh_plan
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 
-__all__ = ["ParallelConfig", "StrategyStore", "MeshPlan", "build_mesh_plan"]
+__all__ = [
+    "ParallelConfig",
+    "StrategyStore",
+    "MeshPlan",
+    "build_mesh_plan",
+    "build_hybrid_mesh_plan",
+    "initialize",
+]

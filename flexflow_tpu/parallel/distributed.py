@@ -1,0 +1,127 @@
+"""Multi-host / multi-slice distributed backend.
+
+The reference scales across nodes with GASNet under Legion/Realm
+(``Makefile:27`` USE_GASNET) — region coherence generates the
+cross-node copies and the NMT stack adds a 2-level hierarchical
+gradient reduction (per-GPU grads -> node master -> global,
+``rnn.cu:650-703``).  The TPU-native backend:
+
+- ``initialize()`` — ``jax.distributed`` bootstrap (one process per
+  host; coordinator + process id from env or args), the SPMD analogue
+  of ``Runtime::start`` fanning out across nodes.
+- ``build_hybrid_mesh_plan()`` — a mesh whose OUTER axes span the slow
+  interconnect (DCN, across slices/nodes) and inner axes the fast one
+  (ICI, within a slice).  Strategy assignment consumes ``n`` (data
+  parallel) from the left = DCN first, and ``c``/``s`` (tensor /
+  sequence) from the right = ICI only, so per-step TP/ring collectives
+  ride ICI while only the once-per-step gradient all-reduce crosses
+  DCN.  XLA lowers that all-reduce hierarchically (intra-slice
+  reduce-scatter, inter-slice all-reduce, intra-slice all-gather) —
+  the reference's SharedVariable 2-level reduction, emitted by the
+  compiler instead of hand-written.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import jax
+
+from flexflow_tpu.parallel.mesh import MeshPlan, factor_axes, make_plan
+
+logger = logging.getLogger("ff.distributed")
+
+
+# Markers that a cluster resource manager is present, i.e.
+# jax.distributed auto-detection has something to detect.
+_CLUSTER_ENV_MARKERS = (
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "SLURM_JOB_ID",
+    "KUBERNETES_SERVICE_HOST",
+)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the multi-host runtime (no-op on a single process).
+
+    Args fall back to the standard env (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``).  With everything None,
+    ``jax.distributed`` auto-detection runs when a cluster environment
+    is visible (TPU pod / Slurm / k8s markers); otherwise this is a
+    single-process no-op and the local backend is left untouched.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        if process_id is not None:
+            # Half a config is a typo, not a request: fail fast rather
+            # than silently training N independent single-host replicas.
+            raise ValueError(
+                "process_id given without coordinator_address/num_processes"
+            )
+        if any(k in os.environ for k in _CLUSTER_ENV_MARKERS):
+            # Markers (k8s/Slurm env) are necessary but not sufficient —
+            # an ordinary k8s pod sets KUBERNETES_SERVICE_HOST with no
+            # JAX cluster behind it — so auto-detect failure degrades
+            # to the single-process no-op.
+            try:
+                jax.distributed.initialize()  # cluster auto-detection
+            except (ValueError, RuntimeError) as e:
+                logger.info(
+                    "cluster auto-detection unavailable (%s); "
+                    "running single-process", e,
+                )
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def build_hybrid_mesh_plan(
+    num_granules: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshPlan:
+    """MeshPlan with DCN-spanning axes outermost.
+
+    ``num_granules`` = number of slow-interconnect islands (TPU slices
+    or hosts); defaults to ``jax.process_count()``.  Devices are
+    grouped granule-major (jax.devices() is already process-major), the
+    granule count is factored into leading ``d*`` axes and the
+    per-granule devices into trailing ``x*`` axes, so the deterministic
+    strategy assignment (``mesh.py``: ``n`` from the left, ``c``/``s``
+    from the right) maps data parallelism onto DCN and keeps
+    tensor/sequence collectives on ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if num_granules is None:
+        num_granules = max(jax.process_count(), 1)
+    assert n % num_granules == 0, (
+        f"{n} devices do not divide into {num_granules} granules"
+    )
+    if num_granules == 1:
+        names, sizes = factor_axes(n)
+    else:
+        d_names, d_sizes = factor_axes(num_granules, prefix="d")
+        i_names, i_sizes = factor_axes(n // num_granules)
+        if n // num_granules == 1:
+            i_names, i_sizes = (), ()
+        names, sizes = d_names + i_names, d_sizes + i_sizes
+    return make_plan(devices, names, sizes)

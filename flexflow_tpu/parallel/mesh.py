@@ -165,6 +165,22 @@ class MeshPlan:
         return NamedSharding(self.mesh, PartitionSpec())
 
 
+def factor_axes(n: int, prefix: str = "x") -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """Prime-factor ``n`` into named mesh axes ``<prefix>0..k``."""
+    sizes = tuple(_prime_factors(n)) or (1,)
+    return tuple(f"{prefix}{i}" for i in range(len(sizes))), sizes
+
+
+def make_plan(
+    devices: Sequence[jax.Device],
+    names: Tuple[str, ...],
+    sizes: Tuple[int, ...],
+) -> MeshPlan:
+    """Build a MeshPlan from devices reshaped to the named axis grid."""
+    arr = np.array(list(devices)).reshape(sizes)
+    return MeshPlan(mesh=Mesh(arr, names), axis_names=names, axis_sizes=sizes)
+
+
 def build_mesh_plan(
     num_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -175,9 +191,5 @@ def build_mesh_plan(
         if num_devices is not None and num_devices > 0:
             devices = devices[:num_devices]
     devices = list(devices)
-    n = len(devices)
-    sizes = _prime_factors(n) or [1]
-    names = tuple(f"x{i}" for i in range(len(sizes)))
-    arr = np.array(devices).reshape(tuple(sizes))
-    mesh = Mesh(arr, names)
-    return MeshPlan(mesh=mesh, axis_names=names, axis_sizes=tuple(sizes))
+    names, sizes = factor_axes(len(devices))
+    return make_plan(devices, names, sizes)

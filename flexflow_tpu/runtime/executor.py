@@ -162,7 +162,16 @@ class Executor:
             xs = [env[t.name] for t in op.inputs]
             p = params.get(op.name, {})
             s = state.get(op.name, {})
-            result, s_new = op.forward(p, xs, s, training)
+            if self.config.remat and training and not op.is_loss:
+                # Per-layer rematerialization: drop this op's
+                # activations after forward and recompute them in the
+                # backward pass (jax.checkpoint) — HBM for FLOPs.
+                fwd = jax.checkpoint(
+                    lambda p, xs, s, _op=op: _op.forward(p, xs, s, training)
+                )
+                result, s_new = fwd(p, xs, s)
+            else:
+                result, s_new = op.forward(p, xs, s, training)
             if op.is_loss:
                 loss, m, ys = result
                 total_loss = total_loss + loss

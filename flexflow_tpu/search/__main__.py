@@ -66,7 +66,20 @@ def main(argv=None):
         model, num_devices=args.devices, iters=args.iters,
         seed=args.seed, alpha=args.alpha,
     )
-    res.store.save(args.output)
+    if args.output.endswith(".pb"):
+        # Reference wire format (strategy.proto) via the native codec —
+        # searched strategies drop into the reference toolchain too.
+        # Sequence-parallel (s>1) results have no .pb encoding; never
+        # lose a finished search to that — fall back to JSON.
+        try:
+            res.store.save_pb(args.output)
+        except ValueError as e:
+            fallback = args.output + ".json"
+            res.store.save(fallback)
+            print(f"cannot encode as .pb ({e}); wrote {fallback} instead")
+            args.output = fallback
+    else:
+        res.store.save(args.output)
     print(f"dp      = {res.dp_time_us:.1f} us/step (simulated)")
     print(f"best    = {res.best_time_us:.1f} us/step (simulated)")
     print(f"speedup = {res.speedup:.2f}x")

@@ -1,0 +1,91 @@
+"""Multi-host/DCN mesh planning, emulated on the 8-device CPU mesh
+(2 granules x 4 devices — the reference's 2-node x 4-GPU simulator
+topology, ``simulator.cc:32-33``)."""
+
+import jax
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.distributed import build_hybrid_mesh_plan
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime.executor import Executor
+
+
+def test_dcn_axes_outermost():
+    plan = build_hybrid_mesh_plan(num_granules=2)
+    assert plan.axis_names == ("d0", "x0", "x1")
+    assert plan.axis_sizes == (2, 2, 2)
+
+
+def test_dp_lands_on_dcn_tp_on_ici():
+    """n consumes the slow (DCN) axis first; c/s stay on ICI — the
+    'collectives ride ICI' layout rule."""
+    plan = build_hybrid_mesh_plan(num_granules=2)
+    asg = plan.assign(ParallelConfig(n=2, c=2, s=2))
+    assert asg["n"] == ("d0",)
+    assert set(asg["c"]) | set(asg["s"]) <= {"x0", "x1"}
+    # Larger DP spills from DCN into ICI, never the reverse.
+    asg4 = plan.assign(ParallelConfig(n=4, c=2))
+    assert "d0" in asg4["n"]
+    assert asg4["c"][0].startswith("x")
+
+
+def test_granule_grouping_is_process_major():
+    devs = jax.devices()
+    plan = build_hybrid_mesh_plan(num_granules=2, devices=devs)
+    arr = np.asarray(plan.mesh.devices).reshape(2, 4)
+    # Each granule is a contiguous block of jax.devices() order.
+    assert [d.id for d in arr[0]] == [d.id for d in devs[:4]]
+    assert [d.id for d in arr[1]] == [d.id for d in devs[4:]]
+
+
+def test_hybrid_plan_trains_and_matches_single_device(rng):
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), name="x")
+    lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+    t = ff.dense(x, 32, activation="relu", name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    ff.softmax(t, lbl, name="softmax")
+    batch = {
+        "x": rng.standard_normal((8, 16)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+    }
+    opt = SGDOptimizer(lr=0.1, momentum=0.9)
+
+    ex1 = Executor(ff, optimizer=opt, devices=jax.devices()[:1])
+    params, opt_state, state = ex1.init(seed=0)
+    p1, *_ = ex1.train_step(jax.tree.map(np.asarray, params),
+                            jax.tree.map(np.asarray, opt_state), state, batch)
+
+    plan = build_hybrid_mesh_plan(num_granules=2)
+    store = StrategyStore(8, {"fc1": ParallelConfig(n=2, c=4)})
+    exh = Executor(ff, strategy=store, mesh_plan=plan, optimizer=opt)
+    ph, *_ = exh.train_step(jax.tree.map(np.asarray, params),
+                            jax.tree.map(np.asarray, opt_state), state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        p1, ph,
+    )
+
+
+def test_initialize_single_process_noop_in_k8s(monkeypatch):
+    """An ordinary k8s pod (KUBERNETES_SERVICE_HOST set, no JAX cluster)
+    must degrade to the single-process no-op, not crash."""
+    from flexflow_tpu.parallel.distributed import initialize
+
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    initialize()  # must not raise
+
+
+def test_initialize_rejects_partial_config(monkeypatch):
+    from flexflow_tpu.parallel.distributed import initialize
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="process_id"):
+        initialize()
