@@ -1,8 +1,12 @@
-from flexflow_tpu.data.loader import ArrayDataLoader, synthetic_arrays
+from flexflow_tpu.data.csv import load_csv_matrix, load_feature_csvs
+from flexflow_tpu.data.loader import ArrayDataLoader, PrefetchLoader, synthetic_arrays
 from flexflow_tpu.data.criteo import load_criteo_h5, make_dlrm_arrays
 
 __all__ = [
     "ArrayDataLoader",
+    "PrefetchLoader",
+    "load_csv_matrix",
+    "load_feature_csvs",
     "synthetic_arrays",
     "load_criteo_h5",
     "make_dlrm_arrays",

@@ -1,0 +1,67 @@
+"""CSV feature loading for Candle-Uno-style tabular models.
+
+Reference: the candle_uno example reads per-feature CSV matrices into
+its input tensors (``examples/candle_uno/candle_uno.cc`` feature
+loaders).  Here a thin numpy-based reader producing the
+``{input_name: (N, dim) float32}`` dict ``ArrayDataLoader`` consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def load_csv_matrix(
+    path: str,
+    expected_dim: Optional[int] = None,
+    delimiter: str = ",",
+    skip_header: str | bool = "auto",
+) -> np.ndarray:
+    """Read a numeric CSV into (rows, dim) float32.
+
+    ``skip_header="auto"`` (default) keeps the first row when it parses
+    as numbers and skips it otherwise, so headerless exports lose no
+    sample; pass True/False to force.  A dim mismatch raises instead of
+    truncating.
+    """
+
+    def _load(skiprows: int) -> np.ndarray:
+        # ndmin=2 keeps single-row/column files unambiguous.
+        return np.loadtxt(path, delimiter=delimiter, skiprows=skiprows,
+                          dtype=np.float32, ndmin=2)
+
+    try:
+        if skip_header == "auto":
+            try:
+                arr = _load(0)
+            except ValueError:
+                arr = _load(1)  # first row was a header
+        else:
+            arr = _load(1 if skip_header else 0)
+    except ValueError as e:
+        raise ValueError(
+            f"{path}: non-numeric cells (check delimiter/header): {e}"
+        ) from e
+    if expected_dim is not None and arr.shape[1] != expected_dim:
+        raise ValueError(
+            f"{path}: {arr.shape[1]} columns, expected {expected_dim}"
+        )
+    return arr
+
+
+def load_feature_csvs(
+    paths: Dict[str, str],
+    expected_dims: Optional[Dict[str, int]] = None,
+) -> Dict[str, np.ndarray]:
+    """Load one CSV per input tensor; all must have equal row counts
+    (sample-aligned feature files, the candle layout)."""
+    out = {}
+    for name, path in paths.items():
+        dim = (expected_dims or {}).get(name)
+        out[name] = load_csv_matrix(path, expected_dim=dim)
+    counts = {k: len(v) for k, v in out.items()}
+    if len(set(counts.values())) > 1:
+        raise ValueError(f"row-count mismatch across feature files: {counts}")
+    return out

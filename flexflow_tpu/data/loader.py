@@ -13,7 +13,9 @@ consumer op's sharding, so each chip receives only its shard over PCIe
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, Optional
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
@@ -73,6 +75,78 @@ class ArrayDataLoader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:
             yield self.next_batch()
+
+
+class PrefetchLoader:
+    """Background-thread batch prefetch with a bounded device queue.
+
+    The reference overlaps input staging with compute by double-buffering
+    dataset rows through zero-copy DRAM ahead of the step's gather tasks
+    (``dlrm.cc:447-512``).  Here a daemon thread pulls host batches from
+    ``source``, runs ``place_fn`` (typically ``Executor.shard_batch`` —
+    the H2D transfer) and parks up to ``depth`` device-resident batches,
+    so the accelerator never waits on the host path.
+
+    Iteration ends when ``source`` does; errors in the worker re-raise
+    at the consuming ``next()`` call.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        source: Iterable[Dict[str, np.ndarray]],
+        place_fn: Callable[[Dict[str, np.ndarray]], Dict],
+        depth: int = 2,
+    ):
+        assert depth >= 1
+        self._terminal: Optional[BaseException] = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._source = iter(source)
+        self._place = place_fn
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                self._q.put(self._place(batch))
+            self._q.put(self._DONE)
+        except BaseException as e:  # surfaced at next()
+            self._q.put(e)
+
+    def __iter__(self) -> Iterator[Dict]:
+        return self
+
+    def __next__(self) -> Dict:
+        # Terminal states are sticky: once exhausted, errored, or
+        # closed, every further next() raises instead of blocking on a
+        # queue with no producer left.
+        if self._terminal is not None:
+            if isinstance(self._terminal, BaseException):
+                raise self._terminal
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._terminal = StopIteration()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._terminal = item
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        self._terminal = self._terminal or StopIteration()
+        # Unblock a worker stuck on a full queue.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
 
 
 def synthetic_arrays(

@@ -22,6 +22,7 @@ from flexflow_tpu.ops import (
     Add,
     BatchNorm,
     Concat,
+    DotInteraction,
     Conv2D,
     Embedding,
     Flat,
@@ -251,6 +252,12 @@ class FFModel:
 
     def flat(self, x: TensorSpec, name: Optional[str] = None) -> TensorSpec:
         return self._add(Flat(self._unique("flat", name), x))
+
+    def dot_interaction(self, dense: TensorSpec, sparse: TensorSpec,
+                        name: Optional[str] = None) -> TensorSpec:
+        """DLRM pairwise-dot interaction (completes the reference's
+        --arch-interaction-op TODO, ``dlrm.cc:49-65``)."""
+        return self._add(DotInteraction(self._unique("interact", name), dense, sparse))
 
     def reshape(self, x: TensorSpec, shape: Sequence[int], name: Optional[str] = None) -> TensorSpec:
         return self._add(Reshape(self._unique("reshape", name), x, shape))

@@ -130,10 +130,7 @@ def build_dlrm(
             name="embeddings",
             kernel_initializer=UniformInitializer(-rng, rng),
         )
-        flat_emb = ff.reshape(
-            emb, (batch_size, num_tables * dlrm.sparse_feature_size), name="emb_flat"
-        )
-        towers = [flat_emb]
+        towers = None  # built per interaction branch (avoid dead ops)
     else:
         towers = []
         for i, vocab in enumerate(dlrm.embedding_size):
@@ -145,9 +142,24 @@ def build_dlrm(
                              kernel_initializer=UniformInitializer(-rng, rng))
             )
 
-    # Interaction (reference supports only "cat", ``dlrm.cc:49-65``).
-    assert dlrm.arch_interaction_op == "cat", "only 'cat' interaction supported"
-    z = ff.concat([x] + towers, axis=1, name="concat")
+    # Interaction.  The reference ships "cat" and leaves "dot" a TODO
+    # (``dlrm.cc:49-65``); both are implemented here.
+    if dlrm.arch_interaction_op == "cat":
+        if towers is None:
+            towers = [ff.reshape(
+                emb, (batch_size, num_tables * dlrm.sparse_feature_size),
+                name="emb_flat",
+            )]
+        z = ff.concat([x] + towers, axis=1, name="concat")
+    elif dlrm.arch_interaction_op == "dot":
+        assert uniform_vocab, (
+            "'dot' interaction needs uniform tables (stacked embedding)"
+        )
+        z = ff.dot_interaction(x, emb, name="interact")
+    else:
+        raise ValueError(
+            f"unknown arch_interaction_op {dlrm.arch_interaction_op!r}"
+        )
     assert z.shape[1] == dlrm.mlp_top[0], (
         f"top MLP input {dlrm.mlp_top[0]} != interaction width {z.shape[1]}"
     )

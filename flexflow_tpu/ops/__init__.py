@@ -6,7 +6,7 @@ from flexflow_tpu.ops.linear import Linear
 from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
 from flexflow_tpu.ops.norm import BatchNorm
 from flexflow_tpu.ops.rnn import LSTM
-from flexflow_tpu.ops.tensor_ops import Add, Concat, Reshape
+from flexflow_tpu.ops.tensor_ops import Add, Concat, DotInteraction, Reshape
 
 __all__ = [
     "Op",
@@ -23,6 +23,7 @@ __all__ = [
     "LSTM",
     "Add",
     "Concat",
+    "DotInteraction",
     "LayerNorm",
     "MultiHeadAttention",
     "PositionEmbedding",

@@ -73,3 +73,38 @@ class Reshape(Op):
     def forward(self, params, xs, state, training):
         (x,) = xs
         return [x.reshape(self.outputs[0].shape)], state
+
+
+class DotInteraction(Op):
+    """DLRM pairwise-dot feature interaction.
+
+    The reference ships only the concat interaction and leaves dot as a
+    TODO (``examples/DLRM/dlrm.cc:49-65`` "TODO: implement dot
+    attention"); this op completes the --arch-interaction-op surface.
+    Inputs: dense features (batch, d) and stacked embeddings
+    (batch, T, d).  Output: dense features concatenated with the
+    strictly-lower-triangular pairwise dot products of the T+1 feature
+    vectors — (batch, d + (T+1)T/2), the standard DLRM formulation.
+    One batched (T+1, d)x(d, T+1) matmul per sample on the MXU.
+    """
+
+    def __init__(self, name: str, dense: TensorSpec, sparse: TensorSpec):
+        super().__init__(name, [dense, sparse])
+        assert dense.ndim == 2 and sparse.ndim == 3, (dense.shape, sparse.shape)
+        assert dense.shape[0] == sparse.shape[0]
+        assert dense.shape[1] == sparse.shape[2], (
+            f"{name}: dense dim {dense.shape[1]} != feature dim {sparse.shape[2]}"
+        )
+        b, t, d = sparse.shape
+        f = t + 1
+        out_dim = d + (f * (f - 1)) // 2
+        self._make_output((b, out_dim), dense.dtype, ("n", None))
+
+    def forward(self, params, xs, state, training):
+        dense, sparse = xs
+        feats = jnp.concatenate([dense[:, None, :], sparse], axis=1)  # (b,F,d)
+        dots = jnp.einsum("bfd,bgd->bfg", feats, feats)  # (b,F,F)
+        f = feats.shape[1]
+        li, lj = jnp.tril_indices(f, k=-1)
+        pairs = dots[:, li, lj]  # (b, F(F-1)/2)
+        return [jnp.concatenate([dense, pairs.astype(dense.dtype)], axis=1)], state

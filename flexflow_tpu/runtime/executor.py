@@ -62,6 +62,7 @@ class Executor:
         for op in model.layers:
             for t in op.inputs:
                 self._consumer.setdefault(t.name, op)
+        self._accum_cache: Dict[int, Any] = {}
 
     # -- sharding assembly -------------------------------------------------
 
@@ -211,6 +212,64 @@ class Executor:
     @functools.cached_property
     def train_step(self):
         return jax.jit(self.build_train_step(), donate_argnums=(0, 1, 2))
+
+    # -- gradient accumulation ---------------------------------------------
+
+    def accum_train_step(self, accum_steps: int):
+        """A train step over ``accum_steps`` stacked microbatches: one
+        optimizer update from the mean of per-microbatch gradients.
+
+        Each input tensor arrives shaped ``(accum_steps,) + t.shape``
+        (see :meth:`stack_microbatches`).  Losses are batch means, so
+        averaging microbatch gradients is exactly the full-batch
+        gradient; HBM holds one microbatch of activations at a time
+        (``lax.scan``), which is how batch sizes beyond memory run.
+        Count-like metrics (integer dtypes) are summed across
+        microbatches, means are averaged.
+        """
+        cached = self._accum_cache.get(accum_steps)
+        if cached is not None:
+            return cached
+        for op in self.model.layers:
+            if op.is_loss and getattr(op, "reduction", "mean") != "mean":
+                # Sum-reduced losses would need grad SUM across
+                # microbatches; the mean below would shrink the step by
+                # accum_steps silently.
+                raise ValueError(
+                    f"gradient accumulation requires mean-reduction "
+                    f"losses; {op.name!r} uses {op.reduction!r}"
+                )
+
+        def step(params, opt_state, state, stacked):
+            def micro(carry_state, batch):
+                (loss, (metrics, new_state)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True
+                )(params, carry_state, batch)
+                return new_state, (metrics, grads)
+
+            new_state, (metrics, grads) = jax.lax.scan(micro, state, stacked)
+            g = jax.tree.map(lambda x: jnp.mean(x, axis=0), grads)
+            m = {
+                k: jnp.sum(v, axis=0)
+                if jnp.issubdtype(v.dtype, jnp.integer)
+                else jnp.mean(v, axis=0)
+                for k, v in metrics.items()
+            }
+            new_params, new_opt = self.optimizer.update(params, opt_state, g)
+            return new_params, new_opt, new_state, m
+
+        fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._accum_cache[accum_steps] = fn
+        return fn
+
+    def stack_microbatches(self, batch: Dict[str, Any], accum_steps: int):
+        """Reshape a ``(accum*b, ...)`` host batch into the
+        ``(accum, b, ...)`` layout ``accum_train_step`` scans over."""
+        out = {}
+        for k, v in batch.items():
+            assert v.shape[0] % accum_steps == 0, (k, v.shape, accum_steps)
+            out[k] = v.reshape((accum_steps, v.shape[0] // accum_steps) + v.shape[1:])
+        return out
 
     @functools.cached_property
     def eval_step(self):

@@ -131,3 +131,27 @@ class Trainer:
             "batch_size": batch_size,
             "loss": float(self.metrics.avg_loss),
         }
+
+    def evaluate(
+        self,
+        params,
+        state,
+        batches: Iterable[Dict[str, Any]],
+        iterations: Optional[int] = None,
+    ) -> Dict[str, float]:
+        """Held-out evaluation over ``batches`` (host or device dicts);
+        returns mean loss and accuracy.  The reference computes metrics
+        only inside the training backward (``mse_loss.cu:61-112``); a
+        read-only eval pass is this rebuild's addition."""
+        ex = self.ex
+        pm = PerfMetrics()
+        for it, batch in enumerate(batches):
+            if iterations is not None and it >= iterations:
+                break
+            _, m = ex.eval_step(params, state, ex.shard_batch(batch))
+            pm.update(jax.device_get(m))
+        return {
+            "loss": pm.avg_loss,
+            "accuracy": pm.accuracy,
+            "batches": pm.steps,
+        }

@@ -71,3 +71,40 @@ def test_synthetic_arrays_respects_dtypes():
     assert arrays["sparse_input"].dtype == np.int32
     assert arrays["sparse_input"].max() < 32
     assert arrays["dense_input"].dtype == np.float32
+
+
+def test_csv_loader_roundtrip(tmp_path):
+    from flexflow_tpu.data.csv import load_csv_matrix, load_feature_csvs
+
+    p1 = tmp_path / "dose.csv"
+    p1.write_text("dose\n0.5\n1.5\n2.5\n")
+    p2 = tmp_path / "rnaseq.csv"
+    p2.write_text("a,b\n1,2\n3,4\n5,6\n")
+    m = load_csv_matrix(str(p1))
+    assert m.shape == (3, 1) and m.dtype == np.float32
+    feats = load_feature_csvs({"dose1": str(p1), "cell.rnaseq": str(p2)},
+                              expected_dims={"cell.rnaseq": 2})
+    assert feats["cell.rnaseq"].shape == (3, 2)
+
+
+def test_csv_loader_errors(tmp_path):
+    import pytest
+    from flexflow_tpu.data.csv import load_csv_matrix, load_feature_csvs
+
+    bad = tmp_path / "bad.csv"
+    bad.write_text("h\n1\nxyz\n")
+    with pytest.raises(ValueError, match="non-numeric"):
+        load_csv_matrix(str(bad))
+    a = tmp_path / "a.csv"; a.write_text("h\n1\n2\n")
+    b = tmp_path / "b.csv"; b.write_text("h\n1\n")
+    with pytest.raises(ValueError, match="row-count"):
+        load_feature_csvs({"a": str(a), "b": str(b)})
+
+
+def test_csv_headerless_keeps_all_rows(tmp_path):
+    from flexflow_tpu.data.csv import load_csv_matrix
+
+    p = tmp_path / "nohdr.csv"
+    p.write_text("1,2\n3,4\n5,6\n")
+    assert load_csv_matrix(str(p)).shape == (3, 2)  # auto keeps row 1
+    assert load_csv_matrix(str(p), skip_header=True).shape == (2, 2)
