@@ -19,7 +19,22 @@ def main(argv=None) -> int:
     cfg = FFConfig.parse_args(sys.argv[1:] if argv is None else argv)
     ff = build_candle_uno(batch_size=cfg.batch_size, candle=CandleConfig(),
                           config=cfg)
-    run_training(ff, cfg)
+    arrays = None
+    if cfg.dataset_path:
+        # -d <dir>: one CSV per model input tensor, "<dir>/<name>.csv"
+        # (the candle per-feature-file layout).
+        import os
+
+        from flexflow_tpu.data.csv import load_feature_csvs
+
+        paths = {
+            t.name: os.path.join(cfg.dataset_path, f"{t.name}.csv")
+            for t in ff.input_tensors
+        }
+        arrays = load_feature_csvs(
+            paths, expected_dims={t.name: t.shape[1] for t in ff.input_tensors}
+        )
+    run_training(ff, cfg, arrays=arrays)
     return 0
 
 

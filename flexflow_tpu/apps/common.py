@@ -43,10 +43,15 @@ def run_training(
     int_high: Optional[Dict[str, int]] = None,
     label: str = "samples",
     num_samples: Optional[int] = None,
+    arrays: Optional[Dict[str, np.ndarray]] = None,
 ) -> Dict[str, float]:
-    """Build the executor, feed synthetic (or loader-provided) batches,
-    run ``cfg.epochs x cfg.iterations`` fenced steps, and print the
-    reference throughput lines (``cnn.cc:128-129``, ``dlrm.cc:159-166``).
+    """Build the executor, feed batches, run ``cfg.epochs x
+    cfg.iterations`` fenced steps, and print the reference throughput
+    lines (``cnn.cc:128-129``, ``dlrm.cc:159-166``).
+
+    ``arrays`` is an app-loaded dataset (``-d``); otherwise synthetic
+    arrays are generated when ``num_samples`` is set, else one fixed
+    device-resident synthetic batch (the reference's syntheticInput).
     """
     ndev = cfg.resolve_num_devices()
     if strategy is None:
@@ -61,14 +66,14 @@ def run_training(
     )
     trainer = Trainer(ex)
     batches = None
-    if not cfg.synthetic_input and cfg.dataset_path:
+    if arrays is None and cfg.dataset_path:
         raise SystemExit(
-            "dataset files are app-specific; this app only supports "
-            "synthetic input (drop -d)"
+            "this app has no -d loader; drop -d for synthetic input"
         )
-    if num_samples is not None:
+    if arrays is None and num_samples is not None:
         arrays = synthetic_arrays(ff, num_samples, seed=cfg.seed,
                                   int_high=int_high)
+    if arrays is not None:
         # Background prefetch overlaps the host gather + H2D transfer
         # with the device step (the reference's double-buffered ZC
         # staging); Trainer.fit's own shard_batch is then a no-op.

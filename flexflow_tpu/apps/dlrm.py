@@ -42,7 +42,16 @@ def main(argv=None) -> int:
     ndev = cfg.resolve_num_devices()
     strategy = load_strategy(cfg, ndev) or dlrm_strategy(ndev, dlrm)
     int_high = {"sparse_input": min(dlrm.embedding_size)}
-    run_training(ff, cfg, strategy=strategy, int_high=int_high)
+    arrays = None
+    if cfg.dataset_path:
+        # The reference's Criteo HDF5 schema (dlrm.cc:239-281).
+        from flexflow_tpu.data.criteo import make_dlrm_arrays
+
+        arrays = make_dlrm_arrays(
+            dlrm, num_samples=cfg.batch_size * max(cfg.iterations, 1) * 2,
+            path=cfg.dataset_path,
+        )
+    run_training(ff, cfg, strategy=strategy, int_high=int_high, arrays=arrays)
     return 0
 
 

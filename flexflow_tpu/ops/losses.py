@@ -12,14 +12,24 @@ output — the future-chaining machinery collapses into the return value
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
+from flexflow_tpu.ops import pallas_kernels
 from flexflow_tpu.ops.base import Op, TensorSpec
 
 
 class SoftmaxCrossEntropy(Op):
-    """Softmax + cross-entropy against int labels, mean over batch."""
+    """Softmax + cross-entropy against int labels, mean over batch.
+
+    Large vocabularies take the fused Pallas kernel
+    (``pallas_kernels.softmax_xent``): one streaming pass per row, no
+    HBM softmax materialization — the rebuilt form of the reference's
+    fused softmax+loss chain (``softmax.cu:91-160``).
+    """
 
     is_loss = True
 
@@ -35,16 +45,63 @@ class SoftmaxCrossEntropy(Op):
         # averaged over every leading dim.
         self._make_output(logits.shape, logits.dtype, logits.dim_axes)
 
+    # -- fused kernel routing ----------------------------------------------
+
+    def _fused_nll_pred(self, logits, labels):
+        """Per-row (nll, pred) via the Pallas kernel, or None to fall
+        back.  Multi-device: shard_map over the batch/sequence axes
+        (vocab stays whole per device — a Mosaic custom call has no
+        GSPMD partitioning rule)."""
+        v = logits.shape[-1]
+        rows_shape = logits.shape[:-1]
+        plan = getattr(self, "_plan", None)
+        flat = lambda a: a.reshape((-1,) + a.shape[len(rows_shape):])
+        if plan is None or plan.num_devices == 1:
+            n = math.prod(rows_shape)
+            if not pallas_kernels.xent_supported(n, v):
+                return None
+            nll, _, pred = pallas_kernels.softmax_xent(flat(logits), flat(labels))
+            return nll.reshape(rows_shape), pred.reshape(rows_shape)
+        axes = ["n", "s"][: len(rows_shape)]
+        entries = plan.local_degrees(self._pc, *axes)
+        local_rows = 1
+        for dim, (_, deg) in zip(rows_shape, entries):
+            if dim % deg:
+                return None
+            local_rows *= dim // deg
+        if not pallas_kernels.xent_supported(local_rows, v):
+            return None
+        row_spec = PartitionSpec(*(e for e, _ in entries))
+        logit_spec = PartitionSpec(*(e for e, _ in entries), None)
+
+        def local_fn(lg, lb):
+            local_shape = lb.shape
+            nll, _, pred = pallas_kernels.softmax_xent(flat(lg), flat(lb))
+            return nll.reshape(local_shape), pred.reshape(local_shape)
+
+        return jax.shard_map(
+            local_fn,
+            mesh=plan.mesh,
+            in_specs=(logit_spec, row_spec),
+            out_specs=(row_spec, row_spec),
+            check_vma=False,
+        )(logits, labels)
+
     def forward(self, params, xs, state, training):
         logits, labels = xs
         logits = logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
-        logp = logits - lse
-        nll = -jnp.take_along_axis(
-            logp, labels[..., None].astype(jnp.int32), axis=-1
-        )[..., 0]
+        labels = labels.astype(jnp.int32)
+        fused = self._fused_nll_pred(logits, labels)
+        if fused is not None:
+            nll, pred = fused
+            # Probabilities only if a consumer reads them (DCE'd else).
+            logp = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        else:
+            lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+            logp = logits - lse
+            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+            pred = jnp.argmax(logits, axis=-1)
         loss = jnp.mean(nll)
-        pred = jnp.argmax(logits, axis=-1)
         correct = jnp.sum((pred == labels).astype(jnp.int32))
         metrics = {
             "train_loss": loss,

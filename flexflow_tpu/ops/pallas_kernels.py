@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 # Keep resident K/V (+ per-step blocks) comfortably inside ~16 MB VMEM.
@@ -337,3 +338,152 @@ def flash_attention(q, k, v, causal: bool = True,
                     interpret: Optional[bool] = None):
     """Flash attention returning just the output (dense, non-ring use)."""
     return flash_attention_lse(q, k, v, causal, interpret)[0]
+
+
+# ---------------------------------------------------------------------------
+# fused softmax + cross-entropy (the reference's fused softmax/loss op,
+# src/ops/softmax.cu:91-160, rebuilt as a vocab-blocked streaming kernel)
+# ---------------------------------------------------------------------------
+
+_XENT_BLOCK_N = 128
+_XENT_BLOCK_V = 512
+
+
+def xent_supported(n: int, v: int) -> bool:
+    """Gate for the fused kernel: the vocab dim must be large enough to
+    be worth streaming and both dims must tile."""
+    if v < 2 * _XENT_BLOCK_V or v % _XENT_BLOCK_V:
+        return False
+    return n >= 8 and _pick_block(n, _XENT_BLOCK_N) >= 8
+
+
+def _xent_fwd_kernel(logits_ref, labels_ref, nll_ref, lse_ref, pred_ref,
+                     m_scr, l_scr, t_scr, am_scr, *, block_v):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        t_scr[:] = jnp.zeros_like(t_scr)
+        am_scr[:] = jnp.zeros_like(am_scr)
+
+    x = logits_ref[:].astype(jnp.float32)               # (bn, bv)
+    bn = x.shape[0]
+    bmax = jnp.max(x, axis=1, keepdims=True)
+    bidx = jnp.argmax(x, axis=1).astype(jnp.int32)[:, None] + j * block_v
+    # Streaming logsumexp + running argmax.
+    m_old = m_scr[:]
+    m_new = jnp.maximum(m_old, bmax)
+    l_scr[:] = l_scr[:] * jnp.exp(m_old - m_new) + jnp.sum(
+        jnp.exp(x - m_new), axis=1, keepdims=True
+    )
+    am_scr[:] = jnp.where(bmax > m_old, bidx, am_scr[:])
+    m_scr[:] = m_new
+    # Target logit: the label column, if it falls in this vocab block.
+    lbl = labels_ref[:, 0:1]
+    col = lbl - j * block_v
+    cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    tv = jnp.sum(jnp.where(cols == col, x, 0.0), axis=1, keepdims=True)
+    in_blk = (col >= 0) & (col < block_v)
+    t_scr[:] = t_scr[:] + jnp.where(in_blk, tv, 0.0)
+
+    @pl.when(j == nv - 1)
+    def _():
+        lse = m_scr[:] + jnp.log(l_scr[:])
+        lse_ref[:] = lse
+        nll_ref[:] = lse - t_scr[:]
+        pred_ref[:] = am_scr[:]
+
+
+def _xent_bwd_kernel(logits_ref, labels_ref, lse_ref, gn_ref, gl_ref,
+                     dlogits_ref, *, block_v):
+    j = pl.program_id(1)
+    x = logits_ref[:].astype(jnp.float32)
+    p = jnp.exp(x - lse_ref[:])                         # softmax block
+    lbl = labels_ref[:, 0:1]
+    col = lbl - j * block_v
+    cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (cols == col).astype(jnp.float32)
+    g_nll = gn_ref[:]
+    g_lse = gl_ref[:]
+    # d nll/d x = p - onehot ; d lse/d x = p.
+    dlogits_ref[:] = (
+        p * (g_nll + g_lse) - onehot * g_nll
+    ).astype(dlogits_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_xent(logits, labels, interpret: Optional[bool] = None):
+    """Fused cross-entropy over (N, V) logits with int (N,) labels.
+
+    One streaming pass over the vocab per row: returns per-row
+    ``(nll, lse, pred)`` without materializing the softmax in HBM —
+    the TPU form of the reference's fused softmax+loss kernel chain
+    (``softmax.cu:91-160``, ``SoftmaxLossBackprop``).
+    """
+    (out, _) = _xent_fwd(logits, labels, interpret)
+    return out
+
+
+def _xent_calls(n, v, dtype, interpret):
+    block_n = _pick_block(n, _XENT_BLOCK_N)
+    block_v = _XENT_BLOCK_V
+    grid = (n // block_n, v // block_v)
+    row = pl.BlockSpec((block_n, 1), lambda i, j: (i, 0))
+    blk = pl.BlockSpec((block_n, block_v), lambda i, j: (i, j))
+    fwd = pl.pallas_call(
+        functools.partial(_xent_fwd_kernel, block_v=block_v),
+        grid=grid,
+        in_specs=[blk, row],
+        out_specs=[row, row, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+    bwd = pl.pallas_call(
+        functools.partial(_xent_bwd_kernel, block_v=block_v),
+        grid=grid,
+        in_specs=[blk, row, row, row, row],
+        out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct((n, v), dtype),
+        interpret=interpret,
+    )
+    return fwd, bwd
+
+
+def _xent_fwd(logits, labels, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    n, v = logits.shape
+    fwd, _ = _xent_calls(n, v, logits.dtype, interpret)
+    nll, lse, pred = fwd(logits, labels.astype(jnp.int32)[:, None])
+    out = (nll[:, 0], lse[:, 0], pred[:, 0])
+    return out, (logits, labels, lse)
+
+
+def _xent_bwd(interpret, res, g):
+    if interpret is None:
+        interpret = _interpret_default()
+    logits, labels, lse = res
+    g_nll, g_lse, _ = g  # pred is integer-valued: no cotangent
+    n, v = logits.shape
+    _, bwd = _xent_calls(n, v, logits.dtype, interpret)
+    zeros = jnp.zeros((n, 1), jnp.float32)
+    gn = zeros if g_nll is None else g_nll.astype(jnp.float32)[:, None]
+    gl = zeros if g_lse is None else g_lse.astype(jnp.float32)[:, None]
+    dlogits = bwd(logits, labels.astype(jnp.int32)[:, None], lse, gn, gl)
+    return (dlogits, None)
+
+
+softmax_xent.defvjp(_xent_fwd, _xent_bwd)

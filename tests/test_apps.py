@@ -61,3 +61,40 @@ def test_transformer_app_hybrid(capsys):
         "--dp", "2", "--sp", "2", "--tp", "2",
     ]) == 0
     assert "tokens/s" in capsys.readouterr().out
+
+
+def test_dlrm_app_reads_criteo_h5(tmp_path, capsys):
+    """-d <criteo.h5> end-to-end through the reference H5 schema."""
+    import h5py
+
+    n, T = 128, 4
+    r = np.random.default_rng(0)
+    with h5py.File(tmp_path / "criteo.h5", "w") as f:
+        f["X_int"] = r.standard_normal((n, 8)).astype(np.float32)
+        f["X_cat"] = r.integers(0, 100, size=(n, T)).astype(np.int64)
+        f["y"] = r.integers(0, 2, size=n).astype(np.float32)
+    assert dlrm.main([
+        "-b", "16", "-i", "2", "-d", str(tmp_path / "criteo.h5"),
+        "--arch-sparse-feature-size", "8",
+        "--arch-embedding-size", "100-100-100-100",
+        "--arch-mlp-bot", "8-16-8",
+        "--arch-mlp-top", "40-16-1",
+    ]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out
+
+
+def test_candle_app_reads_csv_dir(tmp_path, capsys):
+    """-d <dir> with one CSV per input tensor."""
+    from flexflow_tpu.models.candle_uno import CandleConfig, build_candle_uno
+
+    ff = build_candle_uno(batch_size=4, candle=CandleConfig())
+    r = np.random.default_rng(0)
+    n = 16
+    for t in ff.input_tensors:
+        rows = "\n".join(
+            ",".join(f"{v:.3f}" for v in r.standard_normal(t.shape[1]))
+            for _ in range(n)
+        )
+        (tmp_path / f"{t.name}.csv").write_text(rows + "\n")
+    assert candle_uno.main(["-b", "4", "-i", "2", "-d", str(tmp_path)]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out

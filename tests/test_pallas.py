@@ -109,3 +109,57 @@ def test_flash_supported_gating():
     assert not pk.flash_supported((2, 2, 8, 64))      # too short
     assert not pk.flash_supported((2, 128, 64))       # wrong rank
     assert not pk.flash_supported((1, 1, 1 << 17, 128))  # K/V exceed VMEM
+
+
+# -- fused softmax cross-entropy -------------------------------------------
+
+
+def _xent_oracle(logits, labels):
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    nll = lse - jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+    return nll, lse, jnp.argmax(logits, axis=-1)
+
+
+def test_xent_forward_matches_oracle(rng):
+    n, v = 32, 2048
+    logits = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, size=n), jnp.int32)
+    nll, lse, pred = pk.softmax_xent(logits, labels)
+    rn, rl, rp = _xent_oracle(logits, labels)
+    np.testing.assert_allclose(np.asarray(nll), np.asarray(rn), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(rl), atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(rp))
+
+
+def test_xent_grads_match_oracle(rng):
+    n, v = 16, 1024
+    logits = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, size=n), jnp.int32)
+
+    def loss_k(lg):
+        nll, lse, _ = pk.softmax_xent(lg, labels)
+        return jnp.mean(nll) + 0.1 * jnp.sum(lse)
+
+    def loss_o(lg):
+        rn, rl, _ = _xent_oracle(lg, labels)
+        return jnp.mean(rn) + 0.1 * jnp.sum(rl)
+
+    gk = jax.grad(loss_k)(logits)
+    go = jax.grad(loss_o)(logits)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(go), atol=1e-5)
+
+
+def test_xent_bfloat16(rng):
+    n, v = 16, 1024
+    logits = jnp.asarray(rng.standard_normal((n, v)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, v, size=n), jnp.int32)
+    nll, _, _ = pk.softmax_xent(logits, labels)
+    rn, _, _ = _xent_oracle(logits.astype(jnp.float32), labels)
+    np.testing.assert_allclose(np.asarray(nll), np.asarray(rn), atol=5e-2)
+
+
+def test_xent_supported_gating():
+    assert pk.xent_supported(128, 2048)
+    assert not pk.xent_supported(128, 512)    # vocab too small to stream
+    assert not pk.xent_supported(128, 1000)   # not tiled by block_v
+    assert not pk.xent_supported(4, 2048)     # too few rows
