@@ -1,40 +1,161 @@
-"""Headline benchmark: AlexNet training throughput on real TPU.
+"""Headline benchmarks on the live backend (TPU when reachable).
 
-Mirrors the reference's measurement protocol exactly — N timed
-iterations between fences, ``tp = iters*batch/elapsed`` images/s
-(``cnn.cc:122-129``).  Prints ONE JSON line for the driver.
+Measurement protocol mirrors the reference exactly — fence
+(``block_until_ready``), N timed iterations, ``tp = iters*batch/elapsed``
+images/s (``cnn.cc:122-129``) and ``THROUGHPUT = samples/elapsed``
+samples/s (``dlrm.cc:165-166``).
 
-The reference publishes no absolute numbers (BASELINE.md); the target
-we normalize against is the 4×V100 AlexNet figure the driver's
-BASELINE.json names — approximated here as 1500 img/s per the ICML'18
-era hardware — so ``vs_baseline`` is imgs/sec/chip over (target/4).
+Prints ONE JSON line for the driver.  Primary metric: AlexNet
+images/s/chip (the reference's canonical app).  The ``extra`` field
+carries DLRM samples/s (``run_random.sh`` shape), MFU vs the v5e bf16
+roofline, platform, and batch size.
+
+Robust to a flaky TPU tunnel (round-1 postmortem: ``jax.devices()``
+can HANG or raise UNAVAILABLE under the axon sitecustomize): the
+backend is probed in a timeout-bounded subprocess with retries and
+backoff; on final failure we fall back to CPU so the round still
+records a parseable artifact, and any error is reported as structured
+JSON — never a bare traceback.
 """
 
+import contextlib
 import json
+import os
+import subprocess
 import sys
+import time
+import traceback
 
-import jax
+#: 4xV100 AlexNet target (BASELINE.md "match 4xV100 on v5e-4"), per chip.
+#: The reference publishes no absolute number; 1500 img/s total is the
+#: ICML'18-era figure the driver's BASELINE.json names.
+BASELINE_IMGS_PER_SEC_PER_CHIP = 1500.0 / 4.0
 
-BASELINE_IMGS_PER_SEC_PER_CHIP = 1500.0 / 4.0  # 4xV100 AlexNet target, per chip
+#: TPU v5e bf16 peak (matches search/cost_model.DeviceModel, which uses
+#: 1.97e14 * 0.5 as its *achievable* rate; MFU divides by the raw peak).
+V5E_BF16_PEAK_FLOPS = 1.97e14
+
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
 
 
-def main():
+def probe_backend():
+    """Decide the platform WITHOUT touching the backend in-process.
+
+    ``jax.devices()`` on a broken tunnel hangs indefinitely, so the
+    probe runs in a subprocess under a hard timeout, with retries and
+    linear backoff.  Returns (platform, error_or_None).
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu", None
+    code = (
+        "import jax; d = jax.devices(); "
+        "print('PLATFORM=' + jax.default_backend(), len(d))"
+    )
+    last_err = None
+    for attempt in range(PROBE_RETRIES):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            if out.returncode == 0 and "PLATFORM=" in out.stdout:
+                platform = out.stdout.split("PLATFORM=")[1].split()[0]
+                return platform, None
+            last_err = f"probe rc={out.returncode}: {out.stderr.strip()[-500:]}"
+        except subprocess.TimeoutExpired:
+            last_err = f"probe timed out after {PROBE_TIMEOUT_S}s (backend hang)"
+        if attempt < PROBE_RETRIES - 1:
+            time.sleep(5.0 * (attempt + 1))
+    return "cpu", last_err
+
+
+def _train_flops(ff) -> float:
+    """Analytic train-step flops from the op graph (fwd * 3 for
+    fwd+bwd, ``cost_model.FWD_BWD_FACTOR``)."""
+    from flexflow_tpu.search.cost_model import FWD_BWD_FACTOR, op_cost
+
+    return FWD_BWD_FACTOR * sum(op_cost(op).flops for op in ff.layers)
+
+
+def bench_alexnet(n_chips: int, on_tpu: bool):
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.models.alexnet import build_alexnet
     from flexflow_tpu.optim import SGDOptimizer
     from flexflow_tpu.runtime.executor import Executor
     from flexflow_tpu.runtime.trainer import Trainer
 
-    # Swept 256/512/1024 on v5e: 512 is the per-chip throughput peak.
-    batch_size = 512
-    n_chips = len(jax.devices())
+    batch_size = int(os.environ.get("BENCH_BATCH", "512" if on_tpu else "32"))
+    iters = 20 if on_tpu else 5
     cfg = FFConfig(batch_size=batch_size, compute_dtype="bfloat16")
     ff = build_alexnet(batch_size=batch_size, image_size=229, num_classes=1000,
                        config=cfg)
-    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.01, momentum=0.9, weight_decay=1e-4))
-    trainer = Trainer(ex)
-    stats = trainer.fit(iterations=20, warmup=3)
+    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.01, momentum=0.9,
+                                             weight_decay=1e-4))
+    stats = Trainer(ex).fit(iterations=iters, warmup=3)
     per_chip = stats["samples_per_s"] / n_chips
+    mfu = (_train_flops(ff) / batch_size) * stats["samples_per_s"] / (
+        V5E_BF16_PEAK_FLOPS * n_chips
+    )
+    return per_chip, mfu, batch_size
+
+
+def bench_dlrm(n_chips: int, on_tpu: bool):
+    """``run_random.sh`` shape: 8 x 1M-row x 64-dim tables, 256
+    samples/chip/iter (``dlrm.cc:165-166``; tables shrunk on the CPU
+    fallback where the 2 GB of tables would swamp the probe)."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models.dlrm import (
+        build_dlrm,
+        dlrm_random_benchmark_config,
+        dlrm_strategy,
+    )
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    cfg = dlrm_random_benchmark_config(num_tables=8)
+    if not on_tpu:
+        cfg.embedding_size = [10000] * 8
+    batch = 256 * n_chips
+    ff = build_dlrm(batch, cfg, config=FFConfig(batch_size=batch,
+                                                compute_dtype="bfloat16"))
+    ex = Executor(ff, strategy=dlrm_strategy(n_chips, cfg),
+                  optimizer=SGDOptimizer(lr=0.01))
+    stats = Trainer(ex).fit(iterations=10 if on_tpu else 3, warmup=2)
+    return stats["samples_per_s"]
+
+
+def main():
+    platform, probe_err = probe_backend()
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    n_chips = len(jax.devices(platform))
+    on_tpu = platform not in ("cpu",)
+
+    extra = {"platform": platform, "n_chips": n_chips}
+    if probe_err:
+        extra["tpu_probe_error"] = probe_err
+
+    # The Trainer mirrors the reference's ``tp = ...`` printouts on
+    # stdout; the driver wants exactly one JSON line there, so route
+    # everything else to stderr.
+    with contextlib.redirect_stdout(sys.stderr):
+        per_chip, mfu, batch_size = bench_alexnet(n_chips, on_tpu)
+    extra["batch_size"] = batch_size
+    extra["alexnet_mfu"] = round(mfu, 4)
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["dlrm_samples_per_s"] = round(bench_dlrm(n_chips, on_tpu), 2)
+    except Exception as e:  # DLRM failure must not sink the headline
+        extra["dlrm_error"] = f"{type(e).__name__}: {e}"
+
     print(
         json.dumps(
             {
@@ -42,10 +163,27 @@ def main():
                 "value": round(per_chip, 2),
                 "unit": "images/s/chip",
                 "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+                "extra": extra,
             }
         )
     )
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except Exception as e:
+        print(
+            json.dumps(
+                {
+                    "metric": "alexnet_imgs_per_sec_per_chip",
+                    "value": None,
+                    "unit": "images/s/chip",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc()[-1500:],
+                }
+            )
+        )
+        sys.exit(0)

@@ -20,10 +20,24 @@ import numpy as np
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data.loader import ArrayDataLoader, PrefetchLoader, synthetic_arrays
 from flexflow_tpu.graph import FFModel
-from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.optim import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.parallel.strategy import StrategyStore
-from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.pipeline import PipelineExecutor, make_executor
 from flexflow_tpu.runtime.trainer import Trainer
+
+
+def make_optimizer(cfg: FFConfig):
+    """``--optimizer sgd|adam`` (sgd matches the reference's only
+    optimizer, ``optimizer_kernel.cu:28-129``; adam is the rebuild's
+    addition)."""
+    if cfg.optimizer == "sgd":
+        return SGDOptimizer(
+            lr=cfg.learning_rate, momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay,
+        )
+    if cfg.optimizer == "adam":
+        return AdamOptimizer(lr=cfg.learning_rate, weight_decay=cfg.weight_decay)
+    raise SystemExit(f"unknown --optimizer {cfg.optimizer!r} (sgd|adam)")
 
 
 def load_strategy(cfg: FFConfig, num_devices: int) -> Optional[StrategyStore]:
@@ -56,14 +70,32 @@ def run_training(
     ndev = cfg.resolve_num_devices()
     if strategy is None:
         strategy = load_strategy(cfg, ndev)
-    ex = Executor(
+    mesh_plan = None
+    if cfg.granules > 1:
+        # Multi-host pod layout: DCN-spanning axes outermost so data
+        # parallelism rides the slow links and tp/sp stay on ICI.
+        from flexflow_tpu.parallel.distributed import build_hybrid_mesh_plan
+
+        mesh_plan = build_hybrid_mesh_plan(cfg.granules)
+    ex = make_executor(
         ff,
+        strategy,
         config=cfg,
-        strategy=strategy,
-        optimizer=SGDOptimizer(
-            lr=cfg.learning_rate, momentum=0.9, weight_decay=cfg.weight_decay
-        ),
+        optimizer=make_optimizer(cfg),
+        mesh_plan=mesh_plan,
+        microbatches=cfg.microbatches,
     )
+    if isinstance(ex, PipelineExecutor):
+        if cfg.accum_steps > 1:
+            raise SystemExit(
+                "--accum-steps composes with full-mesh strategies only; "
+                "pipeline strategies microbatch via --microbatches"
+            )
+        if mesh_plan is not None:
+            raise SystemExit(
+                "--granules (hybrid mesh) and device-subset placement "
+                "cannot combine yet"
+            )
     trainer = Trainer(ex)
     batches = None
     if arrays is None and cfg.dataset_path:
@@ -83,7 +115,8 @@ def run_training(
             ex.shard_batch,
         )
     iters = cfg.iterations * max(cfg.epochs, 1)
-    stats = trainer.fit(iterations=iters, batches=batches, warmup=1)
+    stats = trainer.fit(iterations=iters, batches=batches, warmup=1,
+                        accum_steps=cfg.accum_steps)
     print(f"ELAPSED TIME = {stats['elapsed_s']:.4f}s")
     print(f"THROUGHPUT = {stats['samples_per_s']:.2f} {label}/s")
     return stats

@@ -46,6 +46,18 @@ class FFConfig:
     seed: int = 1234  # the reference NMT fixed seed (nmt/rnn.cu:345-349)
     # Synthetic input (reference: config.h:73 syntheticInput)
     synthetic_input: bool = True
+    # Optimizer selection (reference ships SGD only; Adam is the TPU
+    # rebuild's addition — see flexflow_tpu/optim.py).
+    optimizer: str = "sgd"
+    momentum: float = 0.9
+    # Gradient accumulation: microbatches per optimizer step
+    # (Executor.accum_train_step).
+    accum_steps: int = 1
+    # Hybrid mesh granules: number of slow-interconnect islands for
+    # build_hybrid_mesh_plan (0/1 = flat single-slice mesh).
+    granules: int = 0
+    # Pipeline microbatches for device-subset (layer-wise) strategies.
+    microbatches: int = 1
 
     @staticmethod
     def parse_args(argv: Sequence[str]) -> "FFConfig":
@@ -98,6 +110,16 @@ class FFConfig:
                 cfg.compute_dtype = _next()
             elif a == "--seed":
                 cfg.seed = int(_next())
+            elif a == "--optimizer":
+                cfg.optimizer = _next().lower()
+            elif a == "--momentum":
+                cfg.momentum = float(_next())
+            elif a == "--accum-steps":
+                cfg.accum_steps = int(_next())
+            elif a == "--granules":
+                cfg.granules = int(_next())
+            elif a == "--microbatches":
+                cfg.microbatches = int(_next())
             i += 1
         return cfg
 

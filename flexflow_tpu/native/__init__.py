@@ -236,6 +236,12 @@ def gather_rows(
     if not src.flags.c_contiguous or src.ndim < 1 or src.dtype.hasobject:
         return src[idx]
     idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    if idx64.size and idx64.min() < 0:
+        # Match the numpy-fallback semantics: in-range negative indices
+        # wrap; doubly-out-of-range ones still IndexError natively.
+        idx64 = np.ascontiguousarray(
+            np.where(idx64 < 0, idx64 + src.shape[0], idx64)
+        )
     out = np.empty((len(idx64),) + src.shape[1:], dtype=src.dtype)
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     if row_bytes == 0 or len(idx64) == 0:

@@ -54,6 +54,10 @@ class SoftmaxCrossEntropy(Op):
         GSPMD partitioning rule)."""
         v = logits.shape[-1]
         rows_shape = logits.shape[:-1]
+        if len(rows_shape) > 2:
+            # Only (n,) and (n, s) row layouts have a defined sharding
+            # story here; anything deeper uses the unfused path.
+            return None
         plan = getattr(self, "_plan", None)
         flat = lambda a: a.reshape((-1,) + a.shape[len(rows_shape):])
         if plan is None or plan.num_devices == 1:

@@ -52,6 +52,20 @@ def _pick_block(t: int, target: int = 128) -> int:
     return 0
 
 
+def _require_block(t: int) -> int:
+    """``_pick_block`` for callers already committed to the kernel:
+    raises the clear error instead of launching Mosaic with an
+    unsupported block (the ``flash_supported`` gate, enforced)."""
+    block = _pick_block(t)
+    if block < 8 or t < 16:
+        raise ValueError(
+            f"flash attention needs seq >= 16 with a block divisor that "
+            f"is a multiple of 8 and <= 128; got t={t}. Gate callers on "
+            f"flash_supported()."
+        )
+    return block
+
+
 def flash_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
     """Whether the blocked kernel applies to (b, h, t, hd) attention."""
     if len(shape) != 4:
@@ -220,8 +234,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fwd_call(q, k, v, causal, interpret):
     bh, t, hd = q.shape
-    block_q = _pick_block(t)
-    block_k = _pick_block(t)
+    block_q = _require_block(t)
+    block_k = block_q
     scale = 1.0 / math.sqrt(hd)
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, scale=scale
@@ -246,8 +260,8 @@ def _fwd_call(q, k, v, causal, interpret):
 
 def _bwd_call(q, k, v, do, lse, delta, causal, interpret):
     bh, t, hd = q.shape
-    block_q = _pick_block(t)
-    block_k = _pick_block(t)
+    block_q = _require_block(t)
+    block_k = block_q
     scale = 1.0 / math.sqrt(hd)
     full = pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0))
     full_r = pl.BlockSpec((1, t, LSE_LANES), lambda b, i: (b, 0, 0))

@@ -105,11 +105,10 @@ class AdamOptimizer:
             upd = mh / (jnp.sqrt(vh) + self.eps)
             if self.weight_decay > 0.0:
                 upd = upd + self.weight_decay * pf  # AdamW-style decoupled
-            return (pf - self.lr * upd).astype(p.dtype)
+            return (pf - self.lr * upd).astype(p.dtype), m_new, v_new
 
-        new_params = jax.tree.map(step, params, grads, opt_state["m"], opt_state["v"])
-        new_m = jax.tree.map(lambda g, m, v: moments(g, m, v)[0],
-                             grads, opt_state["m"], opt_state["v"])
-        new_v = jax.tree.map(lambda g, m, v: moments(g, m, v)[1],
-                             grads, opt_state["m"], opt_state["v"])
+        triples = jax.tree.map(step, params, grads, opt_state["m"], opt_state["v"])
+        new_params, new_m, new_v = jax.tree.transpose(
+            jax.tree.structure(params), jax.tree.structure((0, 0, 0)), triples
+        )
         return new_params, {"m": new_m, "v": new_v, "t": t}

@@ -27,6 +27,7 @@ data layout.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,6 +36,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from flexflow_tpu.parallel.strategy import ParallelConfig
+
+_log = logging.getLogger("ff.mesh")
 
 
 class InfeasibleStrategyError(ValueError):
@@ -64,6 +67,7 @@ class MeshPlan:
 
     def __post_init__(self):
         self._assign_cache: Dict[ParallelConfig, Dict[str, Tuple[str, ...]]] = {}
+        self._warned_drops: set = set()
 
     @property
     def num_devices(self) -> int:
@@ -147,6 +151,17 @@ class MeshPlan:
                     if dim % (prod * size_of[ax]) == 0:
                         kept.append(ax)
                         prod *= size_of[ax]
+                if len(kept) != len(axes):
+                    dropped = tuple(ax for ax in axes if ax not in kept)
+                    key = (sem, dropped, i, dim)
+                    if key not in self._warned_drops:  # once per shape
+                        self._warned_drops.add(key)
+                        _log.warning(
+                            "partial sharding: axis %r (%s) does not divide "
+                            "dim %d (extent %d); dropping %s — that factor "
+                            "runs replicated",
+                            sem, "x".join(dropped), i, dim, list(dropped),
+                        )
                 axes = tuple(kept)
             entries.append(axes if len(axes) != 1 else axes[0])
         # PartitionSpec treats () like None.

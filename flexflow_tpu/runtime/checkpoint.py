@@ -13,10 +13,13 @@ discovery for crash-resume.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+
+_log = logging.getLogger("ff.checkpoint")
 
 
 def _ocp():
@@ -68,10 +71,19 @@ class CheckpointManager:
         empty items — and reconstituted as None/{} on restore."""
         ocp = _ocp()
         if step in self._mgr.all_steps():
-            # Same step saved already (e.g. a final forced save landing
-            # on a periodic one); orbax raises StepAlreadyExistsError
-            # even under force, so treat it as the no-op it is.
-            return False
+            if force:
+                # A run resumed from an *older* step may legitimately
+                # re-save this step with different state; replace the
+                # stale snapshot (orbax raises StepAlreadyExistsError
+                # even under force, so delete first).  NOT atomic: a
+                # crash between delete and save loses the old snapshot
+                # — only force when the caller truly wants replacement.
+                self._mgr.delete(step)
+            else:
+                # Same step saved already (e.g. a final forced save
+                # landing on a periodic one); a no-op, but say so.
+                _log.warning("skipping save: step %d already exists", step)
+                return False
         items: Dict[str, Any] = {"params": ocp.args.StandardSave(params)}
         if opt_state is not None and jax.tree.leaves(opt_state):
             items["opt_state"] = ocp.args.StandardSave(opt_state)

@@ -55,6 +55,22 @@ class Executor:
             mesh_plan = build_mesh_plan(nd, devices=devices)
         self.plan = mesh_plan
         self.strategy = strategy or StrategyStore.data_parallel(self.plan.num_devices)
+        # Loudly reject (never silently drop) placements this executor
+        # cannot realize: a proper-subset device list is layer-wise
+        # placement, which is PipelineExecutor's job (reference
+        # ``config.h:39-48`` gpu[]; ``nmt.cc:269-308``).
+        full = set(range(self.plan.num_devices))
+        for name, pc in self.strategy.table.items():
+            ids = pc.device_ids
+            if ids is not None and set(ids) != full:
+                raise ValueError(
+                    f"strategy for {name!r} places on devices "
+                    f"{sorted(set(ids))} but this Executor's mesh is "
+                    f"devices 0..{self.plan.num_devices - 1}; Executor "
+                    f"runs every op on the full mesh — use "
+                    f"flexflow_tpu.runtime.pipeline.PipelineExecutor (or "
+                    f"make_executor) for layer-wise placement"
+                )
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
@@ -151,7 +167,10 @@ class Executor:
         env: Dict[str, jax.Array] = {}
         for t in self.model.input_tensors:
             x = batch[t.name]
-            assert x.shape == t.shape, (
+            # The sample dim may shrink (pipeline microbatching splits
+            # the declared batch); feature dims are structural.
+            strict_from = 1 if (t.dim_axes and t.dim_axes[0] == "n") else 0
+            assert x.shape[strict_from:] == t.shape[strict_from:], (
                 f"input {t.name}: expected {t.shape}, got {x.shape}"
             )
             env[t.name] = jax.lax.with_sharding_constraint(x, self.input_sharding(t))

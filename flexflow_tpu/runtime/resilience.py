@@ -148,7 +148,12 @@ class ResilientTrainer:
                 # Durable forward progress: the budget bounds crash
                 # *loops*, not total faults over the job lifetime.
                 self.restarts = 0
-        self.checkpoint.save(step, params, opt_state, state, force=True)
+        # Final save: if the step was already saved periodically it is
+        # this very state (same trajectory since the last restore) —
+        # skip, avoiding force's delete-then-rewrite crash window.  A
+        # fresh step forces past any orbax save-interval gating.
+        if step not in self.checkpoint.all_steps():
+            self.checkpoint.save(step, params, opt_state, state, force=True)
         return {
             "step": step,
             "restarts": self.total_restarts,

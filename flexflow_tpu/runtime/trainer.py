@@ -51,6 +51,7 @@ class Trainer:
         checkpoint=None,
         save_every: int = 0,
         resume: bool = True,
+        accum_steps: int = 1,
     ) -> Dict[str, float]:
         """Run ``iterations`` steps; returns throughput stats computed
         with the reference formula.
@@ -60,6 +61,13 @@ class Trainer:
         ``save_every`` steps plus once at the end — the crash-recovery
         subsystem the reference lacks entirely (SURVEY.md §5)."""
         ex = self.ex
+        if accum_steps > 1:
+            accum_fn = ex.accum_train_step(accum_steps)
+            step_fn = lambda p, o, s, b: accum_fn(
+                p, o, s, ex.stack_microbatches(b, accum_steps)
+            )
+        else:
+            step_fn = ex.train_step
         params, opt_state, state = ex.init()
         start_step = 0
         if checkpoint is not None and resume:
@@ -85,7 +93,7 @@ class Trainer:
         m = None
         for _ in range(warmup):
             batch = next(batches)
-            params, opt_state, state, m = ex.train_step(params, opt_state, state, batch)
+            params, opt_state, state, m = step_fn(params, opt_state, state, batch)
         start_step += warmup
         if m is not None:
             jax.device_get(m)  # host readback: the only reliable fence on the relay
@@ -95,7 +103,7 @@ class Trainer:
         start = time.perf_counter()
         for it in range(iterations):
             batch = next(batches)
-            params, opt_state, state, m = ex.train_step(params, opt_state, state, batch)
+            params, opt_state, state, m = step_fn(params, opt_state, state, batch)
             if log_every and (it + 1) % log_every == 0:
                 self.metrics.update(jax.device_get(m))
                 print(f"iter {it+1}: {self.metrics.report()}")
@@ -115,9 +123,13 @@ class Trainer:
         if ex.config.profiling:
             # --profiling: per-op breakdown, the reference's per-task
             # cudaEvent timings (conv_2d.cu:515-546).
-            from flexflow_tpu.runtime.profiler import profile_ops, report
+            if isinstance(ex, Executor):
+                from flexflow_tpu.runtime.profiler import profile_ops, report
 
-            print(report(profile_ops(ex, params, state, batch)))
+                print(report(profile_ops(ex, params, state, batch)))
+            else:
+                print("profiling: per-op breakdown unavailable for "
+                      "pipeline executors")
         batch_size = ex.model.input_tensors[0].shape[0]
         throughput = iterations * batch_size / elapsed
         # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).

@@ -12,9 +12,12 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_log = logging.getLogger("ff.search")
 
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.ops import Op
@@ -118,6 +121,12 @@ def enumerate_candidates(
         (pc for pc in combos if pc != dp),
         key=lambda pc: (-pc.num_parts, pc.n, pc.c, pc.h, pc.w, pc.s),
     )
+    if len(rest) > max_candidates - 1:
+        _log.warning(
+            "op %r: %d feasible strategies truncated to %d "
+            "(pass max_candidates to widen)",
+            op.name, len(rest) + 1, max_candidates,
+        )
     return [dp] + rest[: max_candidates - 1]
 
 

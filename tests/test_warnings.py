@@ -1,0 +1,50 @@
+"""Silent degradations must be loud (SURVEY 'no silent caps').
+
+Round-1 verdict: ``spec()`` dropped non-dividing mesh axes, the search
+truncated candidate lists, and ``device_ids`` was ignored — all
+silently.  These tests pin the warnings (rejection for device_ids is
+pinned in test_pipeline.py).
+"""
+
+import logging
+
+import jax
+import pytest
+
+from flexflow_tpu.parallel.mesh import build_mesh_plan
+from flexflow_tpu.parallel.strategy import ParallelConfig
+
+
+def test_spec_drop_warns_once(caplog):
+    plan = build_mesh_plan(8, devices=jax.devices()[:8])
+    pc = ParallelConfig(n=2, h=2)
+    with caplog.at_level(logging.WARNING, logger="ff.mesh"):
+        # 229 is odd: the h split cannot divide it.
+        plan.spec(pc, ("n", "h", "w", None), (8, 229, 229, 3))
+        plan.spec(pc, ("n", "h", "w", None), (8, 229, 229, 3))
+    msgs = [r for r in caplog.records if "partial sharding" in r.message]
+    assert len(msgs) == 1, [r.message for r in caplog.records]
+    assert "'h'" in msgs[0].message
+    # A different extent warns separately.
+    with caplog.at_level(logging.WARNING, logger="ff.mesh"):
+        plan.spec(pc, ("n", "h", "w", None), (8, 57, 57, 3))
+    msgs = [r for r in caplog.records if "partial sharding" in r.message]
+    assert len(msgs) == 2
+
+
+def test_candidate_truncation_warns(caplog):
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.search.problem import enumerate_candidates
+
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 64, 64, 8), name="x")
+    t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="conv")
+    plan = build_mesh_plan(8, devices=jax.devices()[:8])
+    op = ff.layers[0]
+    full = enumerate_candidates(op, plan, max_candidates=1024)
+    assert len(full) > 4
+    with caplog.at_level(logging.WARNING, logger="ff.search"):
+        small = enumerate_candidates(op, plan, max_candidates=4)
+    assert len(small) == 4
+    assert any("truncated" in r.message for r in caplog.records)
