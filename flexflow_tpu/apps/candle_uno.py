@@ -10,15 +10,26 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import run_training
+from flexflow_tpu.apps.common import load_strategy, run_training
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.models.candle_uno import CandleConfig, build_candle_uno
+from flexflow_tpu.models.candle_uno import (
+    CandleConfig,
+    build_candle_uno,
+    candle_uno_strategy,
+)
 
 
 def main(argv=None) -> int:
     cfg = FFConfig.parse_args(sys.argv[1:] if argv is None else argv)
-    ff = build_candle_uno(batch_size=cfg.batch_size, candle=CandleConfig(),
+    candle = CandleConfig()
+    ff = build_candle_uno(batch_size=cfg.batch_size, candle=candle,
                           config=cfg)
+    # Default strategy: the BASELINE "multi-host pod hybrid" — DP
+    # towers + hybrid n x c trunk; pair with --granules on a pod so the
+    # trunk's tensor parallelism stays on ICI.
+    strategy = load_strategy(cfg, cfg.resolve_num_devices()) or (
+        candle_uno_strategy(cfg.resolve_num_devices(), candle)
+    )
     arrays = None
     if cfg.dataset_path:
         # -d <dir>: one CSV per model input tensor, "<dir>/<name>.csv"
@@ -34,7 +45,7 @@ def main(argv=None) -> int:
         arrays = load_feature_csvs(
             paths, expected_dims={t.name: t.shape[1] for t in ff.input_tensors}
         )
-    run_training(ff, cfg, arrays=arrays)
+    run_training(ff, cfg, strategy=strategy, arrays=arrays)
     return 0
 
 

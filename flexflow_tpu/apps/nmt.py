@@ -17,7 +17,7 @@ import sys
 
 from flexflow_tpu.apps.common import load_strategy, run_training
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.models.nmt import build_nmt, nmt_strategy
+from flexflow_tpu.models.nmt import build_nmt, nmt_pipeline_strategy, nmt_strategy
 
 
 def _pop_int(argv, flag, default):
@@ -31,6 +31,9 @@ def _pop_int(argv, flag, default):
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    pipeline = "--pipeline" in argv
+    if pipeline:
+        argv.remove("--pipeline")
     src_len = _pop_int(argv, "--src-len", 20)
     tgt_len = _pop_int(argv, "--tgt-len", 20)
     vocab = _pop_int(argv, "--vocab", 32 * 1024)
@@ -43,7 +46,14 @@ def main(argv=None) -> int:
         num_layers=layers, config=cfg,
     )
     ndev = cfg.resolve_num_devices()
-    strategy = load_strategy(cfg, ndev) or nmt_strategy(ndev, num_layers=layers)
+    strategy = load_strategy(cfg, ndev) or (
+        # --pipeline: the reference's layer-wise placement — encoder on
+        # the first half of the devices, decoder on the second
+        # (``nmt.cc:269-308``) — via PipelineExecutor.
+        nmt_pipeline_strategy(ndev, num_layers=layers)
+        if pipeline
+        else nmt_strategy(ndev, num_layers=layers)
+    )
     int_high = {"src": vocab, "tgt": vocab, "label": vocab}
     stats = run_training(ff, cfg, strategy=strategy, int_high=int_high,
                          label="sentence-pairs")

@@ -191,6 +191,22 @@ class FFModel:
                            num_entries, out_dim, **kw)
         )
 
+    def hetero_embedding(
+        self,
+        x: TensorSpec,
+        vocab_sizes,
+        out_dim: int,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        """T different-vocab tables, row-concatenated and row-range
+        sharded (heterogeneous table parallelism; reference:
+        ``dlrm.cc:230-330`` + ``dlrm_strategy.cc:5-36``)."""
+        return self._add(
+            HeteroEmbedding(self._unique("embeddings", name), x, vocab_sizes,
+                            out_dim, **kw)
+        )
+
     def word_embedding(
         self,
         x: TensorSpec,

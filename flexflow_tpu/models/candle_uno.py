@@ -94,3 +94,27 @@ def build_candle_uno(
     label = ff.create_tensor((batch_size, 1), name="label")
     ff.mse_loss(out, label, reduction="mean", name="mse_loss")
     return ff
+
+
+def candle_uno_strategy(
+    num_devices: int,
+    candle: Optional[CandleConfig] = None,
+    tp: Optional[int] = None,
+) -> "StrategyStore":
+    """The BASELINE 'multi-host pod hybrid' config: feature towers pure
+    data-parallel (small weights, DCN-friendly), the wide trunk dense
+    layers hybrid n x c so their tensor parallelism rides ICI when the
+    mesh is granule-outer (``build_hybrid_mesh_plan``; the mesh
+    assigner takes ``n`` from the left/DCN axes and ``c`` from the
+    right/ICI axes)."""
+    from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+    candle = candle or CandleConfig()
+    if tp is None:
+        tp = 2 if num_devices % 2 == 0 and num_devices > 1 else 1
+    assert num_devices % tp == 0
+    store = StrategyStore(num_devices)
+    for j in range(len(candle.dense_layers)):
+        store.set(f"trunk_dense{j}",
+                  ParallelConfig(n=num_devices // tp, c=tp))
+    return store

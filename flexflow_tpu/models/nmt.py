@@ -87,3 +87,24 @@ def nmt_strategy(
     store.set("vocab_proj", ParallelConfig(n=dp, c=sp))
     store.set("softmax", ParallelConfig(n=dp * sp))
     return store
+
+
+def nmt_pipeline_strategy(num_devices: int, num_layers: int = 2) -> StrategyStore:
+    """The reference's *layer-wise* NMT placement (``nmt.cc:269-308``):
+    the encoder stack (embed + LSTMs) on the first half of the devices,
+    the decoder stack (embed + LSTMs + vocab projection + loss) on the
+    second half — executed here by ``PipelineExecutor`` as two
+    submeshes, data-parallel within each (the reference runs each
+    chunk's worker set data-parallel the same way)."""
+    assert num_devices % 2 == 0, "pipeline placement needs an even device count"
+    enc = tuple(range(num_devices // 2))
+    dec = tuple(range(num_devices // 2, num_devices))
+    store = StrategyStore(num_devices)
+    store.set("src_embed", ParallelConfig(n=len(enc), device_ids=enc))
+    store.set("tgt_embed", ParallelConfig(n=len(dec), device_ids=dec))
+    for i in range(num_layers):
+        store.set(f"enc_lstm{i}", ParallelConfig(n=len(enc), device_ids=enc))
+        store.set(f"dec_lstm{i}", ParallelConfig(n=len(dec), device_ids=dec))
+    store.set("vocab_proj", ParallelConfig(n=len(dec), device_ids=dec))
+    store.set("softmax", ParallelConfig(n=len(dec), device_ids=dec))
+    return store

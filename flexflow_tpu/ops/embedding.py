@@ -118,6 +118,131 @@ class MultiEmbedding(Op):
         return [y], state
 
 
+class HeteroEmbedding(Op):
+    """T *different-vocab* tables as one row-concatenated parameter —
+    heterogeneous expert/table parallelism (the real 26-table Criteo
+    case, ``examples/DLRM/dlrm.cc:230-330``).
+
+    The reference pins each table whole to one GPU
+    (``dlrm_strategy.cc:5-36``), which load-balances badly when vocabs
+    are skewed (Criteo spans 10^1..10^7 rows).  TPU-native redesign:
+    concatenate all tables along the ROW dim into a single
+    ``(sum_vocab, dim)`` parameter with per-table row offsets folded
+    into the ids, tag the row dim ``c``, and shard row-RANGES — each
+    device owns an equal slice of rows regardless of table boundaries,
+    so placement is balanced by construction.  Under ``c > 1`` the
+    lookup runs as an explicit ``shard_map``: each shard gathers the
+    ids that fall in its row range (masked, clipped) and a ``psum``
+    over the ``c`` group assembles full rows — the standard
+    sharded-gather pattern; its transpose is a local scatter-add into
+    the owning shard (the reference's atomicAdd backward,
+    ``embedding.cu:128-158``, without atomics).
+
+    Rows are padded to a multiple of ``pad_to`` so any ``c`` degree
+    dividing ``pad_to`` shards evenly; padded rows are never indexed,
+    so their gradient is structurally zero.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        vocab_sizes,
+        out_dim: int,
+        dtype=jnp.float32,
+        pad_to: int = 128,
+    ):
+        super().__init__(name, [x])
+        vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        assert x.ndim == 2 and x.shape[1] == len(vocab_sizes), (
+            f"ids must be (batch, {len(vocab_sizes)}), got {x.shape}"
+        )
+        total = sum(vocab_sizes)
+        rows = ((total + pad_to - 1) // pad_to) * pad_to
+        offsets = []
+        acc = 0
+        for v in vocab_sizes:
+            offsets.append(acc)
+            acc += v
+        self.attrs = dict(
+            vocab_sizes=vocab_sizes, out_dim=out_dim, rows=rows,
+            offsets=tuple(offsets),
+        )
+        self._make_output(
+            (x.shape[0], len(vocab_sizes), out_dim), dtype, ("n", None, None)
+        )
+
+    def _init_table(self, key, shape, dtype):
+        """Per-table U(-1/sqrt(V_t), 1/sqrt(V_t)) rows (``dlrm.cc:41-47``),
+        zeros for padding — one uniform draw scaled by a per-row range."""
+        import jax
+
+        a = self.attrs
+        scale = jnp.zeros((a["rows"],), jnp.float32)
+        for off, v in zip(a["offsets"], a["vocab_sizes"]):
+            scale = scale.at[off:off + v].set(1.0 / (v ** 0.5))
+        u = jax.random.uniform(key, shape, jnp.float32, -1.0, 1.0)
+        return (u * scale[:, None]).astype(dtype)
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        a = self.attrs
+        return {
+            "table": ParamSpec(
+                (a["rows"], a["out_dim"]),
+                self.outputs[0].dtype,
+                self._init_table,
+                ("c", None),
+            )
+        }
+
+    def forward(self, params, xs, state, training):
+        import jax
+        from jax.sharding import PartitionSpec
+
+        (idx,) = xs  # (batch, T)
+        table = params["table"]
+        offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
+        flat = idx + offsets[None, :]  # global row ids
+
+        plan = getattr(self, "_plan", None)
+        if plan is None:
+            return [jnp.take(table, flat, axis=0)], state
+        (n_axes, n_deg), (c_axes, c_deg) = plan.local_degrees(
+            self._pc, "n", "c"
+        )
+        if c_deg <= 1 or self.attrs["rows"] % c_deg:
+            return [jnp.take(table, flat, axis=0)], state
+
+        local_rows = self.attrs["rows"] // c_deg
+
+        def local_fn(tbl, ids):
+            # Shard id along the c group: this device owns rows
+            # [k*local_rows, (k+1)*local_rows).
+            k = 0
+            for ax in (c_axes or ()):
+                k = k * plan.mesh.shape[ax] + jax.lax.axis_index(ax)
+            start = k * local_rows
+            loc = ids - start
+            ok = (loc >= 0) & (loc < local_rows)
+            got = jnp.take(tbl, jnp.clip(loc, 0, local_rows - 1), axis=0)
+            got = jnp.where(ok[..., None], got, 0.0)
+            return jax.lax.psum(got, c_axes)
+
+        n_entry = n_axes if n_axes else None
+        return [
+            jax.shard_map(
+                local_fn,
+                mesh=plan.mesh,
+                in_specs=(
+                    PartitionSpec(c_axes, None),
+                    PartitionSpec(n_entry, None),
+                ),
+                out_specs=PartitionSpec(n_entry, None, None),
+                check_vma=False,
+            )(table, flat)
+        ], state
+
+
 class WordEmbedding(Op):
     """Token embedding over (batch, seq) int ids → (batch, seq, dim).
 

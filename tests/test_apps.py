@@ -98,3 +98,31 @@ def test_candle_app_reads_csv_dir(tmp_path, capsys):
         (tmp_path / f"{t.name}.csv").write_text(rows + "\n")
     assert candle_uno.main(["-b", "4", "-i", "2", "-d", str(tmp_path)]) == 0
     assert "THROUGHPUT =" in capsys.readouterr().out
+
+
+def test_nmt_app_pipeline_placement(capsys):
+    """--pipeline: encoder on the first half of devices, decoder on the
+    second (``nmt.cc:269-308``), driven through PipelineExecutor."""
+    assert nmt.main([
+        "-b", "16", "-i", "1", "--hidden", "16", "--vocab", "64",
+        "--src-len", "8", "--tgt-len", "8", "--pipeline",
+        "-ll:tpu", "8", "--microbatches", "2",
+    ]) == 0
+    assert "time =" in capsys.readouterr().out
+
+
+def test_candle_uno_app_hybrid_granules(capsys):
+    """The BASELINE multi-host pod hybrid: --granules 2 (DCN-outer
+    mesh) + the default hybrid n x c trunk strategy + --optimizer adam."""
+    assert candle_uno.main([
+        "-b", "16", "-i", "1", "--granules", "2", "-ll:tpu", "8",
+        "--optimizer", "adam",
+    ]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out
+
+
+def test_alexnet_app_accum_steps(capsys):
+    assert alexnet.main([
+        "-b", "8", "-i", "1", "-ll:tpu", "4", "--accum-steps", "2",
+    ]) == 0
+    assert "tp =" in capsys.readouterr().out
