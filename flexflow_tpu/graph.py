@@ -26,6 +26,7 @@ from flexflow_tpu.ops import (
     Conv2D,
     Embedding,
     Flat,
+    HeteroEmbedding,
     LayerNorm,
     Linear,
     MSELoss,

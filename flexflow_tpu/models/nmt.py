@@ -96,7 +96,12 @@ def nmt_pipeline_strategy(num_devices: int, num_layers: int = 2) -> StrategyStor
     second half — executed here by ``PipelineExecutor`` as two
     submeshes, data-parallel within each (the reference runs each
     chunk's worker set data-parallel the same way)."""
-    assert num_devices % 2 == 0, "pipeline placement needs an even device count"
+    if num_devices % 2 != 0:
+        raise ValueError(
+            f"pipeline placement splits the devices into encoder and "
+            f"decoder halves and needs an even device count, got "
+            f"{num_devices}"
+        )
     enc = tuple(range(num_devices // 2))
     dec = tuple(range(num_devices // 2, num_devices))
     store = StrategyStore(num_devices)

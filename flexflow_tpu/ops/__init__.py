@@ -1,7 +1,7 @@
 from flexflow_tpu.ops.attention import LayerNorm, MultiHeadAttention, PositionEmbedding
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 from flexflow_tpu.ops.conv import Conv2D, Flat, Pool2D
-from flexflow_tpu.ops.embedding import Embedding, MultiEmbedding, WordEmbedding
+from flexflow_tpu.ops.embedding import Embedding, HeteroEmbedding, MultiEmbedding, WordEmbedding
 from flexflow_tpu.ops.linear import Linear
 from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
 from flexflow_tpu.ops.norm import BatchNorm
@@ -18,6 +18,7 @@ __all__ = [
     "BatchNorm",
     "Linear",
     "Embedding",
+    "HeteroEmbedding",
     "MultiEmbedding",
     "WordEmbedding",
     "LSTM",

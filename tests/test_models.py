@@ -88,6 +88,40 @@ def test_dlrm_heterogeneous_vocabs(rng):
     assert np.isfinite(m["train_loss"])
 
 
+def test_hetero_embedding_sharded_matches_replicated(rng):
+    """The row-range-sharded lookup (shard_map gather + psum) must be
+    numerically identical to the replicated jnp.take path."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+    vocabs = [10, 200, 300]
+
+    def build():
+        ff = FFModel(FFConfig(batch_size=8))
+        ids = ff.create_tensor((8, 3), dtype=jnp.int32, name="ids")
+        lbl = ff.create_tensor((8,), dtype=jnp.int32, name="label")
+        t = ff.hetero_embedding(ids, vocabs, 8, pad_to=4, name="tables")
+        t = ff.reshape(t, (8, 24), name="r")
+        t = ff.dense(t, 4, name="fc")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    batch = {
+        "ids": np.stack(
+            [rng.integers(0, v, size=8) for v in vocabs], axis=1
+        ).astype(np.int32),
+        "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+    }
+    m_rep = _one_step(build(), dict(batch), 1)
+    store = StrategyStore(8)
+    store.set("tables", ParallelConfig(n=2, c=4))
+    m_shard = _one_step(build(), dict(batch), 8, store)
+    np.testing.assert_allclose(
+        m_rep["train_loss"], m_shard["train_loss"], rtol=2e-5, atol=1e-6
+    )
+
+
 def test_dlrm_config_parse_args():
     cfg = DLRMConfig.parse_args(
         "--arch-sparse-feature-size 64 --arch-embedding-size 1000-2000 "
