@@ -181,6 +181,7 @@ def main():
         actual_n = len(jax.devices())
         per_chip = per_chip * n_chips / actual_n
         n_chips = extra["n_chips"] = actual_n
+        extra["alexnet_mfu"] = None  # computed against a TPU roofline
 
     print(
         json.dumps(

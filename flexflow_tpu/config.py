@@ -53,6 +53,12 @@ class FFConfig:
     # Gradient accumulation: microbatches per optimizer step
     # (Executor.accum_train_step).
     accum_steps: int = 1
+    # Row-sparse embedding updates: differentiate w.r.t. gathered rows
+    # and scatter the row grads into the (donated) table instead of
+    # materializing a table-sized dense gradient.  Exact plain-SGD
+    # numerics; applies only when the optimizer reports
+    # ``supports_sparse_rows`` (see flexflow_tpu/ops/base.py).
+    sparse_embedding_updates: bool = True
     # Hybrid mesh granules: number of slow-interconnect islands for
     # build_hybrid_mesh_plan (0/1 = flat single-slice mesh).
     granules: int = 0

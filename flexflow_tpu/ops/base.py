@@ -94,6 +94,39 @@ class Op:
         self._plan = plan
         self._pc = pc
 
+    # -- sparse-gradient protocol -----------------------------------------
+    #
+    # Embedding-style ops (output == gathered rows, up to a linear
+    # aggregation) opt in by returning their table keys from
+    # ``sparse_keys``.  The executor then differentiates w.r.t. the
+    # GATHERED ROWS instead of the table and applies the row cotangent
+    # with a scatter-add — donation makes the table update in place, so
+    # neither a table-sized gradient nor a table-sized copy ever
+    # materializes.  This is the TPU-native answer to the reference's
+    # atomicAdd scatter backward (``embedding.cu:128-158``) *and* to
+    # its skip-the-embedding-update hack (``model.cc:566-574``): the
+    # update is exact plain-SGD, just row-sparse.
+
+    def sparse_keys(self) -> Tuple[str, ...]:
+        """Param keys eligible for row-sparse updates ('' = none)."""
+        return ()
+
+    def sparse_ok(self, plan, pc) -> bool:
+        """Whether the sparse path is valid under this placement."""
+        return True
+
+    def sparse_rows(self, params, xs):
+        """Gather: params + graph inputs -> rows pytree (small)."""
+        raise NotImplementedError
+
+    def sparse_forward(self, rows, xs, state, training):
+        """Forward given pre-gathered rows; must not touch the table."""
+        raise NotImplementedError
+
+    def sparse_apply(self, params, xs, row_grads, lr):
+        """Scatter row cotangents: p.at[ids].add(-lr * g)."""
+        raise NotImplementedError
+
     # -- execution --------------------------------------------------------
 
     def forward(

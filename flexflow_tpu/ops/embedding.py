@@ -23,6 +23,58 @@ from flexflow_tpu.initializers import NormInitializer
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 
 
+def _row_kernels_ok(op: Op, n_ids: int, table) -> bool:
+    """Use the Pallas row-DMA kernels (pallas_kernels.gather_rows /
+    scatter_add_rows): XLA's TPU lowering of gather/scatter over a big
+    table is a full-table sweep, the kernels touch only the addressed
+    rows.  Single-device TPU only (under GSPMD sharding the jnp path
+    lets the partitioner place the op), and only outside autodiff —
+    jax has no AD rule for scalar-prefetch pallas_call, so ONLY the
+    executor's sparse protocol (never ``forward``) may dispatch here.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    plan = getattr(op, "_plan", None)
+    if plan is not None and plan.num_devices > 1:
+        return False
+    rows = 1
+    for s in table.shape[:-1]:
+        rows *= s
+    if rows >= 2**31:  # kernel ids are int32 (SMEM)
+        return False
+    from flexflow_tpu.ops import pallas_kernels as pk
+
+    return pk.rows_supported(n_ids, table.shape[-1], table.dtype)
+
+
+def _gather_dispatch(op: Op, table, flat_ids):
+    """``table[(R, D)][flat_ids] -> flat_ids.shape + (D,)`` via the
+    Pallas row kernel when eligible, else ``jnp.take``.  Executor
+    sparse path only (not differentiable through)."""
+    d = table.shape[1]
+    if _row_kernels_ok(op, flat_ids.size, table):
+        from flexflow_tpu.ops import pallas_kernels as pk
+
+        rows = pk.gather_rows(table, flat_ids.reshape(-1))
+        return rows.reshape(flat_ids.shape + (d,))
+    return jnp.take(table, flat_ids, axis=0)
+
+
+def _scatter_add_dispatch(op: Op, table, flat_ids, upd):
+    """``table.at[flat_ids].add(upd)`` via the in-place Pallas row
+    kernel when eligible.  Executor sparse path only."""
+    upd = upd.astype(table.dtype)
+    if _row_kernels_ok(op, flat_ids.size, table):
+        from flexflow_tpu.ops import pallas_kernels as pk
+
+        return pk.scatter_add_rows(
+            table, flat_ids.reshape(-1), upd.reshape(-1, table.shape[1])
+        )
+    return table.at[flat_ids].add(upd)
+
+
 class Embedding(Op):
     """Single-table embedding lookup with bag aggregation.
 
@@ -59,13 +111,32 @@ class Embedding(Op):
         }
 
     def forward(self, params, xs, state, training):
+        # Pure jnp (differentiable): the dense-grad path traces this
+        # under value_and_grad.
         (idx,) = xs
         rows = jnp.take(params["table"], idx, axis=0)  # (batch, bag, dim)
+        return self.sparse_forward(rows, xs, state, training)
+
+    def sparse_keys(self):
+        return ("table",)
+
+    def sparse_rows(self, params, xs):
+        (idx,) = xs
+        return _gather_dispatch(self, params["table"], idx)
+
+    def sparse_forward(self, rows, xs, state, training):
         if self.attrs["aggr"] == "sum":
             y = jnp.sum(rows, axis=1)
         else:
             y = jnp.mean(rows, axis=1)
         return [y], state
+
+    def sparse_apply(self, params, xs, row_grads, lr):
+        (idx,) = xs
+        table = _scatter_add_dispatch(
+            self, params["table"], idx, -lr * row_grads
+        )
+        return {**params, "table": table}
 
 
 class MultiEmbedding(Op):
@@ -109,13 +180,42 @@ class MultiEmbedding(Op):
         }
 
     def forward(self, params, xs, state, training):
+        # Pure jnp (differentiable).  Gather row idx[b, t] from table
+        # t: one_hot-free take_along_axis.  (T, vocab, dim) indexed by
+        # (batch, T) → (batch, T, dim).
         (idx,) = xs  # (batch, T)
         tables = params["tables"]  # (T, vocab, dim)
-        # Gather row idx[b, t] from table t: one_hot-free take_along_axis.
-        # (T, vocab, dim) indexed by (batch, T) → (batch, T, dim).
         t_range = jnp.arange(tables.shape[0])[None, :]  # (1, T)
-        y = tables[t_range, idx]  # advanced indexing → batched gather
-        return [y], state
+        return [tables[t_range, idx]], state
+
+    def sparse_keys(self):
+        return ("tables",)
+
+    def _flat_ids(self, tables, idx):
+        # Global row id t*V + idx[b, t] into the (T*V, D) bitcast view.
+        T, V, _ = tables.shape
+        return jnp.arange(T, dtype=idx.dtype)[None, :] * V + idx
+
+    def sparse_rows(self, params, xs):
+        (idx,) = xs  # (batch, T)
+        tables = params["tables"]  # (T, vocab, dim)
+        T, V, D = tables.shape
+        return _gather_dispatch(
+            self, tables.reshape(T * V, D), self._flat_ids(tables, idx)
+        )
+
+    def sparse_forward(self, rows, xs, state, training):
+        return [rows], state
+
+    def sparse_apply(self, params, xs, row_grads, lr):
+        (idx,) = xs  # (batch, T)
+        tables = params["tables"]
+        T, V, D = tables.shape
+        new = _scatter_add_dispatch(
+            self, tables.reshape(T * V, D), self._flat_ids(tables, idx),
+            -lr * row_grads,
+        )
+        return {**params, "tables": new.reshape(T, V, D)}
 
 
 class HeteroEmbedding(Op):
@@ -195,6 +295,39 @@ class HeteroEmbedding(Op):
             )
         }
 
+    def sparse_keys(self):
+        return ("table",)
+
+    def _shards_rows(self, plan, pc) -> bool:
+        """Single predicate for 'the table is row-range sharded' —
+        shared by forward (shard_map lookup) and sparse_ok so the two
+        gates cannot drift."""
+        if plan is None:
+            return False
+        (_, c_deg), = plan.local_degrees(pc, "c")
+        return c_deg > 1 and self.attrs["rows"] % c_deg == 0
+
+    def sparse_ok(self, plan, pc) -> bool:
+        # The row-range-sharded lookup runs inside shard_map; the
+        # sparse row-grad path covers only the replicated table.
+        return not self._shards_rows(plan, pc)
+
+    def sparse_rows(self, params, xs):
+        (idx,) = xs
+        offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
+        return _gather_dispatch(self, params["table"], idx + offsets[None, :])
+
+    def sparse_forward(self, rows, xs, state, training):
+        return [rows], state
+
+    def sparse_apply(self, params, xs, row_grads, lr):
+        (idx,) = xs
+        offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
+        table = _scatter_add_dispatch(
+            self, params["table"], idx + offsets[None, :], -lr * row_grads
+        )
+        return {**params, "table": table}
+
     def forward(self, params, xs, state, training):
         import jax
         from jax.sharding import PartitionSpec
@@ -205,13 +338,11 @@ class HeteroEmbedding(Op):
         flat = idx + offsets[None, :]  # global row ids
 
         plan = getattr(self, "_plan", None)
-        if plan is None:
+        if not self._shards_rows(plan, getattr(self, "_pc", None)):
             return [jnp.take(table, flat, axis=0)], state
         (n_axes, n_deg), (c_axes, c_deg) = plan.local_degrees(
             self._pc, "n", "c"
         )
-        if c_deg <= 1 or self.attrs["rows"] % c_deg:
-            return [jnp.take(table, flat, axis=0)], state
 
         local_rows = self.attrs["rows"] // c_deg
 
@@ -280,3 +411,20 @@ class WordEmbedding(Op):
     def forward(self, params, xs, state, training):
         (idx,) = xs
         return [jnp.take(params["table"], idx, axis=0)], state
+
+    def sparse_keys(self):
+        return ("table",)
+
+    def sparse_rows(self, params, xs):
+        (idx,) = xs
+        return _gather_dispatch(self, params["table"], idx)
+
+    def sparse_forward(self, rows, xs, state, training):
+        return [rows], state
+
+    def sparse_apply(self, params, xs, row_grads, lr):
+        (idx,) = xs
+        table = _scatter_add_dispatch(
+            self, params["table"], idx, -lr * row_grads
+        )
+        return {**params, "table": table}

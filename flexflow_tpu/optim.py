@@ -27,6 +27,14 @@ class SGDOptimizer:
     nesterov: bool = False
     weight_decay: float = 0.0
 
+    @property
+    def supports_sparse_rows(self) -> bool:
+        """Row-sparse embedding updates (Executor sparse path) are
+        numerically identical to the dense update only for plain SGD:
+        momentum needs a dense buffer and weight decay touches every
+        row every step."""
+        return self.momentum == 0.0 and self.weight_decay == 0.0
+
     def init(self, params) -> Any:
         """Momentum buffers (the reference's per-parameter ``v_regions``,
         ``optimizer.cc:22-63``); None when momentum is off."""

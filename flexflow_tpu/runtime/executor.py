@@ -160,10 +160,49 @@ class Executor:
         opt_state = self.optimizer.init(params)
         return params, opt_state, state
 
+    # -- sparse embedding updates ------------------------------------------
+
+    @functools.cached_property
+    def _sparse_ops(self) -> List[Op]:
+        """Ops taking the row-sparse update path (ops/base.py protocol):
+        opted-in embedding ops whose inputs are all graph inputs, when
+        the config enables it and the optimizer's update rule is exactly
+        reproducible row-wise."""
+        if not getattr(self.config, "sparse_embedding_updates", False):
+            return []
+        if not getattr(self.optimizer, "supports_sparse_rows", False):
+            return []
+        input_names = {t.name for t in self.model.input_tensors}
+        out = []
+        for op in self.model.layers:
+            keys = op.sparse_keys()
+            if not keys:
+                continue
+            if set(keys) != set(op.param_specs().keys()):
+                continue  # mixed dense+sparse params: keep dense
+            if any(
+                spec.dtype != jnp.float32
+                for spec in op.param_specs().values()
+            ):
+                # Sub-f32 tables round per-duplicate in the scatter
+                # RMW, which is not bit-identical to the dense path's
+                # single post-sum rounding — keep those dense.
+                continue
+            if not all(t.name in input_names for t in op.inputs):
+                continue  # ids must come straight from the batch
+            if not op.sparse_ok(self.plan, self._pc(op)):
+                continue
+            out.append(op)
+        return out
+
     # -- forward -----------------------------------------------------------
 
-    def forward(self, params, state, batch, training: bool):
-        """Run the op graph.  Returns (loss, metrics, new_state, env)."""
+    def forward(self, params, state, batch, training: bool, rows_override=None):
+        """Run the op graph.  Returns (loss, metrics, new_state, env).
+
+        ``rows_override`` maps op name -> pre-gathered embedding rows;
+        those ops run ``sparse_forward`` (never touching their table)
+        so autodiff produces row-sized cotangents."""
         env: Dict[str, jax.Array] = {}
         for t in self.model.input_tensors:
             x = batch[t.name]
@@ -182,7 +221,11 @@ class Executor:
             xs = [env[t.name] for t in op.inputs]
             p = params.get(op.name, {})
             s = state.get(op.name, {})
-            if self.config.remat and training and not op.is_loss:
+            if rows_override is not None and op.name in rows_override:
+                result, s_new = op.sparse_forward(
+                    rows_override[op.name], xs, s, training
+                )
+            elif self.config.remat and training and not op.is_loss:
                 # Per-layer rematerialization: drop this op's
                 # activations after forward and recompute them in the
                 # backward pass (jax.checkpoint) — HBM for FLOPs.
@@ -218,15 +261,48 @@ class Executor:
         function.  Reference equivalent: forward() + zero_gradients() +
         backward() + update() (``model.cc:538-595``) under a Legion
         trace."""
+        sparse_ops = self._sparse_ops
+        if not sparse_ops:
 
-        def train_step(params, opt_state, state, batch):
-            (loss, (metrics, new_state)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(params, state, batch)
-            new_params, new_opt = self.optimizer.update(params, opt_state, grads)
+            def train_step(params, opt_state, state, batch):
+                (loss, (metrics, new_state)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True
+                )(params, state, batch)
+                new_params, new_opt = self.optimizer.update(params, opt_state, grads)
+                return new_params, new_opt, new_state, metrics
+
+            return train_step
+
+        sparse_names = {op.name for op in sparse_ops}
+
+        def sparse_train_step(params, opt_state, state, batch):
+            rows = {}
+            for op in sparse_ops:
+                op.bind_mesh(self.plan, self._pc(op))
+                xs = [batch[t.name] for t in op.inputs]
+                rows[op.name] = op.sparse_rows(params[op.name], xs)
+            dense = {k: v for k, v in params.items() if k not in sparse_names}
+
+            def loss_fn(dense_params, rows):
+                loss, metrics, new_state, _ = self.forward(
+                    dense_params, state, batch, training=True,
+                    rows_override=rows,
+                )
+                return loss, (metrics, new_state)
+
+            (loss, (metrics, new_state)), (dg, rg) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(dense, rows)
+            new_params, new_opt = self.optimizer.update(dense, opt_state, dg)
+            lr = self.optimizer.lr
+            for op in sparse_ops:
+                xs = [batch[t.name] for t in op.inputs]
+                new_params[op.name] = op.sparse_apply(
+                    params[op.name], xs, rg[op.name], lr
+                )
             return new_params, new_opt, new_state, metrics
 
-        return train_step
+        return sparse_train_step
 
     @functools.cached_property
     def train_step(self):
@@ -245,6 +321,11 @@ class Executor:
         (``lax.scan``), which is how batch sizes beyond memory run.
         Count-like metrics (integer dtypes) are summed across
         microbatches, means are averaged.
+
+        Note: this path always uses dense gradients — the row-sparse
+        embedding protocol (``_sparse_ops``) applies to ``train_step``
+        only, so accumulating steps over very large embedding tables
+        materializes table-sized gradients per microbatch.
         """
         cached = self._accum_cache.get(accum_steps)
         if cached is not None:

@@ -1,0 +1,169 @@
+"""Row-sparse embedding updates (Executor sparse path + Pallas row
+kernels).
+
+The sparse path differentiates w.r.t. the gathered rows and scatters
+the row cotangent into the (donated) table — numerics must be
+IDENTICAL to the dense-gradient path (plain SGD; SURVEY.md §2.2
+embedding scatter-grad, reference ``embedding.cu:128-158``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime.executor import Executor
+
+
+def _build(sparse, batch=8):
+    cfg = FFConfig(batch_size=batch, sparse_embedding_updates=sparse)
+    ff = FFModel(cfg)
+    ids = ff.create_tensor((batch, 4), dtype=jnp.int32, name="ids")
+    bag = ff.create_tensor((batch, 3), dtype=jnp.int32, name="bag")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    e1 = ff.multi_embedding(ids, 4, 16, 8, name="tables")
+    e1 = ff.reshape(e1, (batch, 32), name="r1")
+    e2 = ff.embedding(bag, 32, 8, aggr="avg", name="bagged")
+    t = ff.concat([e1, e2], axis=1, name="cat")
+    t = ff.dense(t, 4, name="fc")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+def _batch(rng, batch=8):
+    return {
+        # narrow id range => duplicate rows exercise scatter accumulation
+        "ids": rng.integers(0, 4, size=(batch, 4)).astype(np.int32),
+        "bag": rng.integers(0, 6, size=(batch, 3)).astype(np.int32),
+        "label": rng.integers(0, 4, size=(batch,)).astype(np.int32),
+    }
+
+
+def _run(ff, batch, n_devices=1, strategy=None, steps=3, lr=0.3):
+    ex = Executor(
+        ff, strategy=strategy, optimizer=SGDOptimizer(lr=lr),
+        devices=jax.devices()[:n_devices],
+    )
+    params, opt_state, state = ex.init()
+    b = ex.shard_batch(dict(batch))
+    for _ in range(steps):
+        params, opt_state, state, m = ex.train_step(params, opt_state, state, b)
+    return ex, jax.device_get(params), float(jax.device_get(m["train_loss"]))
+
+
+def test_sparse_matches_dense_exactly(rng):
+    batch = _batch(rng)
+    ex_d, pd, ld = _run(_build(False), batch)
+    ex_s, ps, ls = _run(_build(True), batch)
+    assert not ex_d._sparse_ops
+    assert {op.name for op in ex_s._sparse_ops} == {"tables", "bagged"}
+    assert ld == pytest.approx(ls, rel=1e-6)
+    for opn in pd:
+        for k in pd[opn]:
+            np.testing.assert_allclose(
+                pd[opn][k], ps[opn][k], rtol=1e-6, atol=1e-7,
+                err_msg=f"{opn}/{k}",
+            )
+
+
+def test_sparse_sharded_matches_dense(rng):
+    batch = _batch(rng)
+    _, _, ld = _run(_build(False), batch)
+    store = StrategyStore(8)
+    store.set("tables", ParallelConfig(n=2, c=4))
+    _, _, ls = _run(_build(True), batch, n_devices=8, strategy=store)
+    assert ld == pytest.approx(ls, rel=2e-5)
+
+
+def test_sparse_disabled_for_momentum_and_wd(rng):
+    ff = _build(True)
+    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+                  devices=jax.devices()[:1])
+    assert not ex._sparse_ops  # momentum needs a dense buffer
+    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.1, weight_decay=1e-4),
+                  devices=jax.devices()[:1])
+    assert not ex._sparse_ops  # decay touches every row every step
+
+
+def test_hetero_sparse_matches_dense(rng):
+    vocabs = [10, 50, 100]
+
+    def build(sparse):
+        cfg = FFConfig(batch_size=8, sparse_embedding_updates=sparse)
+        ff = FFModel(cfg)
+        ids = ff.create_tensor((8, 3), dtype=jnp.int32, name="ids")
+        lbl = ff.create_tensor((8,), dtype=jnp.int32, name="label")
+        t = ff.hetero_embedding(ids, vocabs, 8, pad_to=4, name="tables")
+        t = ff.reshape(t, (8, 24), name="r")
+        t = ff.dense(t, 4, name="fc")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    batch = {
+        "ids": np.stack(
+            [rng.integers(0, v, size=8) for v in vocabs], axis=1
+        ).astype(np.int32),
+        "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+    }
+    _, pd, ld = _run(build(False), batch)
+    ex_s, ps, ls = _run(build(True), batch)
+    assert [op.name for op in ex_s._sparse_ops] == ["tables"]
+    assert ld == pytest.approx(ls, rel=1e-6)
+    np.testing.assert_allclose(
+        pd["tables"]["table"], ps["tables"]["table"], rtol=1e-6, atol=1e-7
+    )
+
+    # Row-range-sharded tables stay on the dense path (shard_map fwd).
+    store = StrategyStore(8)
+    store.set("tables", ParallelConfig(n=2, c=4))
+    ex = Executor(build(True), strategy=store, optimizer=SGDOptimizer(lr=0.3),
+                  devices=jax.devices()[:8])
+    assert not ex._sparse_ops
+
+
+def test_word_embedding_sparse(rng):
+    def build(sparse):
+        cfg = FFConfig(batch_size=4, sparse_embedding_updates=sparse)
+        ff = FFModel(cfg)
+        tok = ff.create_tensor((4, 6), dtype=jnp.int32, name="tokens")
+        lbl = ff.create_tensor((4, 6), dtype=jnp.int32, name="label")
+        t = ff.word_embedding(tok, 32, 8, name="wte")
+        t = ff.dense(t, 32, name="proj")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    batch = {
+        "tokens": rng.integers(0, 32, size=(4, 6)).astype(np.int32),
+        "label": rng.integers(0, 32, size=(4, 6)).astype(np.int32),
+    }
+    _, pd, ld = _run(build(False), batch)
+    ex_s, ps, ls = _run(build(True), batch)
+    assert [op.name for op in ex_s._sparse_ops] == ["wte"]
+    assert ld == pytest.approx(ls, rel=1e-6)
+    np.testing.assert_allclose(
+        pd["wte"]["table"], ps["wte"]["table"], rtol=1e-6, atol=1e-7
+    )
+
+
+def test_row_kernels_interpret(rng):
+    """gather_rows / scatter_add_rows vs numpy oracle (interpret mode
+    on CPU — same code path the chip compiles)."""
+    from flexflow_tpu.ops import pallas_kernels as pk
+
+    table = jnp.asarray(rng.standard_normal((40, 128)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, 40, size=(17,)), jnp.int32)
+    upd = jnp.asarray(rng.standard_normal((17, 128)), jnp.float32)
+
+    got = pk.gather_rows(table, idx, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(table)[np.asarray(idx)], rtol=1e-6
+    )
+
+    got = pk.scatter_add_rows(table, idx, upd, interpret=True)
+    ref = np.asarray(table).copy()
+    np.add.at(ref, np.asarray(idx), np.asarray(upd))  # dups accumulate
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-6)
