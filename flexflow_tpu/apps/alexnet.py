@@ -10,14 +10,19 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import run_training
+from flexflow_tpu.apps.common import pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.alexnet import build_alexnet
 
 
 def main(argv=None) -> int:
-    cfg = FFConfig.parse_args(sys.argv[1:] if argv is None else argv)
-    ff = build_alexnet(batch_size=cfg.batch_size, config=cfg)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # App-specific knob (like DLRM's --arch-*): input resolution.
+    # Default 229 matches the reference (alexnet.cc:8).
+    image_size = pop_int(argv, "--image-size", 229)
+    cfg = FFConfig.parse_args(argv)
+    ff = build_alexnet(batch_size=cfg.batch_size, image_size=image_size,
+                       config=cfg)
     stats = run_training(ff, cfg, int_high={"label": 1000}, label="images")
     print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
     return 0

@@ -13,9 +13,23 @@ formulas.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Optional
 
 import numpy as np
+
+# Honor an explicit JAX_PLATFORMS before any backend init: site
+# customizations (e.g. the axon TPU relay) may override the env var at
+# interpreter start, which both hijacks `JAX_PLATFORMS=cpu app ...`
+# and can hang on an unreachable accelerator tunnel.
+_plats = os.environ.get("JAX_PLATFORMS")
+if _plats:
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _plats)
+    except Exception:
+        pass  # backend already initialized with another platform
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data.loader import ArrayDataLoader, PrefetchLoader, synthetic_arrays
@@ -24,6 +38,20 @@ from flexflow_tpu.optim import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.parallel.strategy import StrategyStore
 from flexflow_tpu.runtime.pipeline import PipelineExecutor, make_executor
 from flexflow_tpu.runtime.trainer import Trainer
+
+
+def pop_int(argv, flag, default):
+    """Extract an app-specific ``--flag N`` from argv (the FFConfig
+    parser passes unknown flags through, Legion-style)."""
+    if flag in argv:
+        i = argv.index(flag)
+        try:
+            val = int(argv[i + 1])
+        except (IndexError, ValueError):
+            raise SystemExit(f"{flag} expects an integer")
+        del argv[i:i + 2]
+        return val
+    return default
 
 
 def make_optimizer(cfg: FFConfig):

@@ -15,18 +15,9 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, run_training
+from flexflow_tpu.apps.common import load_strategy, pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.nmt import build_nmt, nmt_pipeline_strategy, nmt_strategy
-
-
-def _pop_int(argv, flag, default):
-    if flag in argv:
-        i = argv.index(flag)
-        val = int(argv[i + 1])
-        del argv[i : i + 2]
-        return val
-    return default
 
 
 def main(argv=None) -> int:
@@ -34,11 +25,11 @@ def main(argv=None) -> int:
     pipeline = "--pipeline" in argv
     if pipeline:
         argv.remove("--pipeline")
-    src_len = _pop_int(argv, "--src-len", 20)
-    tgt_len = _pop_int(argv, "--tgt-len", 20)
-    vocab = _pop_int(argv, "--vocab", 32 * 1024)
-    hidden = _pop_int(argv, "--hidden", 1024)
-    layers = _pop_int(argv, "--layers", 2)
+    src_len = pop_int(argv, "--src-len", 20)
+    tgt_len = pop_int(argv, "--tgt-len", 20)
+    vocab = pop_int(argv, "--vocab", 32 * 1024)
+    hidden = pop_int(argv, "--hidden", 1024)
+    layers = pop_int(argv, "--layers", 2)
     cfg = FFConfig.parse_args(argv)
     ff = build_nmt(
         batch_size=cfg.batch_size, src_len=src_len, tgt_len=tgt_len,

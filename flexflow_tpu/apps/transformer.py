@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, run_training
+from flexflow_tpu.apps.common import load_strategy, pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.transformer import (
     build_transformer_lm,
@@ -23,25 +23,16 @@ from flexflow_tpu.models.transformer import (
 )
 
 
-def _pop_int(argv, flag, default):
-    if flag in argv:
-        i = argv.index(flag)
-        val = int(argv[i + 1])
-        del argv[i : i + 2]
-        return val
-    return default
-
-
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
-    seq = _pop_int(argv, "--seq", 512)
-    vocab = _pop_int(argv, "--vocab", 32 * 1024)
-    d_model = _pop_int(argv, "--d-model", 512)
-    heads = _pop_int(argv, "--heads", 8)
-    layers = _pop_int(argv, "--layers", 4)
-    dp = _pop_int(argv, "--dp", 1)
-    sp = _pop_int(argv, "--sp", 1)
-    tp = _pop_int(argv, "--tp", 1)
+    seq = pop_int(argv, "--seq", 512)
+    vocab = pop_int(argv, "--vocab", 32 * 1024)
+    d_model = pop_int(argv, "--d-model", 512)
+    heads = pop_int(argv, "--heads", 8)
+    layers = pop_int(argv, "--layers", 4)
+    dp = pop_int(argv, "--dp", 1)
+    sp = pop_int(argv, "--sp", 1)
+    tp = pop_int(argv, "--tp", 1)
     cfg = FFConfig.parse_args(argv)
     ff = build_transformer_lm(
         batch_size=cfg.batch_size, seq_len=seq, vocab_size=vocab,

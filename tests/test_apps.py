@@ -9,7 +9,8 @@ from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 
 
 def test_alexnet_app(capsys):
-    assert alexnet.main(["-b", "4", "-i", "1", "-ll:tpu", "4"]) == 0
+    assert alexnet.main(["-b", "4", "-i", "1", "-ll:tpu", "4",
+                         "--image-size", "67"]) == 0
     out = capsys.readouterr().out
     assert "tp =" in out and "images/s" in out
 
@@ -124,5 +125,6 @@ def test_candle_uno_app_hybrid_granules(capsys):
 def test_alexnet_app_accum_steps(capsys):
     assert alexnet.main([
         "-b", "8", "-i", "1", "-ll:tpu", "4", "--accum-steps", "2",
+        "--image-size", "67",
     ]) == 0
     assert "tp =" in capsys.readouterr().out
