@@ -78,6 +78,31 @@ def load_strategy(cfg: FFConfig, num_devices: int) -> Optional[StrategyStore]:
     return StrategyStore.load(cfg.strategy_file, num_devices=num_devices)
 
 
+def _dry_run(ff: FFModel, ex) -> Dict[str, float]:
+    """``--dry-run``: the reference's DISABLE_COMPUTATION mode —
+    exercise the whole graph/strategy/trace machinery with zero device
+    compute (Executor.abstract_step = jax.eval_shape of the full train
+    step) and print the op table."""
+    avals = ex.abstract_step()
+    total = 0
+    print(f"{'op':<24} {'strategy':<18} outputs")
+    for op in ff.layers:
+        pc = ex.strategy.find(op.name)
+        deg = "x".join(
+            f"{a}{pc.degree(a)}" for a in "nchws" if pc.degree(a) > 1
+        ) or "replicated"
+        outs = ", ".join(f"{t.shape}" for t in op.outputs) or "(loss)"
+        print(f"{op.name:<24} {deg:<18} {outs}")
+        for spec in op.param_specs().values():
+            total += int(np.prod(spec.shape))
+    metrics = avals[3]
+    print(f"parameters = {total}")
+    print(f"metrics = {sorted(metrics)}")
+    print("DRY RUN OK (no device compute)")
+    return {"parameters": float(total), "elapsed_s": 0.0,
+            "samples_per_s": 0.0}
+
+
 def run_training(
     ff: FFModel,
     cfg: FFConfig,
@@ -124,6 +149,14 @@ def run_training(
                 "--granules (hybrid mesh) and device-subset placement "
                 "cannot combine yet"
             )
+    if cfg.dry_run:
+        if isinstance(ex, PipelineExecutor):
+            raise SystemExit(
+                "--dry-run supports full-mesh strategies only (layer-wise "
+                "device-subset placement compiles per stage); drop -s or "
+                "use a full-mesh strategy"
+            )
+        return _dry_run(ff, ex)
     trainer = Trainer(ex)
     batches = None
     if arrays is None and cfg.dataset_path:

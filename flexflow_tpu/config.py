@@ -64,6 +64,11 @@ class FFConfig:
     granules: int = 0
     # Pipeline microbatches for device-subset (layer-wise) strategies.
     microbatches: int = 1
+    # Compute-free graph/shape validation (the reference's
+    # DISABLE_COMPUTATION build, ``ops.h:19``): trace the full train
+    # step under jax.eval_shape and print the op/param table, running
+    # nothing on any device.
+    dry_run: bool = False
 
     @staticmethod
     def parse_args(argv: Sequence[str]) -> "FFConfig":
@@ -108,6 +113,8 @@ class FFConfig:
                 cfg.num_nodes = int(_next())
             elif a == "--profiling":
                 cfg.profiling = True
+            elif a == "--dry-run":
+                cfg.dry_run = True
             elif a == "--remat":
                 cfg.remat = True
             elif a in ("-i", "--iterations"):

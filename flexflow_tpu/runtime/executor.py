@@ -134,27 +134,8 @@ class Executor:
         target shardings (reference: initializer index tasks over the
         weight partitions, ``initializer_kernel.cu:24-179``)."""
         seed = self.config.seed if seed is None else seed
-
-        def init_fn(key):
-            params: Dict[str, Dict[str, jax.Array]] = {}
-            state: Dict[str, Dict[str, jax.Array]] = {}
-            for op in self.model.layers:
-                pspecs = op.param_specs()
-                if pspecs:
-                    params[op.name] = {}
-                    for k, spec in sorted(pspecs.items()):
-                        key, sub = jax.random.split(key)
-                        params[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
-                sspecs = op.state_specs()
-                if sspecs:
-                    state[op.name] = {}
-                    for k, spec in sorted(sspecs.items()):
-                        key, sub = jax.random.split(key)
-                        state[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
-            return params, state
-
         out_sh = (self.params_shardings(), self.state_shardings())
-        params, state = jax.jit(init_fn, out_shardings=out_sh)(
+        params, state = jax.jit(self._init_fn, out_shardings=out_sh)(
             jax.random.PRNGKey(seed)
         )
         opt_state = self.optimizer.init(params)
@@ -194,6 +175,27 @@ class Executor:
                 continue
             out.append(op)
         return out
+
+    def _init_fn(self, key):
+        """Pure initializer over the op graph — jitted by :meth:`init`
+        and eval_shape'd by :meth:`abstract_step`, so the two cannot
+        diverge."""
+        params: Dict[str, Dict[str, jax.Array]] = {}
+        state: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.layers:
+            pspecs = op.param_specs()
+            if pspecs:
+                params[op.name] = {}
+                for k, spec in sorted(pspecs.items()):
+                    key, sub = jax.random.split(key)
+                    params[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
+            sspecs = op.state_specs()
+            if sspecs:
+                state[op.name] = {}
+                for k, spec in sorted(sspecs.items()):
+                    key, sub = jax.random.split(key)
+                    state[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
+        return params, state
 
     # -- forward -----------------------------------------------------------
 
@@ -394,6 +396,50 @@ class Executor:
             return loss, outs
 
         return jax.jit(fwd)
+
+    # -- compute-free modes --------------------------------------------------
+    #
+    # The reference's DISABLE_COMPUTATION build compiles the whole
+    # task/partition machinery with the kernels stubbed out
+    # (``ops.h:19``, ``model.h:573-575``) — its "fake backend" for
+    # exercising the runtime without GPUs.  The jax analogues: trace
+    # the full train step under eval_shape (zero FLOPs, validates the
+    # graph, shardings and dtypes), or AOT-lower it to stablehlo text.
+
+    def _abstract_batch(self):
+        return {
+            t.name: jax.ShapeDtypeStruct(t.shape, t.dtype)
+            for t in self.model.input_tensors
+        }
+
+    def _abstract_init(self):
+        """(params, opt_state, state) avals via eval_shape of the REAL
+        init path — no device is touched (even the PRNG key stays
+        abstract)."""
+        key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        params, state = jax.eval_shape(self._init_fn, key)
+        opt_state = jax.eval_shape(self.optimizer.init, params)
+        return params, opt_state, state
+
+    def abstract_step(self):
+        """``jax.eval_shape`` over init + one train step: returns the
+        (params, opt_state, state, metrics) avals without touching any
+        device."""
+        params, opt_state, state = self._abstract_init()
+        return jax.eval_shape(
+            self.build_train_step(), params, opt_state, state,
+            self._abstract_batch(),
+        )
+
+    def lower_train_step(self):
+        """AOT-lower the cached jitted train step (the exact function
+        :meth:`train_step` runs): the returned ``Lowered`` exposes
+        ``.as_text()`` (stablehlo) and ``.compile()`` — the inspection
+        path the reference lacked."""
+        params, opt_state, state = self._abstract_init()
+        return self.train_step.lower(
+            params, opt_state, state, self._abstract_batch()
+        )
 
     # -- data placement ----------------------------------------------------
 
