@@ -130,6 +130,31 @@ def bench_dlrm(n_chips: int, on_tpu: bool):
     return stats["samples_per_s"]
 
 
+def bench_transformer(on_tpu: bool):
+    """Long-context flagship: GPT-style LM step with the Pallas flash
+    attention kernel (dense single-chip path; the ring/CP path is
+    exercised by the driver's multi-chip dry run).  Reports tokens/s."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    from flexflow_tpu.optim import AdamOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    batch = 8 if on_tpu else 2
+    seq = 2048 if on_tpu else 128
+    ff = build_transformer_lm(
+        batch_size=batch, seq_len=seq, vocab_size=32768, d_model=512,
+        num_heads=8, num_layers=6 if on_tpu else 2,
+        config=FFConfig(batch_size=batch, compute_dtype="bfloat16"),
+    )
+    import jax
+
+    ex = Executor(ff, optimizer=AdamOptimizer(lr=1e-4),
+                  devices=jax.devices()[:1])  # single-chip by contract
+    stats = Trainer(ex).fit(iterations=10 if on_tpu else 3, warmup=2)
+    return stats["samples_per_s"] * seq
+
+
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
     speedup (the ICML'18 headline; reference prints dpCompTime /
@@ -186,6 +211,13 @@ def main():
             extra["dlrm_samples_per_s"] = round(bench_dlrm(n_chips, on_tpu), 2)
     except Exception as e:  # DLRM failure must not sink the headline
         extra["dlrm_error"] = f"{type(e).__name__}: {e}"
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["transformer_tokens_per_s"] = round(
+                bench_transformer(on_tpu), 1
+            )
+    except Exception as e:
+        extra["transformer_error"] = f"{type(e).__name__}: {e}"
     try:
         with contextlib.redirect_stdout(sys.stderr):
             # ICML'18 reports 4-chip speedups; simulate at least that

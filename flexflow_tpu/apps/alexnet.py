@@ -24,7 +24,8 @@ def main(argv=None) -> int:
     ff = build_alexnet(batch_size=cfg.batch_size, image_size=image_size,
                        config=cfg)
     stats = run_training(ff, cfg, int_high={"label": 1000}, label="images")
-    print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
+    if not stats.get("dry_run"):
+        print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
     return 0
 
 

@@ -31,7 +31,7 @@ def main(argv=None) -> int:
         candle_uno_strategy(cfg.resolve_num_devices(), candle)
     )
     arrays = None
-    if cfg.dataset_path:
+    if cfg.dataset_path and not cfg.dry_run:
         # -d <dir>: one CSV per model input tensor, "<dir>/<name>.csv"
         # (the candle per-feature-file layout).
         import os

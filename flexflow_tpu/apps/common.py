@@ -35,7 +35,7 @@ from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data.loader import ArrayDataLoader, PrefetchLoader, synthetic_arrays
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.optim import AdamOptimizer, SGDOptimizer
-from flexflow_tpu.parallel.strategy import StrategyStore
+from flexflow_tpu.parallel.strategy import AXES, StrategyStore
 from flexflow_tpu.runtime.pipeline import PipelineExecutor, make_executor
 from flexflow_tpu.runtime.trainer import Trainer
 
@@ -89,7 +89,7 @@ def _dry_run(ff: FFModel, ex) -> Dict[str, float]:
     for op in ff.layers:
         pc = ex.strategy.find(op.name)
         deg = "x".join(
-            f"{a}{pc.degree(a)}" for a in "nchws" if pc.degree(a) > 1
+            f"{a}{pc.degree(a)}" for a in AXES if pc.degree(a) > 1
         ) or "replicated"
         outs = ", ".join(f"{t.shape}" for t in op.outputs) or "(loss)"
         print(f"{op.name:<24} {deg:<18} {outs}")
@@ -100,7 +100,7 @@ def _dry_run(ff: FFModel, ex) -> Dict[str, float]:
     print(f"metrics = {sorted(metrics)}")
     print("DRY RUN OK (no device compute)")
     return {"parameters": float(total), "elapsed_s": 0.0,
-            "samples_per_s": 0.0}
+            "samples_per_s": 0.0, "dry_run": True}
 
 
 def run_training(

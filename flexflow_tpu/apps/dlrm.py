@@ -43,7 +43,7 @@ def main(argv=None) -> int:
     strategy = load_strategy(cfg, ndev) or dlrm_strategy(ndev, dlrm)
     int_high = {"sparse_input": min(dlrm.embedding_size)}
     arrays = None
-    if cfg.dataset_path:
+    if cfg.dataset_path and not cfg.dry_run:
         # The reference's Criteo HDF5 schema (dlrm.cc:239-281).
         from flexflow_tpu.data.criteo import make_dlrm_arrays
 

@@ -48,7 +48,8 @@ def main(argv=None) -> int:
     int_high = {"src": vocab, "tgt": vocab, "label": vocab}
     stats = run_training(ff, cfg, strategy=strategy, int_high=int_high,
                          label="sentence-pairs")
-    print(f"time = {stats['elapsed_s']:.4f}s")  # nmt.cc:77-83
+    if not stats.get("dry_run"):
+        print(f"time = {stats['elapsed_s']:.4f}s")  # nmt.cc:77-83
     return 0
 
 

@@ -45,8 +45,8 @@ def main(argv=None) -> int:
     int_high = {"tokens": vocab, "label": vocab}
     stats = run_training(ff, cfg, strategy=strategy, int_high=int_high,
                          label="sequences")
-    toks = stats["samples_per_s"] * seq
-    print(f"tokens/s = {toks:.0f}")
+    if not stats.get("dry_run"):
+        print(f"tokens/s = {stats['samples_per_s'] * seq:.0f}")
     return 0
 
 
