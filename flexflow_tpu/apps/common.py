@@ -172,7 +172,8 @@ def run_training(
         # staging); Trainer.fit's own shard_batch is then a no-op.
         batches = PrefetchLoader(
             iter(ArrayDataLoader(arrays, cfg.batch_size, shuffle=True,
-                                 seed=cfg.seed)),
+                                 seed=cfg.seed,
+                                 nthreads=cfg.loaders_per_node)),
             ex.shard_batch,
         )
     iters = cfg.iterations * max(cfg.epochs, 1)

@@ -32,6 +32,9 @@ class FFConfig:
     # numNodes (reference: model.cc:765-779 re-reads -ll:gpu / --nodes).
     num_devices: int = 0  # 0 = use all visible jax devices
     num_nodes: int = 1
+    # Host data-loader threads (the reference's -ll:cpu loadersPerNode,
+    # model.cc:765-779); 0 = auto (min(8, cores)).
+    loaders_per_node: int = 0
     # Data / strategy files.
     dataset_path: Optional[str] = None  # -d; None => synthetic input
     strategy_file: Optional[str] = None  # -s
@@ -107,6 +110,8 @@ class FFConfig:
                 cfg.synthetic_input = False
             elif a == "-s" or a == "--strategy":
                 cfg.strategy_file = _next()
+            elif a == "-ll:cpu":
+                cfg.loaders_per_node = int(_next())
             elif a in ("-ll:tpu", "-ll:gpu"):
                 cfg.num_devices = int(_next())
             elif a == "--nodes":

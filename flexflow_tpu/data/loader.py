@@ -31,7 +31,11 @@ class ArrayDataLoader:
         batch_size: int,
         shuffle: bool = False,
         seed: int = 0,
+        nthreads: int = 0,
     ):
+        #: gather threads (the reference's -ll:cpu loadersPerNode);
+        #: 0 = auto in the native gather.
+        self.nthreads = nthreads
         # Tail rows beyond the last full batch are dropped each epoch:
         # jit recompiles per batch shape, so ragged final batches are
         # hostile on TPU (and the reference's loaders are fixed-shape).
@@ -70,7 +74,10 @@ class ArrayDataLoader:
         self._pos += self.batch_size
         from flexflow_tpu.native import gather_rows
 
-        return {k: gather_rows(v, idx) for k, v in self.arrays.items()}
+        return {
+            k: gather_rows(v, idx, nthreads=self.nthreads)
+            for k, v in self.arrays.items()
+        }
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:

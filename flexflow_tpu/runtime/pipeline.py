@@ -16,12 +16,14 @@ still apply within the submesh.  Stage boundaries are plain
 ``jax.device_put`` transfers between submeshes (ICI, async).  The
 backward pass is remat-style — each stage stores only its *inputs*
 and recomputes activations inside its backward jit (``jax.vjp``), the
-standard memory-optimal schedule for pipeline stages.  Because stages
-occupy disjoint devices and jax dispatch is asynchronous, issuing the
-microbatched stage programs in dependency order yields GPipe-like
-fill/drain overlap without an explicit schedule: microbatch ``i`` on
-stage ``k`` runs concurrently with microbatch ``i+1`` on stage
-``k-1``.
+standard memory-optimal schedule for pipeline stages.  When stages
+occupy disjoint devices, asynchronous jax dispatch of the microbatched
+stage programs in dependency order yields GPipe-like fill/drain
+overlap without an explicit schedule: microbatch ``i`` on stage ``k``
+runs concurrently with microbatch ``i+1`` on stage ``k-1``.  Stages
+MAY share devices (the reference permits arbitrary per-op device
+lists, ``config.h:39-48``); overlapping stages serialize on the shared
+devices — Legion's semantics — and a warning notes the lost overlap.
 
 Numerics are exactly the single-executor step: mean-reduction losses
 make the microbatch-mean gradient equal the full-batch gradient (the
@@ -100,16 +102,37 @@ def derive_stages(model: FFModel, strategy: StrategyStore) -> List[Stage]:
     if not placements:
         raise PlacementError("no op in the strategy carries device_ids")
 
-    # Disjointness — a device serving two stages would serialize them.
+    # Overlap check — a device serving two stages serializes them, so
+    # the GPipe fill/drain overlap vanishes there.  The reference
+    # permits arbitrary per-op device lists (``config.h:39-48``; its
+    # README AlexNet table reuses GPU 0 in five layers) with Legion
+    # serializing on data dependencies — sequential dispatch of the
+    # stage programs gives exactly those semantics, so overlap is
+    # legal here too, just not pipelined.
+    for si, ids in enumerate(placements):
+        if len(set(ids)) != len(ids):
+            raise PlacementError(
+                f"stage {si} repeats a device in its device_ids {ids}; "
+                f"each device may appear once per stage"
+            )
     seen: Dict[int, int] = {}
+    overlaps: List[Tuple[int, int, int]] = []
     for si, ids in enumerate(placements):
         for d in ids:
-            if d in seen:
-                raise PlacementError(
-                    f"device {d} appears in stages {seen[d]} and {si}; "
-                    f"stage device sets must be disjoint"
-                )
-            seen[d] = si
+            if d in seen and seen[d] != si:
+                overlaps.append((d, seen[d], si))
+            else:
+                seen[d] = si
+    if overlaps:
+        d, a, b = overlaps[0]
+        _log.warning(
+            "stage device sets overlap (device %d serves stages %d and %d"
+            "%s): stages sharing devices serialize — layer-wise placement "
+            "semantics are preserved but there is no pipeline overlap "
+            "between them",
+            d, a, b,
+            f", +{len(overlaps) - 1} more" if len(overlaps) > 1 else "",
+        )
 
     # Propagate placement to unplaced ops: producer's stage (max over
     # inputs keeps dataflow forward), inputs-only ops to stage 0.
@@ -160,9 +183,9 @@ def derive_stages(model: FFModel, strategy: StrategyStore) -> List[Stage]:
 
 
 class PipelineExecutor:
-    """Executes an FFModel whose strategy places op groups on disjoint
-    device subsets — the runtime realization of ``device_ids``
-    (simulator-only in round 1).
+    """Executes an FFModel whose strategy places op groups on device
+    subsets (disjoint or overlapping) — the runtime realization of
+    ``device_ids`` (simulator-only in round 1).
 
     ``microbatches`` splits the batch GPipe-style; 1 reproduces the
     reference's plain layer-wise placement (compute still pipelined

@@ -108,3 +108,16 @@ def test_csv_headerless_keeps_all_rows(tmp_path):
     p.write_text("1,2\n3,4\n5,6\n")
     assert load_csv_matrix(str(p)).shape == (3, 2)  # auto keeps row 1
     assert load_csv_matrix(str(p), skip_header=True).shape == (2, 2)
+
+
+def test_loader_nthreads_flag():
+    """-ll:cpu (reference loadersPerNode, model.cc:765-779) plumbs into
+    the native gather's thread count."""
+    from flexflow_tpu.config import FFConfig
+
+    cfg = FFConfig.parse_args(["-ll:cpu", "3", "-b", "8"])
+    assert cfg.loaders_per_node == 3
+    arrays = {"x": np.arange(64, dtype=np.float32).reshape(16, 4)}
+    dl = ArrayDataLoader(arrays, batch_size=4, nthreads=3)
+    b = dl.next_batch()
+    np.testing.assert_array_equal(b["x"], arrays["x"][:4])
