@@ -78,21 +78,37 @@ def load_strategy(cfg: FFConfig, num_devices: int) -> Optional[StrategyStore]:
     return StrategyStore.load(cfg.strategy_file, num_devices=num_devices)
 
 
-def _dry_run(ff: FFModel, ex) -> Dict[str, float]:
+def _dry_run(ff: FFModel, ex, strategy: Optional[StrategyStore]) -> Dict[str, float]:
     """``--dry-run``: the reference's DISABLE_COMPUTATION mode —
     exercise the whole graph/strategy/trace machinery with zero device
-    compute (Executor.abstract_step = jax.eval_shape of the full train
-    step) and print the op table."""
+    compute (abstract_step = jax.eval_shape of the full train step)
+    and print the op table.  Works for both full-mesh and layer-wise
+    (PipelineExecutor) strategies."""
+    store = strategy if strategy is not None else ex.strategy
+    # For layer-wise strategies the authoritative placement is the
+    # derived stage (unplaced ops inherit their producer's stage),
+    # not the raw strategy table.
+    stage_devices = {
+        op.name: st.device_ids
+        for st in getattr(ex, "stages", [])
+        for op in st.ops
+    }
     avals = ex.abstract_step()
     total = 0
-    print(f"{'op':<24} {'strategy':<18} outputs")
+    print(f"{'op':<24} {'strategy':<18} {'devices':<12} outputs")
     for op in ff.layers:
-        pc = ex.strategy.find(op.name)
+        pc = store.find(op.name)
         deg = "x".join(
             f"{a}{pc.degree(a)}" for a in AXES if pc.degree(a) > 1
         ) or "replicated"
+        if op.name in stage_devices:
+            devs = " ".join(str(d) for d in stage_devices[op.name])
+        elif pc.device_ids is not None:
+            devs = " ".join(str(d) for d in pc.device_ids)
+        else:
+            devs = "all"
         outs = ", ".join(f"{t.shape}" for t in op.outputs) or "(loss)"
-        print(f"{op.name:<24} {deg:<18} {outs}")
+        print(f"{op.name:<24} {deg:<18} {devs:<12} {outs}")
         for spec in op.param_specs().values():
             total += int(np.prod(spec.shape))
     metrics = avals[3]
@@ -150,13 +166,7 @@ def run_training(
                 "cannot combine yet"
             )
     if cfg.dry_run:
-        if isinstance(ex, PipelineExecutor):
-            raise SystemExit(
-                "--dry-run supports full-mesh strategies only (layer-wise "
-                "device-subset placement compiles per stage); drop -s or "
-                "use a full-mesh strategy"
-            )
-        return _dry_run(ff, ex)
+        return _dry_run(ff, ex, strategy)
     trainer = Trainer(ex)
     batches = None
     if arrays is None and cfg.dataset_path:

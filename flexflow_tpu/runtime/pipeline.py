@@ -455,7 +455,63 @@ class PipelineExecutor:
         }
         return new_params, new_opt, stage_state, m_out
 
-    # -- inference ----------------------------------------------------------
+    # -- compute-free mode ---------------------------------------------------
+
+    def abstract_step(self):
+        """Per-stage ``jax.eval_shape`` of init + forward + backward
+        (stage vjp) + optimizer update — the compute-free
+        DISABLE_COMPUTATION analogue, mirroring Executor.abstract_step
+        over the pipeline's actual per-stage programs.  Returns
+        (params, opt_state, state, metrics) avals keyed by stage
+        index; cross-stage activations are threaded abstractly and
+        metrics come from the final stage, matching train_step."""
+        params, opt_state, state = {}, {}, {}
+        metrics: Dict[str, Any] = {}
+        boundary: Dict[str, Any] = {}
+        graph_inputs = {t.name for t in self.model.input_tensors}
+        S = len(self.stages)
+        stage_inputs: List[Dict[str, Any]] = []
+        for si, st in enumerate(self.stages):
+            ex = self.stage_ex[si]
+            p, o, s = ex._abstract_init()
+            params[si], opt_state[si], state[si] = p, o, s
+            inputs = {}
+            for n in st.in_names:
+                spec = self._spec_of[n]
+                if n in graph_inputs:
+                    inputs[n] = jax.ShapeDtypeStruct(spec.shape, spec.dtype)
+                else:
+                    inputs[n] = boundary[n]
+            stage_inputs.append(inputs)
+
+            def fwd(p, s, xs, _ex=ex, _st=st):
+                loss, mets, new_state, env = _ex.forward(
+                    p, s, xs, training=True
+                )
+                return {n: env[n] for n in _st.out_names}, loss, mets
+
+            outs, loss, mets = jax.eval_shape(fwd, p, s, inputs)
+            boundary.update(outs)
+            if si == S - 1:
+                metrics = mets
+        # Backward + optimizer, reverse order — the vjp and update
+        # trees must also be shape-valid for DRY RUN OK to mean "the
+        # whole step compiles".
+        dloss = jax.ShapeDtypeStruct((), jnp.float32)
+        for si in range(S - 1, -1, -1):
+            st = self.stages[si]
+            douts = {n: boundary[n] for n in st.out_names}
+
+            def bwd(p, s, xs, do, dl, _fn=self._stage_bwd(si)):
+                return _fn(p, s, xs, do, dl)
+
+            dparams, dxs, _, _ = jax.eval_shape(
+                bwd, params[si], state[si], stage_inputs[si], douts, dloss
+            )
+            jax.eval_shape(
+                self.optimizer.update, params[si], opt_state[si], dparams
+            )
+        return params, opt_state, state, metrics
 
     def eval_step(self, params, state, batch):
         graph_inputs = {t.name for t in self.model.input_tensors}

@@ -65,3 +65,17 @@ def test_dry_run_flag(capsys):
     assert "DRY RUN OK" in out
     assert "parameters = " in out
     assert "conv1" in out and "n4" in out
+
+
+def test_dry_run_pipeline_strategy(capsys):
+    """--dry-run over a layer-wise (device-subset) strategy shows
+    per-stage placement and validates shapes with zero compute."""
+    from flexflow_tpu.apps import nmt
+
+    assert nmt.main([
+        "-b", "4", "--pipeline", "--vocab", "64", "--hidden", "16",
+        "--layers", "1", "-ll:tpu", "4", "--dry-run",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "DRY RUN OK" in out
+    assert "2 3" in out  # decoder half placement column
