@@ -20,8 +20,15 @@ from flexflow_tpu.models.candle_uno import (
 
 
 def main(argv=None) -> int:
-    cfg = FFConfig.parse_args(sys.argv[1:] if argv is None else argv)
-    candle = CandleConfig()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # --dense-layers / --dense-feature-layers (A-B-C widths) parse via
+    # CandleConfig; FFConfig ignores unknown flags (the DLRM app's
+    # pattern, dlrm.py).
+    try:
+        candle = CandleConfig.parse_args(argv)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    cfg = FFConfig.parse_args(argv)
     ff = build_candle_uno(batch_size=cfg.batch_size, candle=candle,
                           config=cfg)
     # Default strategy: the BASELINE "multi-host pod hybrid" — DP

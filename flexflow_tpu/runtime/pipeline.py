@@ -464,12 +464,15 @@ class PipelineExecutor:
         over the pipeline's actual per-stage programs.  Returns
         (params, opt_state, state, metrics) avals keyed by stage
         index; cross-stage activations are threaded abstractly and
-        metrics come from the final stage, matching train_step."""
+        metrics come from the final stage, matching train_step.  Stage
+        programs are validated at MICROBATCH shapes (batch split by
+        ``self.microbatches``), the shapes train_step actually runs."""
         params, opt_state, state = {}, {}, {}
         metrics: Dict[str, Any] = {}
         boundary: Dict[str, Any] = {}
         graph_inputs = {t.name for t in self.model.input_tensors}
         S = len(self.stages)
+        m = self.microbatches
         stage_inputs: List[Dict[str, Any]] = []
         for si, st in enumerate(self.stages):
             ex = self.stage_ex[si]
@@ -479,7 +482,14 @@ class PipelineExecutor:
             for n in st.in_names:
                 spec = self._spec_of[n]
                 if n in graph_inputs:
-                    inputs[n] = jax.ShapeDtypeStruct(spec.shape, spec.dtype)
+                    if spec.shape[0] % m:
+                        raise PlacementError(
+                            f"batch dim {spec.shape[0]} of input "
+                            f"{n!r} is not divisible by "
+                            f"microbatches={m}"
+                        )
+                    shape = (spec.shape[0] // m,) + tuple(spec.shape[1:])
+                    inputs[n] = jax.ShapeDtypeStruct(shape, spec.dtype)
                 else:
                     inputs[n] = boundary[n]
             stage_inputs.append(inputs)
@@ -501,12 +511,9 @@ class PipelineExecutor:
         for si in range(S - 1, -1, -1):
             st = self.stages[si]
             douts = {n: boundary[n] for n in st.out_names}
-
-            def bwd(p, s, xs, do, dl, _fn=self._stage_bwd(si)):
-                return _fn(p, s, xs, do, dl)
-
             dparams, dxs, _, _ = jax.eval_shape(
-                bwd, params[si], state[si], stage_inputs[si], douts, dloss
+                self._bwd_fns[si], params[si], state[si],
+                stage_inputs[si], douts, dloss,
             )
             jax.eval_shape(
                 self.optimizer.update, params[si], opt_state[si], dparams

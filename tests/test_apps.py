@@ -51,7 +51,10 @@ def test_nmt_app(capsys):
 
 
 def test_candle_uno_app(capsys):
-    assert candle_uno.main(["-b", "8", "-i", "1"]) == 0
+    assert candle_uno.main([
+        "-b", "8", "-i", "1",
+        "--dense-layers", "64-64", "--dense-feature-layers", "32",
+    ]) == 0
     assert "THROUGHPUT =" in capsys.readouterr().out
 
 
@@ -97,7 +100,10 @@ def test_candle_app_reads_csv_dir(tmp_path, capsys):
             for _ in range(n)
         )
         (tmp_path / f"{t.name}.csv").write_text(rows + "\n")
-    assert candle_uno.main(["-b", "4", "-i", "2", "-d", str(tmp_path)]) == 0
+    assert candle_uno.main([
+        "-b", "4", "-i", "2", "-d", str(tmp_path),
+        "--dense-layers", "64-64", "--dense-feature-layers", "32",
+    ]) == 0
     assert "THROUGHPUT =" in capsys.readouterr().out
 
 
@@ -118,6 +124,7 @@ def test_candle_uno_app_hybrid_granules(capsys):
     assert candle_uno.main([
         "-b", "16", "-i", "1", "--granules", "2", "-ll:tpu", "8",
         "--optimizer", "adam",
+        "--dense-layers", "64-64", "--dense-feature-layers", "32",
     ]) == 0
     assert "THROUGHPUT =" in capsys.readouterr().out
 

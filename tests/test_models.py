@@ -178,9 +178,9 @@ def test_cnn_catalog_shapes(builder, image_size, final_hw):
 def test_inception_small_train_step(rng):
     # Inception at reduced size: verify a full step runs (compile-heavy
     # models are exercised shape-only above).
-    ff = build_inception_v3(batch_size=2, image_size=128, num_classes=4)
+    ff = build_inception_v3(batch_size=2, image_size=75, num_classes=4)
     batch = {
-        "image": rng.standard_normal((2, 128, 128, 3)).astype(np.float32),
+        "image": rng.standard_normal((2, 75, 75, 3)).astype(np.float32),
         "label": rng.integers(0, 4, size=(2,)).astype(np.int32),
     }
     m = _one_step(ff, batch)
