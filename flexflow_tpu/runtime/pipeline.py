@@ -76,31 +76,59 @@ class _StageModel:
 
 
 def derive_stages(model: FFModel, strategy: StrategyStore) -> List[Stage]:
-    """Group ops by their ``device_ids`` placement into pipeline stages.
+    """Group ops into pipeline stages by their ``device_ids`` placement.
 
     Ops without an explicit placement inherit their (first) producer's
-    stage — graph inputs' consumers default to stage 0 — mirroring the
-    reference mapper's "same device as producer" default
-    (``mapper.cc:54-197``).  Stages must be closed under dataflow: an
-    op may only consume tensors from its own or earlier stages.
+    placement — graph inputs' consumers default to the first placed
+    list — mirroring the reference mapper's "same device as producer"
+    default (``mapper.cc:54-197``).  A stage is a maximal CONSECUTIVE
+    run of ops (graph order) sharing one placement, so interleaved
+    placements (A B A) form separate stages rather than an invalid
+    grouping; stages must be closed under dataflow: an op may only
+    consume tensors from its own or earlier stages.
     """
-    placements: List[Tuple[int, ...]] = []
-    stage_of_op: Dict[str, int] = {}
     producer: Dict[str, Op] = {}
     for op in model.layers:
         for t in op.outputs:
             producer[t.name] = op
 
+    explicit: Dict[str, Tuple[int, ...]] = {}
     for op in model.layers:
         ids = strategy.find(op.name).device_ids
         if ids is not None:
-            ids = tuple(ids)
-            if ids not in placements:
-                placements.append(ids)
-            stage_of_op[op.name] = placements.index(ids)
-
-    if not placements:
+            explicit[op.name] = tuple(ids)
+    if not explicit:
         raise PlacementError("no op in the strategy carries device_ids")
+    first_list = next(iter(explicit.values()))
+
+    # Placement list per op: unplaced ops inherit from their MOST
+    # DOWNSTREAM input producer (greatest graph position — the
+    # successor of the old max-stage rule), so a multi-input op joins
+    # the latest stage feeding it instead of spawning a spurious
+    # earlier-placement stage.
+    order = {op.name: i for i, op in enumerate(model.layers)}
+    list_of_op: Dict[str, Tuple[int, ...]] = {}
+    for op in model.layers:
+        if op.name in explicit:
+            list_of_op[op.name] = explicit[op.name]
+            continue
+        inherited = None
+        best = -1
+        for t in op.inputs:
+            p = producer.get(t.name)
+            if p is not None and p.name in list_of_op and order[p.name] > best:
+                best = order[p.name]
+                inherited = list_of_op[p.name]
+        list_of_op[op.name] = inherited if inherited is not None else first_list
+
+    # Stages = maximal consecutive runs of one placement.
+    placements: List[Tuple[int, ...]] = []
+    stage_of_op: Dict[str, int] = {}
+    for op in model.layers:
+        ids = list_of_op[op.name]
+        if not placements or placements[-1] != ids:
+            placements.append(ids)
+        stage_of_op[op.name] = len(placements) - 1
 
     # Overlap check — a device serving two stages serializes them, so
     # the GPipe fill/drain overlap vanishes there.  The reference
@@ -134,28 +162,8 @@ def derive_stages(model: FFModel, strategy: StrategyStore) -> List[Stage]:
             f", +{len(overlaps) - 1} more" if len(overlaps) > 1 else "",
         )
 
-    # Propagate placement to unplaced ops: producer's stage (max over
-    # inputs keeps dataflow forward), inputs-only ops to stage 0.
-    for op in model.layers:
-        if op.name in stage_of_op:
-            continue
-        stages_in = [
-            stage_of_op[producer[t.name].name]
-            for t in op.inputs if t.name in producer
-            if producer[t.name].name in stage_of_op
-        ]
-        stage_of_op[op.name] = max(stages_in, default=0)
-
-    # Validate monotone dataflow.
-    for op in model.layers:
-        si = stage_of_op[op.name]
-        for t in op.inputs:
-            p = producer.get(t.name)
-            if p is not None and stage_of_op[p.name] > si:
-                raise PlacementError(
-                    f"op {op.name!r} (stage {si}) consumes {t.name!r} "
-                    f"produced in later stage {stage_of_op[p.name]}"
-                )
+    # Dataflow monotonicity holds by construction: stages are
+    # consecutive runs in graph order and producers precede consumers.
 
     graph_inputs = {t.name for t in model.input_tensors}
     stages: List[Stage] = []

@@ -17,7 +17,10 @@ Usage::
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional
+
+_log = logging.getLogger("ff.search")
 
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.native import ffsim_search, ffsim_simulate
@@ -79,6 +82,22 @@ def search_strategy(
     table: Dict[str, ParallelConfig] = {}
     for op, cands, idx in zip(prob.ops, prob.candidates, res["assign"]):
         table[op.name] = cands[idx]
+    if any(pc.device_ids is not None for pc in table.values()):
+        # Mixed placement: give EVERY op its explicit device list (the
+        # canonical mesh placement for unpinned ops) so the runtime's
+        # stage derivation sees a fully-placed table
+        # (make_executor -> PipelineExecutor).
+        from flexflow_tpu.search.problem import shard_devices
+
+        table = {
+            name: (
+                pc if pc.device_ids is not None
+                else dataclasses.replace(
+                    pc, device_ids=tuple(shard_devices(plan, pc))
+                )
+            )
+            for name, pc in table.items()
+        }
     store = StrategyStore(num_devices, table)
     return SearchResult(
         store=store,
@@ -100,13 +119,34 @@ def simulate_strategy(
     nd = num_devices or store.num_devices
     plan = build_virtual_plan(nd)
     prob = build_problem(model, plan, device_model)
+    from flexflow_tpu.parallel.strategy import AXES
+    from flexflow_tpu.search.problem import shard_devices
+
     assign: List[int] = []
     for op, cands in zip(prob.ops, prob.candidates):
         pc = store.find(op.name)
+        idx: Optional[int] = None
         try:
-            assign.append(cands.index(pc))
+            idx = cands.index(pc)
         except ValueError:
-            # Not enumerated (e.g. explicit device_ids): fall back to
-            # the op's DP candidate.
-            assign.append(0)
+            # Match modulo canonical placement: a store entry whose
+            # explicit device list equals a candidate's canonical (or
+            # explicit) placement is the same strategy.
+            for j, c in enumerate(cands):
+                if all(c.degree(a) == pc.degree(a) for a in AXES) and (
+                    pc.device_ids is None
+                    or list(pc.device_ids) == shard_devices(plan, c)
+                ):
+                    idx = j
+                    break
+        if idx is None:
+            _log.warning(
+                "simulate_strategy: op %r config %s matches no enumerated "
+                "candidate (e.g. unaligned device block); costing its DP "
+                "fallback instead — the returned time does NOT reflect "
+                "this placement",
+                op.name, store.find(op.name).to_json(),
+            )
+            idx = 0
+        assign.append(idx)
     return ffsim_simulate(prob.text, assign)

@@ -121,13 +121,35 @@ def enumerate_candidates(
         (pc for pc in combos if pc != dp),
         key=lambda pc: (-pc.num_parts, pc.n, pc.c, pc.h, pc.w, pc.s),
     )
-    if len(rest) > max_candidates - 1:
+    # Device-shifted sub-mesh placements: a pure-n candidate using
+    # k < ndev devices may sit on ANY aligned k-block, not just the
+    # mesh origin — the search freedom behind the reference's per-table
+    # DLRM pinning (``dlrm_strategy.cc:11-19``: each 1-part embedding
+    # on a different GPU) and layer-wise NMT splits.  The runtime
+    # executes these via PipelineExecutor device subsets.
+    shifted: List[ParallelConfig] = []
+    for pc in [dp] + rest:
+        k = pc.num_parts
+        if k >= ndev or pc.num_parts != pc.n or pc.device_ids is not None:
+            continue
+        for b in range(1, ndev // k):
+            ids = tuple(range(b * k, (b + 1) * k))
+            shifted.append(ParallelConfig(n=pc.n, device_ids=ids))
+    # Smallest blocks first (single-device pinning is the DLRM case);
+    # shifted candidates get a RESERVED quota so hybrid-combo floods on
+    # big meshes cannot truncate the placement freedom away.
+    shifted.sort(key=lambda pc: (pc.num_parts, pc.device_ids))
+    quota = min(len(shifted), max(8, (max_candidates - 1) // 4))
+    budget = max_candidates - 1 - quota
+    if len(rest) > budget or len(shifted) > quota:
         _log.warning(
             "op %r: %d feasible strategies truncated to %d "
             "(pass max_candidates to widen)",
-            op.name, len(rest) + 1, max_candidates,
+            op.name, len(rest) + len(shifted) + 1, max_candidates,
         )
-    return [dp] + rest[: max_candidates - 1]
+    kept = rest[:budget]
+    kept += shifted[: max_candidates - 1 - len(kept)]
+    return [dp] + kept
 
 
 @dataclasses.dataclass

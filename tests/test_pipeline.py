@@ -275,3 +275,24 @@ def test_duplicate_device_in_one_stage_rejected():
     store.set("enc0", ParallelConfig(n=2, device_ids=(0, 0)))
     with pytest.raises(PlacementError, match="repeats a device"):
         derive_stages(ff, store)
+
+
+def test_unplaced_multi_input_op_inherits_most_downstream(rng):
+    """An unplaced op consuming tensors from two stages joins the
+    LATEST stage feeding it, regardless of input listing order."""
+    batch = 8
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, 8), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    a = ff.dense(x, 8, activation="relu", name="a")
+    b = ff.dense(a, 8, activation="relu", name="b")
+    t = ff.concat([b, a], axis=1, name="cat")  # earlier-stage input LAST
+    t = ff.dense(t, 4, name="head")
+    ff.softmax(t, lbl, name="softmax")
+
+    store = StrategyStore(4)
+    store.set("a", ParallelConfig(n=2, device_ids=(0, 1)))
+    store.set("b", ParallelConfig(n=2, device_ids=(2, 3)))
+    stages = derive_stages(ff, store)
+    assert len(stages) == 2
+    assert [op.name for op in stages[1].ops] == ["b", "cat", "head", "softmax"]

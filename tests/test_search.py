@@ -151,10 +151,10 @@ def _run_one_train_step(ff, store, n_classes, image, n_devices=8):
     import jax
 
     from flexflow_tpu.optim import SGDOptimizer
-    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.pipeline import make_executor
 
-    ex = Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.01),
-                  devices=jax.devices()[:n_devices])
+    ex = make_executor(ff, store, optimizer=SGDOptimizer(lr=0.01),
+                       devices=jax.devices()[:n_devices])
     params, opt_state, state = ex.init()
     rng = np.random.default_rng(0)
     batch = ex.shard_batch({
@@ -268,3 +268,63 @@ class TestEndToEndSearch:
         ])
         with pytest.raises(ValueError):
             ffsim_simulate(p, [0])
+
+
+class TestDeviceShiftedCandidates:
+    def test_candidates_include_shifted_blocks(self):
+        """Pure-n sub-mesh candidates exist on every aligned block, not
+        just the mesh origin (the reference's per-table DLRM pinning
+        freedom, dlrm_strategy.cc:11-19)."""
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+        from flexflow_tpu.search.problem import enumerate_candidates
+
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 16), name="x")
+        ff.dense(x, 16, name="fc")
+        plan = build_virtual_plan(4)
+        cands = enumerate_candidates(ff.layers[0], plan)
+        ids = {pc.device_ids for pc in cands if pc.device_ids is not None}
+        assert (1,) in ids and (2,) in ids and (3,) in ids
+        assert (2, 3) in ids
+
+    def test_searched_placement_table_executes(self):
+        """A searched table that mixes full-mesh and pinned ops (every
+        op carrying explicit device_ids) must run via make_executor."""
+        import jax
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+        from flexflow_tpu.optim import SGDOptimizer
+        from flexflow_tpu.runtime.pipeline import make_executor
+
+        ff = FFModel(FFConfig(batch_size=8))
+        import jax.numpy as jnp
+
+        ids_t = ff.create_tensor((8, 2), dtype=jnp.int32, name="ids")
+        lbl = ff.create_tensor((8,), dtype=jnp.int32, name="label")
+        e = ff.multi_embedding(ids_t, 2, 16, 4, name="tables")
+        e = ff.reshape(e, (8, 8), name="r")
+        t = ff.dense(e, 8, activation="relu", name="fc1")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+
+        store = StrategyStore(4)
+        # tables pinned off-origin, trunk on the full mesh.
+        store.set("tables", ParallelConfig(device_ids=(2,)))
+        for name in ("r", "fc1", "fc2", "softmax"):
+            store.set(name, ParallelConfig(n=4, device_ids=(0, 1, 2, 3)))
+        t_sim = simulate_strategy(ff, store, 4)
+        assert np.isfinite(t_sim) and t_sim > 0
+        ex = make_executor(ff, store, optimizer=SGDOptimizer(lr=0.1),
+                           devices=jax.devices()[:4])
+        params, opt_state, state = ex.init()
+        rng = np.random.default_rng(0)
+        batch = ex.shard_batch({
+            "ids": rng.integers(0, 16, size=(8, 2)).astype(np.int32),
+            "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+        })
+        params, opt_state, state, m = ex.train_step(
+            params, opt_state, state, batch
+        )
+        assert np.isfinite(float(jax.device_get(m["train_loss"])))
