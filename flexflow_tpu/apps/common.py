@@ -139,6 +139,20 @@ def run_training(
     ndev = cfg.resolve_num_devices()
     if strategy is None:
         strategy = load_strategy(cfg, ndev)
+    if cfg.search_iters > 0 and cfg.strategy_file is None:
+        # --search: inline automatic parallelization — the reference's
+        # offline simulator+MCMC run (scripts/simulator.cc) folded into
+        # app launch, its emitted table applied directly.
+        from flexflow_tpu.search import search_strategy
+
+        res = search_strategy(ff, num_devices=ndev, iters=cfg.search_iters,
+                              seed=cfg.seed)
+        if strategy is not None:
+            print("search: overriding the app's default strategy")
+        print(f"search: dp = {res.dp_time_us:.1f} us, best = "
+              f"{res.best_time_us:.1f} us, speedup = {res.speedup:.2f}x "
+              f"(simulated, {cfg.search_iters} MCMC iters)")
+        strategy = res.store
     mesh_plan = None
     if cfg.granules > 1:
         # Multi-host pod layout: DCN-spanning axes outermost so data

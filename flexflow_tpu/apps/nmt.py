@@ -31,6 +31,11 @@ def main(argv=None) -> int:
     hidden = pop_int(argv, "--hidden", 1024)
     layers = pop_int(argv, "--layers", 2)
     cfg = FFConfig.parse_args(argv)
+    if pipeline and cfg.search_iters:
+        raise SystemExit(
+            "--pipeline pins an explicit layer-wise placement; --search "
+            "would discard it — pass one or the other"
+        )
     ff = build_nmt(
         batch_size=cfg.batch_size, src_len=src_len, tgt_len=tgt_len,
         vocab_size=vocab, embed_dim=hidden, hidden_size=hidden,

@@ -72,6 +72,11 @@ class FFConfig:
     # step under jax.eval_shape and print the op/param table, running
     # nothing on any device.
     dry_run: bool = False
+    # --search: run the MCMC strategy autotuner at launch when no -s
+    # file is given (the reference runs its simulator offline and feeds
+    # the result back via -s; this folds the two steps into one run).
+    # Value = MCMC iterations; 0 = off.
+    search_iters: int = 0
 
     @staticmethod
     def parse_args(argv: Sequence[str]) -> "FFConfig":
@@ -138,6 +143,10 @@ class FFConfig:
                 cfg.granules = int(_next())
             elif a == "--microbatches":
                 cfg.microbatches = int(_next())
+            elif a == "--search":
+                cfg.search_iters = cfg.search_iters or 20_000
+            elif a == "--search-iters":
+                cfg.search_iters = int(_next())
             i += 1
         return cfg
 

@@ -56,15 +56,37 @@ def main(argv=None):
     ap.add_argument("--iters", type=int, default=50_000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--alpha", type=float, default=5.0)
+    ap.add_argument(
+        "--measured", action="store_true",
+        help="replace roofline compute costs with live per-op "
+             "microbenchmarks on the current backend (the reference's "
+             "measured simulator mode, scripts/cnn.h:204+)")
     ap.add_argument("-o", "--output", default="strategy.json")
     args = ap.parse_args(argv)
 
     from flexflow_tpu.search import search_strategy
 
     model = build_model(args.model, args.batch_size)
+    measured = None
+    if args.measured:
+        import jax
+
+        from flexflow_tpu.runtime.executor import Executor
+        from flexflow_tpu.runtime.profiler import measured_cost_table
+        from flexflow_tpu.runtime.trainer import Trainer
+
+        # Single-device executor: whole-op times, no collectives mixed
+        # into the compute estimate (the search adds comm itself).
+        ex = Executor(model, devices=jax.devices()[:1])
+        params, _, state = ex.init()
+        table = measured_cost_table(
+            ex, params, state, Trainer(ex).synthetic_batch()
+        )
+        print(f"measured {len(table)} op costs on {jax.default_backend()}")
+        measured = table
     res = search_strategy(
         model, num_devices=args.devices, iters=args.iters,
-        seed=args.seed, alpha=args.alpha,
+        seed=args.seed, alpha=args.alpha, measured_costs=measured,
     )
     if args.output.endswith(".pb"):
         # Reference wire format (strategy.proto) via the native codec —

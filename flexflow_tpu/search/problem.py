@@ -139,8 +139,10 @@ def enumerate_candidates(
     # shifted candidates get a RESERVED quota so hybrid-combo floods on
     # big meshes cannot truncate the placement freedom away.
     shifted.sort(key=lambda pc: (pc.num_parts, pc.device_ids))
-    quota = min(len(shifted), max(8, (max_candidates - 1) // 4))
-    budget = max_candidates - 1 - quota
+    quota = min(
+        len(shifted), max(8, (max_candidates - 1) // 4), max_candidates - 1
+    )
+    budget = max(0, max_candidates - 1 - quota)
     if len(rest) > budget or len(shifted) > quota:
         _log.warning(
             "op %r: %d feasible strategies truncated to %d "
@@ -148,7 +150,7 @@ def enumerate_candidates(
             op.name, len(rest) + len(shifted) + 1, max_candidates,
         )
     kept = rest[:budget]
-    kept += shifted[: max_candidates - 1 - len(kept)]
+    kept += shifted[: max(0, max_candidates - 1 - len(kept))]
     return [dp] + kept
 
 

@@ -129,6 +129,19 @@ def test_candle_uno_app_hybrid_granules(capsys):
     assert "THROUGHPUT =" in capsys.readouterr().out
 
 
+def test_alexnet_app_inline_search(capsys):
+    """--search: launch-time automatic parallelization (the reference's
+    offline simulator run folded into the app); the searched table must
+    drive a real dry-run (or training) step table."""
+    assert alexnet.main([
+        "-b", "8", "-i", "1", "-ll:tpu", "8", "--image-size", "67",
+        "--search-iters", "400", "--dry-run",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "search: dp =" in out and "speedup =" in out
+    assert "DRY RUN OK" in out
+
+
 def test_alexnet_app_accum_steps(capsys):
     assert alexnet.main([
         "-b", "8", "-i", "1", "-ll:tpu", "4", "--accum-steps", "2",

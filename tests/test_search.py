@@ -210,6 +210,21 @@ class TestEndToEndSearch:
         assert res.dp_time_us != pytest.approx(res2.dp_time_us)
         assert res.best_time_us <= res.dp_time_us
 
+    def test_cli_measured_mode(self, tmp_path, capsys):
+        """``python -m flexflow_tpu.search --measured`` microbenches
+        every op live (the reference's measured simulator inputs,
+        ``scripts/cnn.h:204+``) and still emits a loadable strategy."""
+        from flexflow_tpu.search.__main__ import main
+
+        out = tmp_path / "strategy.json"
+        assert main([
+            "--model", "alexnet", "-b", "2", "--devices", "4",
+            "--iters", "200", "--measured", "-o", str(out),
+        ]) in (0, None)
+        assert "measured 13 op costs" in capsys.readouterr().out
+        loaded = StrategyStore.load(str(out))
+        assert loaded.num_devices == 4
+
     def test_searched_strategy_runs_on_executor(self, alexnet):
         """The emitted table must be consumable by the runtime: compile
         and run one train step under the searched strategy on the
