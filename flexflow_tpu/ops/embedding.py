@@ -23,7 +23,7 @@ from flexflow_tpu.initializers import NormInitializer
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 
 
-def _row_kernels_ok(op: Op, n_ids: int, table) -> bool:
+def _row_kernels_ok(op: Op, n_ids: int, table, kind: str = "scatter") -> bool:
     """Use the Pallas row-DMA kernels (pallas_kernels.gather_rows /
     scatter_add_rows): XLA's TPU lowering of gather/scatter over a big
     table is a full-table sweep, the kernels touch only the addressed
@@ -46,7 +46,8 @@ def _row_kernels_ok(op: Op, n_ids: int, table) -> bool:
         return False
     from flexflow_tpu.ops import pallas_kernels as pk
 
-    return pk.rows_supported(n_ids, table.shape[-1], table.dtype)
+    return pk.rows_supported(n_ids, table.shape[-1], table.dtype,
+                             num_rows=rows, kind=kind)
 
 
 def _gather_dispatch(op: Op, table, flat_ids):
@@ -54,7 +55,7 @@ def _gather_dispatch(op: Op, table, flat_ids):
     Pallas row kernel when eligible, else ``jnp.take``.  Executor
     sparse path only (not differentiable through)."""
     d = table.shape[1]
-    if _row_kernels_ok(op, flat_ids.size, table):
+    if _row_kernels_ok(op, flat_ids.size, table, kind="gather"):
         from flexflow_tpu.ops import pallas_kernels as pk
 
         rows = pk.gather_rows(table, flat_ids.reshape(-1))

@@ -518,17 +518,41 @@ softmax_xent.defvjp(_xent_fwd, _xent_bwd)
 # ---------------------------------------------------------------------------
 
 
-def rows_supported(n_ids: int, dim: int, dtype=jnp.float32) -> bool:
-    """Gate for gather_rows/scatter_add_rows: the (1, 1, dim) row
-    blocks always meet the TPU block rule (full-size trailing dims),
-    so the only limits are the prefetched id vector (SMEM) and the
-    update matrix (VMEM) staying on-chip."""
+def rows_supported(
+    n_ids: int,
+    dim: int,
+    dtype=jnp.float32,
+    num_rows: Optional[int] = None,
+    kind: str = "scatter",
+) -> bool:
+    """Gate for gather_rows/scatter_add_rows.
+
+    ``kind="gather"`` needs only the on-chip bounds: its (1, 1, dim)
+    pipelined row blocks compile at any width (v5e-measured at 64, 128
+    and 256).  The scatter's manual HBM row DMAs require 128-lane
+    slices (Mosaic rejects anything else — d=64 and d=256 both fail,
+    d=128 compiles), so ``scatter_add_rows`` repacks the table to a
+    (P, 128) physical view; that works when ``dim`` is a multiple of
+    128 (column blocks) or divides 128 evenly with the table volume
+    128-aligned — the latter requires ``num_rows``, and the gate is
+    conservatively False without it.  Remaining limits for both kinds:
+    the prefetched id vector must fit SMEM and the (packed) update
+    matrix VMEM."""
     itemsize = jnp.dtype(dtype).itemsize
+    if n_ids < 1 or dim < 1:
+        return False
+    if kind == "gather" or dim % 128 == 0:
+        upd_lanes = max(dim, 1)
+        ids = n_ids * (dim // 128 if kind != "gather" and dim > 128 else 1)
+    elif 128 % dim == 0:
+        if num_rows is None or (num_rows * dim) % 128 != 0:
+            return False
+        upd_lanes, ids = 128, n_ids
+    else:
+        return False
     return (
-        n_ids >= 1
-        and dim >= 1
-        and n_ids * 4 <= 512 * 1024            # ids in SMEM
-        and n_ids * dim * itemsize <= 8 * 1024 * 1024  # updates in VMEM
+        ids * 4 <= 512 * 1024                       # ids in SMEM
+        and n_ids * upd_lanes * itemsize <= 8 * 1024 * 1024  # upds in VMEM
     )
 
 
@@ -590,9 +614,52 @@ def _scatter_add_kernel(idx_ref, table_ref, upd_ref, out_ref, row_vmem,
 def scatter_add_rows(table, flat_idx, updates,
                      interpret: Optional[bool] = None):
     """``table.at[flat_idx].add(updates)`` touching only the N rows;
-    the table buffer is aliased (donated) and updated in place."""
+    the table buffer is aliased (donated) and updated in place.
+
+    Mosaic only accepts 128-lane HBM row slices (v5e-measured: d=64
+    and d=256 both reject, d=128 compiles), so the kernel always runs
+    on a ``(P, 128)`` physical view: ``d`` a multiple of 128 splits
+    each row into column blocks with expanded ids; ``d`` dividing 128
+    packs ``128/d`` logical rows per physical row, lane-placing each
+    update by one-hot expansion (exact: one-hot multiply adds zeros).
+    Duplicate physical rows — duplicate ids OR distinct logical rows
+    sharing a packed row — stay correct because the kernel's RMW loop
+    is sequential.  The same reduction runs under ``interpret`` so CPU
+    tests cover it; dims fitting neither case (e.g. 96) are interpret-
+    only and raise on TPU (``rows_supported`` gates them off)."""
     if interpret is None:
         interpret = _interpret_default()
+    n = flat_idx.shape[0]
+    num_rows, d = table.shape
+    if d != 128:
+        if d % 128 == 0:
+            c = d // 128
+            idx = (flat_idx[:, None] * c + jnp.arange(c)[None, :]).reshape(-1)
+            out = _scatter_rows_128(
+                table.reshape(num_rows * c, 128), idx,
+                updates.reshape(n * c, 128), interpret,
+            )
+            return out.reshape(num_rows, d)
+        if 128 % d == 0 and (num_rows * d) % 128 == 0:
+            k = 128 // d
+            phys = flat_idx // k
+            onehot = jax.nn.one_hot(flat_idx % k, k, dtype=table.dtype)
+            upd = (onehot[:, :, None] * updates[:, None, :]).reshape(n, 128)
+            out = _scatter_rows_128(
+                table.reshape(num_rows * d // 128, 128), phys, upd, interpret
+            )
+            return out.reshape(num_rows, d)
+        if not interpret:
+            raise ValueError(
+                f"scatter_add_rows: row dim {d} needs d % 128 == 0 or "
+                f"128 % d == 0 (with 128-aligned table volume) on TPU"
+            )
+    return _scatter_rows_128(table, flat_idx, updates, interpret)
+
+
+def _scatter_rows_128(table, flat_idx, updates, interpret):
+    """The raw sequential-RMW kernel; on hardware ``table`` must be
+    (P, 128) (interpret mode accepts any width)."""
     n = flat_idx.shape[0]
     d = table.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -167,3 +167,25 @@ def test_row_kernels_interpret(rng):
     ref = np.asarray(table).copy()
     np.add.at(ref, np.asarray(idx), np.asarray(upd))  # dups accumulate
     np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("d", [64, 256, 32])
+def test_scatter_rows_repacked_dims(rng, d):
+    """Non-128 row dims run through the (P, 128) physical repack
+    (Mosaic rejects any other HBM row-slice width on hardware; the
+    same reduction executes under interpret so this pins its math):
+    d=256 -> column-block split, d=64/32 -> lane packing; duplicate
+    ids and packed-row sharing must still accumulate exactly."""
+    from flexflow_tpu.ops import pallas_kernels as pk
+
+    table = jnp.asarray(rng.standard_normal((40, d)), jnp.float32)
+    # Adjacent ids (0,1) share a physical row in the packed layout;
+    # duplicates (7,7) exercise the sequential-RMW guarantee.
+    idx = jnp.asarray([0, 1, 7, 7, 39, 2], jnp.int32)
+    upd = jnp.asarray(rng.standard_normal((6, d)), jnp.float32)
+
+    got = pk.scatter_add_rows(table, idx, upd, interpret=True)
+    ref = np.asarray(table).copy()
+    np.add.at(ref, np.asarray(idx), np.asarray(upd))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-6)
+    assert pk.rows_supported(6, d, num_rows=40)
