@@ -4,7 +4,8 @@ SURVEY.md §2.7).
 
 Flags beyond the common set: ``--seq --vocab --d-model --heads
 --layers --dp --sp --tp`` (dp x sp x tp hybrid; sp shards the sequence
-via ring attention over the mesh).
+via ring attention over the mesh) and ``--experts N`` (switch-style
+MoE FFNs; the tp degree then shards EXPERTS — expert parallelism).
 
 Example::
 
@@ -33,14 +34,16 @@ def main(argv=None) -> int:
     dp = pop_int(argv, "--dp", 1)
     sp = pop_int(argv, "--sp", 1)
     tp = pop_int(argv, "--tp", 1)
+    experts = pop_int(argv, "--experts", 0)
     cfg = FFConfig.parse_args(argv)
     ff = build_transformer_lm(
         batch_size=cfg.batch_size, seq_len=seq, vocab_size=vocab,
-        d_model=d_model, num_heads=heads, num_layers=layers, config=cfg,
+        d_model=d_model, num_heads=heads, num_layers=layers,
+        moe_experts=experts, config=cfg,
     )
     ndev = cfg.resolve_num_devices()
     strategy = load_strategy(cfg, ndev) or transformer_strategy(
-        ndev, num_layers=layers, dp=dp, sp=sp, tp=tp
+        ndev, num_layers=layers, dp=dp, sp=sp, tp=tp, moe=experts > 0
     )
     int_high = {"tokens": vocab, "label": vocab}
     stats = run_training(ff, cfg, strategy=strategy, int_high=int_high,

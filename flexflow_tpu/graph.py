@@ -29,6 +29,7 @@ from flexflow_tpu.ops import (
     HeteroEmbedding,
     LayerNorm,
     Linear,
+    MixtureOfExperts,
     MSELoss,
     MultiEmbedding,
     MultiHeadAttention,
@@ -253,6 +254,24 @@ class FFModel:
         return self._add(
             MultiHeadAttention(self._unique("attention", name), x, num_heads,
                                causal=causal, **kw)
+        )
+
+    def moe(
+        self,
+        x: TensorSpec,
+        num_experts: int,
+        ffn_dim: int,
+        capacity_factor: float = 1.25,
+        name: Optional[str] = None,
+        **kw,
+    ) -> TensorSpec:
+        """Switch-style mixture-of-experts FFN; a 'c' strategy degree
+        shards experts across the mesh (the reference's per-table
+        expert placement, ``dlrm_strategy.cc:5-36``, generalized — see
+        ``ops/moe.py``)."""
+        return self._add(
+            MixtureOfExperts(self._unique("moe", name), x, num_experts,
+                             ffn_dim, capacity_factor=capacity_factor, **kw)
         )
 
     def layer_norm(self, x: TensorSpec, name: Optional[str] = None, **kw) -> TensorSpec:

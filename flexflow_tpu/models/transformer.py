@@ -28,8 +28,14 @@ def build_transformer_lm(
     num_heads: int = 8,
     num_layers: int = 6,
     d_ff: Optional[int] = None,
+    moe_experts: int = 0,
+    moe_capacity_factor: float = 1.25,
     config: Optional[FFConfig] = None,
 ) -> FFModel:
+    """``moe_experts > 0`` swaps every block's dense MLP for a
+    switch-style mixture-of-experts FFN (``ops/moe.py``) — expert
+    parallelism at transformer scale (a 'c' degree on the moe ops
+    shards experts across the mesh)."""
     d_ff = d_ff or 4 * d_model
     ff = FFModel(config or FFConfig(batch_size=batch_size))
     tok = ff.create_tensor((batch_size, seq_len), dtype=jnp.int32,
@@ -43,8 +49,12 @@ def build_transformer_lm(
         a = ff.multihead_attention(a, num_heads, causal=True, name=f"blk{i}_attn")
         x = ff.add(x, a, name=f"blk{i}_res1")
         m = ff.layer_norm(x, name=f"blk{i}_ln2")
-        m = ff.dense(m, d_ff, activation="gelu", name=f"blk{i}_mlp_up")
-        m = ff.dense(m, d_model, name=f"blk{i}_mlp_down")
+        if moe_experts:
+            m = ff.moe(m, moe_experts, d_ff,
+                       capacity_factor=moe_capacity_factor, name=f"blk{i}_moe")
+        else:
+            m = ff.dense(m, d_ff, activation="gelu", name=f"blk{i}_mlp_up")
+            m = ff.dense(m, d_model, name=f"blk{i}_mlp_down")
         x = ff.add(x, m, name=f"blk{i}_res2")
     x = ff.layer_norm(x, name="ln_f")
     logits = ff.dense(x, vocab_size, name="lm_head")
@@ -58,9 +68,12 @@ def transformer_strategy(
     dp: int = 1,
     sp: int = 1,
     tp: int = 1,
+    moe: bool = False,
 ) -> StrategyStore:
     """dp × sp (ring/context) × tp (Megatron) hybrid; attention and
-    token-level ops get (n=dp, s=sp); MLP and lm_head get (n=dp, c=tp)."""
+    token-level ops get (n=dp, s=sp); MLP and lm_head get (n=dp, c=tp).
+    With ``moe``, each block's MoE op gets (n=dp, c=tp) — the 'c'
+    degree shards EXPERTS (expert parallelism over ICI)."""
     assert dp * sp <= num_devices and dp * tp <= num_devices
     store = StrategyStore(num_devices)
     seq_pc = ParallelConfig(n=dp, s=sp)
@@ -72,8 +85,11 @@ def transformer_strategy(
         store.set(f"blk{i}_attn", seq_pc)
         store.set(f"blk{i}_res1", seq_pc)
         store.set(f"blk{i}_ln2", seq_pc)
-        store.set(f"blk{i}_mlp_up", tp_pc)
-        store.set(f"blk{i}_mlp_down", seq_pc)
+        if moe:
+            store.set(f"blk{i}_moe", tp_pc)
+        else:
+            store.set(f"blk{i}_mlp_up", tp_pc)
+            store.set(f"blk{i}_mlp_down", seq_pc)
         store.set(f"blk{i}_res2", seq_pc)
     store.set("ln_f", seq_pc)
     store.set("lm_head", tp_pc)

@@ -4,6 +4,7 @@ from flexflow_tpu.ops.conv import Conv2D, Flat, Pool2D
 from flexflow_tpu.ops.embedding import Embedding, HeteroEmbedding, MultiEmbedding, WordEmbedding
 from flexflow_tpu.ops.linear import Linear
 from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
+from flexflow_tpu.ops.moe import MixtureOfExperts
 from flexflow_tpu.ops.norm import BatchNorm
 from flexflow_tpu.ops.rnn import LSTM
 from flexflow_tpu.ops.tensor_ops import Add, Concat, DotInteraction, Reshape
@@ -26,6 +27,7 @@ __all__ = [
     "Concat",
     "DotInteraction",
     "LayerNorm",
+    "MixtureOfExperts",
     "MultiHeadAttention",
     "PositionEmbedding",
     "Reshape",

@@ -1,0 +1,170 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+The reference's expert parallelism is per-table placement: each DLRM
+embedding table is its own op pinned to one GPU by the strategy
+(``dlrm_strategy.cc:5-36``), with Legion coherence moving each table's
+inputs to its device.  This op is that idea generalized to transformer
+scale — many expert FFNs, tokens routed to experts — expressed the
+TPU-native way (the GShard/Switch formulation): routing becomes dense
+one-hot dispatch/combine einsums, the expert dimension carries the
+``c`` sharding tag, and GSPMD inserts the token all-to-alls between
+the sample-sharded activations and the expert-sharded FFN batch —
+exactly where Legion inserted the per-table copies.
+
+Design notes (TPU-first):
+- Top-1 (switch) routing with a static per-expert capacity
+  ``ceil(cf * S / E)``: every shape is static, so the whole layer is
+  three einsums + a gate matmul on the MXU — no dynamic shapes, no
+  scatter.  Tokens overflowing an expert's capacity pass through with
+  a zero expert contribution (the standard switch-transformer drop).
+- Routing math runs in f32 (gate logits, cumulative positions) for
+  stable argmax/cumsum under bf16 activations.
+- The auxiliary load-balance loss (mean expert load x mean gate prob
+  x E) is returned as op state-free METRIC ``{name}_aux_loss`` via the
+  loss-op protocol of the consumer; here it is exposed as an output
+  metric hook: `aux_loss_weight` > 0 adds it into the training loss
+  through ``is_loss`` accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.initializers import GlorotUniform, ZeroInitializer
+from flexflow_tpu.ops.activations import apply_activation, check_activation
+from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+
+class MixtureOfExperts(Op):
+    """Switch-style MoE FFN over (batch, seq, d_model).
+
+    Strategy axes: ``n`` shards tokens (batch), ``c`` shards the
+    EXPERT dimension of every expert parameter and the expert compute
+    batch — the per-op placement freedom the reference used to pin
+    DLRM tables, realized as GSPMD all-to-alls instead of coherence
+    copies.  ``is_loss`` contributes the weighted aux balance loss so
+    routing stays trained (metrics report it separately).
+    """
+
+    is_loss = True
+
+    def __init__(
+        self,
+        name: str,
+        x: TensorSpec,
+        num_experts: int,
+        ffn_dim: int,
+        capacity_factor: float = 1.25,
+        activation: str = "gelu",
+        aux_loss_weight: float = 1e-2,
+        kernel_initializer=None,
+    ):
+        super().__init__(name, [x])
+        assert x.ndim == 3, f"moe input must be (batch, seq, d), got {x.shape}"
+        check_activation(activation)
+        b, t, d = x.shape
+        tokens = b * t
+        assert num_experts >= 2, "moe needs >= 2 experts"
+        self.attrs = dict(
+            num_experts=num_experts,
+            ffn_dim=ffn_dim,
+            capacity_factor=capacity_factor,
+            # Declared-shape capacity (introspection; forward recomputes
+            # from the runtime token count so microbatched execution —
+            # accum scan, pipeline microbatches — drops tokens at the
+            # same per-token rate as the full batch).
+            capacity=self.capacity_for(tokens, capacity_factor, num_experts),
+            activation=activation,
+            aux_loss_weight=aux_loss_weight,
+        )
+        self.d_model = d
+        self.kernel_initializer = kernel_initializer or GlorotUniform()
+        self._make_output(x.shape, x.dtype, x.dim_axes)
+
+    @staticmethod
+    def capacity_for(tokens: int, cf: float, e: int) -> int:
+        """Static per-expert slot count for ``tokens`` routed tokens,
+        padded to a lane-friendly multiple of 8."""
+        cap = int(-(-cf * tokens // e))
+        return max(8, -(-cap // 8) * 8)
+
+    def capacity(self, tokens: int) -> int:
+        return self.capacity_for(
+            tokens, self.attrs["capacity_factor"], self.attrs["num_experts"]
+        )
+
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        d = self.d_model
+        e = self.attrs["num_experts"]
+        f = self.attrs["ffn_dim"]
+        dt = self.outputs[0].dtype
+        ki = self.kernel_initializer
+        return {
+            # Router stays replicated (tiny).
+            "gate": ParamSpec((d, e), dt, ki),
+            # Expert weights: expert dim carries the 'c' tag -> a
+            # c-degree strategy shards experts across the mesh (the
+            # reference's one-table-per-GPU, ``dlrm_strategy.cc:11-19``).
+            "w1": ParamSpec((e, d, f), dt, ki, ("c", None, None)),
+            "b1": ParamSpec((e, f), dt, ZeroInitializer(), ("c", None)),
+            "w2": ParamSpec((e, f, d), dt, ki, ("c", None, None)),
+            "b2": ParamSpec((e, d), dt, ZeroInitializer(), ("c", None)),
+        }
+
+    #: MoE is the heaviest op in its block and its loss term is a cheap
+    #: scalar byproduct — per-layer remat must include it despite
+    #: ``is_loss`` (the executor's guard exists for terminal loss ops).
+    allow_remat = True
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        b, t, d = x.shape
+        e = self.attrs["num_experts"]
+        s = b * t
+        # Capacity follows the RUNTIME token count (microbatched
+        # executions shrink the sample dim; per-token drop behavior
+        # must match the declared-batch step).
+        cap = self.capacity(s)
+        xf = x.reshape(s, d)
+
+        # -- routing (f32) --------------------------------------------
+        logits = (xf.astype(jnp.float32) @ params["gate"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)                  # (S, E)
+        expert = jnp.argmax(probs, axis=-1)                      # (S,)
+        gate_w = jnp.max(probs, axis=-1)                         # (S,)
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)    # (S, E)
+        # Position of each token in its expert's queue; capacity drop.
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (S, E)
+        pos_tok = jnp.sum(pos, axis=-1).astype(jnp.int32)        # (S,)
+        keep = (pos_tok < cap).astype(jnp.float32)
+        dispatch = (
+            onehot[:, :, None]
+            * keep[:, None, None]
+            * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[:, None, :]
+        )                                                        # (S, E, C)
+        combine = dispatch * gate_w[:, None, None]               # (S, E, C)
+
+        # -- expert compute (MXU; all-to-all inserted by GSPMD) -------
+        cd = x.dtype
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(cd), xf)
+        h = jnp.einsum("ecd,edf->ecf", expert_in, params["w1"])
+        h = apply_activation(h + params["b1"][:, None, :],
+                             self.attrs["activation"])
+        y_e = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+        y_e = y_e + params["b2"][:, None, :]
+        y = jnp.einsum("sec,ecd->sd", combine.astype(cd), y_e)
+
+        # -- aux load-balance loss (Switch eq. 4) ---------------------
+        load = jnp.mean(onehot, axis=0)                          # (E,)
+        importance = jnp.mean(probs, axis=0)                     # (E,)
+        aux = e * jnp.sum(load * importance)
+        w = self.attrs["aux_loss_weight"]
+        loss = (w * aux).astype(jnp.float32) if training else jnp.float32(0.0)
+        metrics = {
+            f"{self.name}_aux_loss": aux.astype(jnp.float32),
+            f"{self.name}_dropped": jnp.float32(s) - jnp.sum(keep),
+        }
+        return (loss, metrics, [y.reshape(b, t, d)]), state

@@ -227,7 +227,9 @@ class Executor:
                 result, s_new = op.sparse_forward(
                     rows_override[op.name], xs, s, training
                 )
-            elif self.config.remat and training and not op.is_loss:
+            elif self.config.remat and training and (
+                not op.is_loss or getattr(op, "allow_remat", False)
+            ):
                 # Per-layer rematerialization: drop this op's
                 # activations after forward and recompute them in the
                 # backward pass (jax.checkpoint) — HBM for FLOPs.

@@ -26,6 +26,7 @@ from flexflow_tpu.ops import (
     Conv2D,
     Embedding,
     Linear,
+    MixtureOfExperts,
     MultiEmbedding,
     MultiHeadAttention,
     Op,
@@ -112,6 +113,7 @@ def op_cost(op: Op) -> OpCost:
     bytes_ = 0.0
     params: Dict[str, Tuple[float, Tuple]] = {}
     lookup = isinstance(op, LOOKUP_OPS)
+    moe = isinstance(op, MixtureOfExperts)
     for name, spec in op.param_specs().items():
         psize = float(np.prod(spec.shape)) if spec.shape else 1.0
         pbytes = psize * _dtype_size(spec.dtype)
@@ -119,9 +121,23 @@ def op_cost(op: Op) -> OpCost:
         if lookup:
             # Gather: touches ~output-many rows, already counted below.
             continue
+        bytes_ += pbytes
+        if moe:
+            continue  # only capacity-many tokens contract each expert
         if len(spec.shape) >= 2:
             flops += 2.0 * non_c * psize
-        bytes_ += pbytes
+    if moe:
+        # Switch MoE: router matmul, dispatch/combine one-hot einsums
+        # (O(S * E*C * d), the GShard dispatch cost), and the expert
+        # FFN over E*C ~= cf*S capacity slots.
+        b, t, d = op.inputs[0].shape
+        s = float(b * t)
+        e = op.attrs["num_experts"]
+        fdim = op.attrs["ffn_dim"]
+        cap = float(op.capacity(b * t))
+        flops += 2.0 * s * d * e                  # router
+        flops += 2.0 * 2.0 * s * e * cap * d      # dispatch + combine
+        flops += 2.0 * 2.0 * e * cap * d * fdim   # expert up+down matmuls
     if isinstance(op, MultiHeadAttention):
         b, s, d = op.inputs[0].shape
         flops += 4.0 * b * float(s) ** 2 * d  # QK^T and PV

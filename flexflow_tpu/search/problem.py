@@ -85,13 +85,17 @@ def enumerate_candidates(
 ) -> List[ParallelConfig]:
     """All feasible degree vectors for ``op`` over its semantic axes.
 
-    An axis is usable if it tags a dim of the op's primary output; a
-    degree is usable if it divides every tagged extent (keeps shards
-    even, the reference's rect partitions round instead) and the mesh
-    can realize the combination.  Candidate 0 is the data-parallel
-    fallback (largest feasible pure-``n`` split) so the search starts
-    from — and ``init_us`` reports — the DP baseline, like the
-    reference's ``dpCompTime`` (``simulator.cc:117``).
+    An axis is usable if it tags a dim of the op's primary output, or
+    of a PARAMETER only (e.g. the MoE expert dim, where 'c' shards the
+    experts but the token-shaped output carries no 'c' — the analogue
+    of the reference pinning whole tables whose outputs are
+    sample-sharded, ``dlrm_strategy.cc:11-19``); a degree is usable if
+    it divides every tagged extent (keeps shards even, the reference's
+    rect partitions round instead) and the mesh can realize the
+    combination.  Candidate 0 is the data-parallel fallback (largest
+    feasible pure-``n`` split) so the search starts from — and
+    ``init_us`` reports — the DP baseline, like the reference's
+    ``dpCompTime`` (``simulator.cc:117``).
     """
     ndev = plan.num_devices
     out = op.outputs[0]
@@ -99,6 +103,11 @@ def enumerate_candidates(
     for ext, ax in zip(out.shape, out.dim_axes):
         if ax is not None:
             axis_min_extent[ax] = min(ext, axis_min_extent.get(ax, ext))
+    out_axes = frozenset(axis_min_extent)
+    for spec in op.param_specs().values():
+        for ext, ax in zip(spec.shape, spec.dim_axes):
+            if ax is not None and ax not in out_axes:
+                axis_min_extent[ax] = min(ext, axis_min_extent.get(ax, ext))
     options: Dict[str, List[int]] = {}
     for ax, ext in axis_min_extent.items():
         options[ax] = [d for d in range(1, ndev + 1) if ext % d == 0 and ndev % d == 0]
