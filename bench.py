@@ -157,7 +157,8 @@ def bench_transformer(on_tpu: bool):
     from flexflow_tpu.runtime.executor import Executor
     from flexflow_tpu.runtime.trainer import Trainer
 
-    batch = 8 if on_tpu else 2
+    # v5e-1 sweep: b=8 -> 102k tokens/s, b=16 -> 113k, b=32 OOM.
+    batch = 16 if on_tpu else 2
     seq = 2048 if on_tpu else 128
     ff = build_transformer_lm(
         batch_size=batch, seq_len=seq, vocab_size=32768, d_model=512,

@@ -67,6 +67,17 @@ def test_transformer_app_hybrid(capsys):
     assert "tokens/s" in capsys.readouterr().out
 
 
+def test_transformer_app_moe_expert_parallel(capsys):
+    """--experts N: switch-MoE blocks with the tp degree sharding
+    experts (expert parallelism through the app surface)."""
+    assert transformer.main([
+        "-b", "4", "-i", "1", "--seq", "16", "--vocab", "64",
+        "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--experts", "4", "--dp", "2", "--tp", "4", "-ll:tpu", "8",
+    ]) == 0
+    assert "tokens/s" in capsys.readouterr().out
+
+
 def test_dlrm_app_reads_criteo_h5(tmp_path, capsys):
     """-d <criteo.h5> end-to-end through the reference H5 schema."""
     import h5py
