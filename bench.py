@@ -199,6 +199,28 @@ def bench_nmt(n_chips: int, on_tpu: bool):
     return stats["elapsed_s"], stats["samples_per_s"], iters
 
 
+def bench_candle(on_tpu: bool):
+    """The fifth BASELINE config: Candle-Uno multi-tower MLP
+    (``examples/candle_uno``; defaults mirror the reference model
+    shapes).  Single-chip throughput; the multi-host hybrid strategy
+    leg is validated by the driver's multichip dry run and
+    ``tests/test_apps.py`` granules tests.  Returns samples/s."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models.candle_uno import build_candle_uno
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    batch = 512 if on_tpu else 32
+    ff = build_candle_uno(
+        batch_size=batch,
+        config=FFConfig(batch_size=batch, compute_dtype="bfloat16"),
+    )
+    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.01))
+    stats = Trainer(ex).fit(iterations=10 if on_tpu else 2, warmup=2)
+    return stats["samples_per_s"]
+
+
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
     speedup (the ICML'18 headline; reference prints dpCompTime /
@@ -265,6 +287,11 @@ def main():
             )
     except Exception as e:
         extra["transformer_error"] = f"{type(e).__name__}: {e}"
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["candle_samples_per_s"] = round(bench_candle(on_tpu), 2)
+    except Exception as e:
+        extra["candle_error"] = f"{type(e).__name__}: {e}"
     try:
         with contextlib.redirect_stdout(sys.stderr):
             nmt_s, nmt_sps, nmt_iters = bench_nmt(n_chips, on_tpu)
