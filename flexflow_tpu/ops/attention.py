@@ -165,9 +165,13 @@ class MultiHeadAttention(Op):
         return q, k, v
 
     def _split_heads(self, x):
+        """(b, t, d) -> (b, h, t, hd), keeping the compute dtype: the
+        flash kernels dot in the input dtype (bf16 rides the MXU at
+        bf16 rate) with f32 accumulation; the einsum fallbacks cast to
+        f32 themselves."""
         b, t, d = x.shape
         h = self.attrs["num_heads"]
-        return x.reshape(b, t, h, d // h).transpose(0, 2, 1, 3).astype(jnp.float32)
+        return x.reshape(b, t, h, d // h).transpose(0, 2, 1, 3)
 
     def _merge_heads(self, x, dtype):
         b, h, t, hd = x.shape
@@ -193,6 +197,7 @@ class MultiHeadAttention(Op):
         out = self._flash_dense(q, k, v)
         if out is not None:
             return self._merge_heads(out, dtype)
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
         scale = 1.0 / math.sqrt(q.shape[-1])
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         if causal:
@@ -256,6 +261,7 @@ class MultiHeadAttention(Op):
             use_flash = pallas_kernels.flash_supported(qh.shape, qh.dtype)
             if use_flash:
                 return self._ring_flash(qh, kh, vh, s_idx, S, s_entry, dtype)
+            qh, kh, vh = (x.astype(jnp.float32) for x in (qh, kh, vh))
             b, h, t, hd = qh.shape
             m = jnp.full((b, h, t), _NEG_INF, jnp.float32)
             denom = jnp.zeros((b, h, t), jnp.float32)

@@ -87,7 +87,9 @@ def flash_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # (bq, hd)
+    # Dots run in the INPUT dtype with f32 accumulation (bf16 inputs
+    # hit the MXU at bf16 rate; scale applies post-dot, in f32).
+    q = q_ref[0]                                        # (bq, hd)
     block_q, hd = q.shape
     seq_k = k_ref.shape[1]
     num_kb = seq_k // block_k
@@ -99,11 +101,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                               # (bq, bk)
+        ) * scale                                       # (bq, bk) f32
         if causal:
             k_pos = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
@@ -113,7 +115,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         acc = acc * corr + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         return m_new, l, acc
@@ -139,8 +142,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                *, block_k, causal, scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0:1]                            # (bq, 1)
     delta = delta_ref[0, :, 0:1]                        # (bq, 1)
     block_q, hd = q.shape
@@ -149,23 +152,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        ) * scale
         if causal:
             k_pos = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                            # (bq, bk)
+        p = jnp.exp(s - lse)                            # (bq, bk) f32
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
         return dq + lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     if causal:
@@ -180,8 +184,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, block_q, causal, scale):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                    # (bk, hd)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                        # (bk, hd)
+    v = v_ref[0]
     block_k, hd = k.shape
     seq_q = q_ref.shape[1]
     num_qb = seq_q // block_q
@@ -189,13 +193,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0:1]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                               # (bq, bk)
+        ) * scale                                       # (bq, bk)
         if causal:
             q_pos = qb * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -203,14 +207,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dv = dv + lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)                           # (bq, bk)
         dk = dk + lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return dk, dv
 
@@ -223,7 +229,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lower, num_qb, body,
         (jnp.zeros((block_k, hd), jnp.float32), jnp.zeros((block_k, hd), jnp.float32)),
     )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    # ds·q still needs the ∂s/∂k = scale·q factor (q is no longer
+    # pre-scaled; s scales post-dot).
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 

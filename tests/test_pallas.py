@@ -84,6 +84,33 @@ def test_flash_lse_cotangent(rng):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gn), atol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_bfloat16(rng, causal):
+    """bf16 backward: the kernels dot in the input dtype (ds/p cast to
+    bf16 pre-dot) with the scale compensation applied post-dot in
+    _dq_kernel/_dkv_kernel — gradients must track the f32 oracle
+    within bf16 rounding."""
+    qf, kf, vf = make_qkv(rng, t=32, hd=8)
+    cot = jnp.asarray(rng.standard_normal(qf.shape), jnp.float32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            pk.flash_attention(q, k, v, causal).astype(jnp.float32) * cot
+        )
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal) * cot)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_naive = jax.grad(loss_naive, argnums=(0, 1, 2))(qf, kf, vf)
+    for gf, gn in zip(g_flash, g_naive):
+        assert gf.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gn), atol=0.04, rtol=0.05
+        )
+
+
 def test_flash_uneven_block_sizes(rng):
     # t=48 forces a non-128 block divisor.
     q, k, v = make_qkv(rng, t=48)
