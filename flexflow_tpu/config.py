@@ -77,6 +77,10 @@ class FFConfig:
     # the result back via -s; this folds the two steps into one run).
     # Value = MCMC iterations; 0 = off.
     search_iters: int = 0
+    # --trace DIR: capture an XProf/TensorBoard trace of the timed
+    # training loop (the fused step as XLA executes it — fusions,
+    # collectives, device timelines; view with tensorboard --logdir).
+    trace_dir: Optional[str] = None
 
     @staticmethod
     def parse_args(argv: Sequence[str]) -> "FFConfig":
@@ -147,6 +151,8 @@ class FFConfig:
                 cfg.search_iters = cfg.search_iters or 20_000
             elif a == "--search-iters":
                 cfg.search_iters = int(_next())
+            elif a == "--trace":
+                cfg.trace_dir = _next()
             i += 1
         return cfg
 

@@ -9,6 +9,7 @@ so relative numbers are comparable.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
@@ -99,23 +100,37 @@ class Trainer:
             jax.device_get(m)  # host readback: the only reliable fence on the relay
 
         assert iterations > 0, "fit() needs at least one iteration"
+        trace_ctx = contextlib.nullcontext()
+        if ex.config.trace_dir:
+            # --trace DIR: XProf capture of the timed loop (the fused
+            # step as XLA runs it — the observability the reference's
+            # per-task cudaEvent prints could not give).
+            from flexflow_tpu.runtime.profiler import trace
+
+            trace_ctx = trace(ex.config.trace_dir)
         ckpt_s = 0.0  # checkpoint I/O time, excluded from throughput
         start = time.perf_counter()
-        for it in range(iterations):
-            batch = next(batches)
-            params, opt_state, state, m = step_fn(params, opt_state, state, batch)
-            if log_every and (it + 1) % log_every == 0:
-                self.metrics.update(jax.device_get(m))
-                print(f"iter {it+1}: {self.metrics.report()}")
-            if checkpoint is not None and save_every and (it + 1) % save_every == 0:
-                jax.device_get(m)  # fence: don't bill queued compute to I/O
-                t0 = time.perf_counter()
-                checkpoint.save(start_step + it + 1, params, opt_state, state)
-                ckpt_s += time.perf_counter() - t0
-        # The execution fence (dlrm.cc:159-162): a host readback of the
-        # final step's metrics; the step chain serializes through params.
-        final_m = jax.device_get(m)
-        elapsed = time.perf_counter() - start - ckpt_s
+        with trace_ctx:
+            for it in range(iterations):
+                batch = next(batches)
+                params, opt_state, state, m = step_fn(
+                    params, opt_state, state, batch
+                )
+                if log_every and (it + 1) % log_every == 0:
+                    self.metrics.update(jax.device_get(m))
+                    print(f"iter {it+1}: {self.metrics.report()}")
+                if checkpoint is not None and save_every and (it + 1) % save_every == 0:
+                    jax.device_get(m)  # fence: don't bill queued compute to I/O
+                    t0 = time.perf_counter()
+                    checkpoint.save(start_step + it + 1, params, opt_state, state)
+                    ckpt_s += time.perf_counter() - t0
+            # The execution fence (dlrm.cc:159-162): a host readback of
+            # the final step's metrics; the step chain serializes
+            # through params.  elapsed is taken here, INSIDE the trace
+            # context, so stop_trace's xplane serialization is not
+            # billed to the timed loop.
+            final_m = jax.device_get(m)
+            elapsed = time.perf_counter() - start - ckpt_s
 
         self.metrics.update(final_m)
         if checkpoint is not None:

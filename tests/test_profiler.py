@@ -65,6 +65,19 @@ def test_trace_writes_profile(tmp_path):
     assert found, "no trace events written"
 
 
+def test_trace_flag_wires_through_fit(tmp_path):
+    """--trace DIR captures the timed loop (app surface of the trace()
+    context); jax writes at least one .xplane.pb under the dir."""
+    from flexflow_tpu.apps import alexnet
+
+    logdir = tmp_path / "xprof"
+    assert alexnet.main([
+        "-b", "4", "-i", "1", "--image-size", "67",
+        "--trace", str(logdir),
+    ]) == 0
+    assert list(logdir.rglob("*.xplane.pb"))
+
+
 def test_profiling_flag_prints_breakdown(capsys):
     ff = _model()
     ff.config.profiling = True
