@@ -81,6 +81,11 @@ class FFConfig:
     # training loop (the fused step as XLA executes it — fusions,
     # collectives, device timelines; view with tensorboard --logdir).
     trace_dir: Optional[str] = None
+    # --ones-init: deterministic-parameter mode — every parameter
+    # initializes to ones for reproducible numerics across runs and
+    # strategies (the reference's ``#ifdef PARAMETER_ALL_ONES``,
+    # ``conv_2d.cu:394-399``).
+    parameter_all_ones: bool = False
 
     @staticmethod
     def parse_args(argv: Sequence[str]) -> "FFConfig":
@@ -153,6 +158,8 @@ class FFConfig:
                 cfg.search_iters = int(_next())
             elif a == "--trace":
                 cfg.trace_dir = _next()
+            elif a == "--ones-init":
+                cfg.parameter_all_ones = True
             i += 1
         return cfg
 

@@ -179,7 +179,17 @@ class Executor:
     def _init_fn(self, key):
         """Pure initializer over the op graph — jitted by :meth:`init`
         and eval_shape'd by :meth:`abstract_step`, so the two cannot
-        diverge."""
+        diverge.  ``config.parameter_all_ones`` (--ones-init) swaps
+        every PARAMETER initializer for ones — the reference's
+        deterministic-numerics build (``PARAMETER_ALL_ONES``,
+        ``conv_2d.cu:394-399``); op state (e.g. batchnorm running
+        stats) keeps its own initializers, which are already
+        deterministic."""
+        ones = None
+        if getattr(self.config, "parameter_all_ones", False):
+            from flexflow_tpu.initializers import OnesInitializer
+
+            ones = OnesInitializer()
         params: Dict[str, Dict[str, jax.Array]] = {}
         state: Dict[str, Dict[str, jax.Array]] = {}
         for op in self.model.layers:
@@ -188,7 +198,8 @@ class Executor:
                 params[op.name] = {}
                 for k, spec in sorted(pspecs.items()):
                     key, sub = jax.random.split(key)
-                    params[op.name][k] = spec.initializer(sub, spec.shape, spec.dtype)
+                    init = ones or spec.initializer
+                    params[op.name][k] = init(sub, spec.shape, spec.dtype)
             sspecs = op.state_specs()
             if sspecs:
                 state[op.name] = {}

@@ -109,8 +109,11 @@ class Trainer:
 
             trace_ctx = trace(ex.config.trace_dir)
         ckpt_s = 0.0  # checkpoint I/O time, excluded from throughput
-        start = time.perf_counter()
         with trace_ctx:
+            # Both timestamps live INSIDE the trace context so neither
+            # start_trace spin-up nor stop_trace serialization is
+            # billed to the timed loop.
+            start = time.perf_counter()
             for it in range(iterations):
                 batch = next(batches)
                 params, opt_state, state, m = step_fn(

@@ -55,3 +55,27 @@ def test_alexnet_compiles_sharded():
     )
     compiled = lowered.compile()
     assert compiled is not None
+
+
+def test_ones_init_deterministic_mode():
+    """--ones-init: the reference's PARAMETER_ALL_ONES build
+    (conv_2d.cu:394-399) — every parameter is exactly ones, so two
+    runs (any seed) produce identical numerics."""
+    import numpy as np
+
+    from flexflow_tpu.apps import alexnet as app
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models.alexnet import build_alexnet
+    from flexflow_tpu.runtime.executor import Executor
+
+    cfg = FFConfig(batch_size=4, parameter_all_ones=True)
+    ff = build_alexnet(batch_size=4, image_size=67, num_classes=10, config=cfg)
+    ex = Executor(ff)
+    params, _, _ = ex.init(seed=0)
+    for op_params in params.values():
+        for v in op_params.values():
+            np.testing.assert_array_equal(np.asarray(v), 1.0)
+    # And through the CLI flag surface.
+    assert FFConfig.parse_args(["--ones-init"]).parameter_all_ones
+    assert app.main(["-b", "4", "-i", "1", "--image-size", "67",
+                     "--ones-init"]) == 0
