@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -39,7 +40,13 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_block(t: int, target: int = 128) -> int:
+#: Flash block-size target (q and k block edge).  128 matched v5e best
+#: in round-2 measurements at t=2048; FF_FLASH_BLOCK overrides for
+#: tuning sweeps without a code change.
+_BLOCK_TARGET = int(os.environ.get("FF_FLASH_BLOCK", "128"))
+
+
+def _pick_block(t: int, target: int = _BLOCK_TARGET) -> int:
     """Largest divisor of ``t`` <= target that satisfies the TPU block
     rule (multiple of 8, or the whole dim).  0 if none exists."""
     if t <= target:
