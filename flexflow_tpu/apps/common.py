@@ -40,18 +40,26 @@ from flexflow_tpu.runtime.pipeline import PipelineExecutor, make_executor
 from flexflow_tpu.runtime.trainer import Trainer
 
 
-def pop_int(argv, flag, default):
-    """Extract an app-specific ``--flag N`` from argv (the FFConfig
+def _pop(argv, flag, default, cast, what):
+    """Extract an app-specific ``--flag V`` from argv (the FFConfig
     parser passes unknown flags through, Legion-style)."""
-    if flag in argv:
-        i = argv.index(flag)
-        try:
-            val = int(argv[i + 1])
-        except (IndexError, ValueError):
-            raise SystemExit(f"{flag} expects an integer")
-        del argv[i:i + 2]
-        return val
-    return default
+    if flag not in argv:
+        return default
+    i = argv.index(flag)
+    try:
+        val = cast(argv[i + 1])
+    except (IndexError, ValueError):
+        raise SystemExit(f"{flag} expects {what}")
+    del argv[i:i + 2]
+    return val
+
+
+def pop_int(argv, flag, default):
+    return _pop(argv, flag, default, int, "an integer")
+
+
+def pop_float(argv, flag, default):
+    return _pop(argv, flag, default, float, "a number")
 
 
 def make_optimizer(cfg: FFConfig):

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, pop_int, run_training
+from flexflow_tpu.apps.common import load_strategy, pop_float, pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.nmt import build_nmt, nmt_pipeline_strategy, nmt_strategy
 
@@ -30,6 +30,7 @@ def main(argv=None) -> int:
     vocab = pop_int(argv, "--vocab", 32 * 1024)
     hidden = pop_int(argv, "--hidden", 1024)
     layers = pop_int(argv, "--layers", 2)
+    dropout = pop_float(argv, "--dropout", 0.2)  # lstm.cu:152
     cfg = FFConfig.parse_args(argv)
     if pipeline and cfg.search_iters:
         raise SystemExit(
@@ -39,7 +40,7 @@ def main(argv=None) -> int:
     ff = build_nmt(
         batch_size=cfg.batch_size, src_len=src_len, tgt_len=tgt_len,
         vocab_size=vocab, embed_dim=hidden, hidden_size=hidden,
-        num_layers=layers, config=cfg,
+        num_layers=layers, dropout=dropout, config=cfg,
     )
     ndev = cfg.resolve_num_devices()
     strategy = load_strategy(cfg, ndev) or (

@@ -23,6 +23,7 @@ from flexflow_tpu.ops import (
     BatchNorm,
     Concat,
     DotInteraction,
+    Dropout,
     Conv2D,
     Embedding,
     Flat,
@@ -282,6 +283,11 @@ class FFModel:
 
     def add(self, a: TensorSpec, b: TensorSpec, name: Optional[str] = None) -> TensorSpec:
         return self._add(Add(self._unique("add", name), a, b))
+
+    def dropout(self, x: TensorSpec, rate: float, name: Optional[str] = None) -> TensorSpec:
+        """Inverted dropout (reference: cuDNN RNN dropout in the NMT
+        LSTM, ``nmt/lstm.cu:152-174``); identity at eval/rate 0."""
+        return self._add(Dropout(self._unique("dropout", name), x, rate))
 
     def concat(self, inputs: Sequence[TensorSpec], axis: int, name: Optional[str] = None) -> TensorSpec:
         return self._add(Concat(self._unique("concat", name), inputs, axis))

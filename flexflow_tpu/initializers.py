@@ -23,6 +23,16 @@ class Initializer:
         raise NotImplementedError
 
 
+class RngKeyInitializer(Initializer):
+    """Stores the op's slice of the init PRNG stream as raw key data —
+    for ops that thread an RNG through their state (Dropout)."""
+
+    def __call__(self, key, shape, dtype):
+        data = jax.random.key_data(key).reshape(-1).astype(dtype)
+        assert data.shape == tuple(shape), (data.shape, shape)
+        return data
+
+
 @dataclasses.dataclass
 class GlorotUniform(Initializer):
     """Glorot/Xavier uniform: ``scale = sqrt(6/(fan_in+fan_out))``

@@ -36,8 +36,11 @@ def build_nmt(
     embed_dim: int = 1024,
     hidden_size: int = 1024,
     num_layers: int = 2,
+    dropout: float = 0.2,
     config: Optional[FFConfig] = None,
 ) -> FFModel:
+    """``dropout`` applies between stacked LSTM layers (cuDNN RNN
+    semantics — the reference hardcodes 0.2, ``nmt/lstm.cu:152``)."""
     ff = FFModel(config or FFConfig(batch_size=batch_size))
     src = ff.create_tensor((batch_size, src_len), dtype=jnp.int32,
                            name="src", dim_axes=("n", "s"))
@@ -51,11 +54,15 @@ def build_nmt(
     for i in range(num_layers):
         x, hT, cT = ff.lstm(x, hidden_size, name=f"enc_lstm{i}")
         enc_states.append((hT, cT))
+        if dropout and i < num_layers - 1:
+            x = ff.dropout(x, dropout, name=f"enc_drop{i}")
 
     y = ff.word_embedding(tgt, vocab_size, embed_dim, name="tgt_embed")
     for i in range(num_layers):
         y, _, _ = ff.lstm(y, hidden_size, initial_state=enc_states[i],
                           name=f"dec_lstm{i}")
+        if dropout and i < num_layers - 1:
+            y = ff.dropout(y, dropout, name=f"dec_drop{i}")
 
     logits = ff.dense(y, vocab_size, name="vocab_proj")
     ff.softmax(logits, lbl, name="softmax")
@@ -84,6 +91,10 @@ def nmt_strategy(
     for side in ("enc", "dec"):
         for i in range(num_layers):
             store.set(f"{side}_lstm{i}", ParallelConfig(n=dp, s=sp))
+            if i < num_layers - 1:
+                # Inter-layer dropout keeps the LSTM sharding — no
+                # resharding between stacked layers.
+                store.set(f"{side}_drop{i}", ParallelConfig(n=dp, s=sp))
     store.set("vocab_proj", ParallelConfig(n=dp, c=sp))
     store.set("softmax", ParallelConfig(n=dp * sp))
     return store

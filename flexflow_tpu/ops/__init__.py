@@ -7,7 +7,7 @@ from flexflow_tpu.ops.losses import MSELoss, SoftmaxCrossEntropy
 from flexflow_tpu.ops.moe import MixtureOfExperts
 from flexflow_tpu.ops.norm import BatchNorm
 from flexflow_tpu.ops.rnn import LSTM
-from flexflow_tpu.ops.tensor_ops import Add, Concat, DotInteraction, Reshape
+from flexflow_tpu.ops.tensor_ops import Add, Concat, DotInteraction, Dropout, Reshape
 
 __all__ = [
     "Op",
@@ -26,6 +26,7 @@ __all__ = [
     "Add",
     "Concat",
     "DotInteraction",
+    "Dropout",
     "LayerNorm",
     "MixtureOfExperts",
     "MultiHeadAttention",

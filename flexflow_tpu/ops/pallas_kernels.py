@@ -40,10 +40,22 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _block_target_from_env() -> int:
+    """FF_FLASH_BLOCK tuning knob, sanitized: non-numeric falls back to
+    128, anything else clamps to a multiple of 8 >= 8 (the block rule
+    _pick_block enforces — an unaligned target would silently disable
+    the kernel for every t > target)."""
+    raw = os.environ.get("FF_FLASH_BLOCK", "128")
+    try:
+        t = int(raw)
+    except ValueError:
+        return 128
+    return max(8, t - t % 8)
+
+
 #: Flash block-size target (q and k block edge).  128 matched v5e best
-#: in round-2 measurements at t=2048; FF_FLASH_BLOCK overrides for
-#: tuning sweeps without a code change.
-_BLOCK_TARGET = int(os.environ.get("FF_FLASH_BLOCK", "128"))
+#: in round-2 measurements at t=2048.
+_BLOCK_TARGET = _block_target_from_env()
 
 
 def _pick_block(t: int, target: int = _BLOCK_TARGET) -> int:
@@ -67,8 +79,8 @@ def _require_block(t: int) -> int:
     if block < 8 or t < 16:
         raise ValueError(
             f"flash attention needs seq >= 16 with a block divisor that "
-            f"is a multiple of 8 and <= 128; got t={t}. Gate callers on "
-            f"flash_supported()."
+            f"is a multiple of 8 and <= {_BLOCK_TARGET}; got t={t}. Gate "
+            f"callers on flash_supported()."
         )
     return block
 

@@ -108,3 +108,44 @@ class DotInteraction(Op):
         li, lj = jnp.tril_indices(f, k=-1)
         pairs = dots[:, li, lj]  # (b, F(F-1)/2)
         return [jnp.concatenate([dense, pairs.astype(dense.dtype)], axis=1)], state
+
+
+class Dropout(Op):
+    """Inverted dropout with a deterministic state-threaded RNG.
+
+    The reference applies dropout through the cuDNN RNN descriptor in
+    the NMT LSTM stack (rate 0.2, ``nmt/lstm.cu:152-174``) with cuDNN
+    managing the random states; here the op owns its PRNG key as op
+    STATE (like batchnorm's running stats), splitting it each training
+    step — so masks are reproducible from the seed, advance with the
+    step chain, and are identical under every sharding (threefry is
+    counter-based: the DP=strategy numerics invariant holds).  Eval
+    and rate 0 are the identity.
+    """
+
+    def __init__(self, name: str, x: TensorSpec, rate: float):
+        super().__init__(name, [x])
+        if not 0.0 <= rate < 1.0:  # also rejects nan
+            raise ValueError(
+                f"dropout {name}: rate must be in [0, 1), got {rate}"
+            )
+        self.attrs = dict(rate=rate)
+        self._make_output(x.shape, x.dtype, x.dim_axes)
+
+    def state_specs(self):
+        from flexflow_tpu.initializers import RngKeyInitializer
+        from flexflow_tpu.ops.base import ParamSpec
+
+        return {"rng": ParamSpec((2,), jnp.uint32, RngKeyInitializer())}
+
+    def forward(self, params, xs, state, training):
+        (x,) = xs
+        rate = self.attrs["rate"]
+        if not training or rate == 0.0:
+            return [x], state
+        import jax
+
+        new_key, sub = jax.random.split(state["rng"])
+        keep = jax.random.bernoulli(sub, 1.0 - rate, x.shape)
+        y = jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+        return [y], {"rng": new_key}

@@ -1,0 +1,102 @@
+"""Dropout op: inverted-dropout semantics, deterministic state-threaded
+RNG, strategy invariance (reference: cuDNN RNN dropout in the NMT LSTM,
+``nmt/lstm.cu:152-174``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import StrategyStore
+from flexflow_tpu.runtime.executor import Executor
+
+
+def drop_model(batch=16, d=64, rate=0.5):
+    ff = FFModel(FFConfig(batch_size=batch, seed=5))
+    x = ff.create_tensor((batch, d), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="lbl")
+    t = ff.dense(x, d, activation="relu", name="fc1")
+    t = ff.dropout(t, rate, name="drop")
+    t = ff.dense(t, 4, name="fc2")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+def _batch(rng, batch=16, d=64):
+    return {
+        "x": jnp.asarray(rng.standard_normal((batch, d)), jnp.float32),
+        "lbl": jnp.asarray(rng.integers(0, 4, size=(batch,)), jnp.int32),
+    }
+
+
+def test_dropout_semantics(rng):
+    from flexflow_tpu.ops.tensor_ops import Dropout
+    from flexflow_tpu.ops.base import TensorSpec
+
+    x_spec = TensorSpec("x", (64, 128), jnp.float32, ("n", None))
+    op = Dropout("d", x_spec, rate=0.25)
+    key = jax.random.PRNGKey(7)
+    state = {"rng": jax.random.key_data(key)}
+    x = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+
+    # Eval = identity, state untouched.
+    ys, s2 = op.forward({}, [x], state, training=False)
+    np.testing.assert_array_equal(np.asarray(ys[0]), np.asarray(x))
+    assert s2 is state
+
+    # Train: zeros where dropped, survivors scaled by 1/(1-rate).
+    (y,), s2 = op.forward({}, [x], state, training=True)
+    y = np.asarray(y)
+    dropped = y == 0.0
+    frac = dropped.mean()
+    assert 0.15 < frac < 0.35  # ~rate
+    np.testing.assert_allclose(
+        y[~dropped], (np.asarray(x) / 0.75)[~dropped], rtol=1e-6
+    )
+    # Deterministic given the state; state advances.
+    (y_again,), _ = op.forward({}, [x], state, training=True)
+    np.testing.assert_array_equal(y, np.asarray(y_again))
+    (y_next,), _ = op.forward({}, [x], s2, training=True)
+    assert not np.array_equal(y, np.asarray(y_next))
+
+
+def test_dropout_strategy_invariance():
+    """Masks are threefry counter-based: the same seed yields the same
+    mask under any sharding, so DP≡strategy holds with dropout in the
+    graph (CLAUDE.md design invariant)."""
+    def run(n_devices, steps=3):
+        rng = np.random.default_rng(3)
+        ff = drop_model()
+        ex = Executor(
+            ff,
+            strategy=StrategyStore.data_parallel(n_devices),
+            optimizer=SGDOptimizer(lr=0.05),
+            devices=jax.devices()[:n_devices],
+        )
+        params, opt_state, state = ex.init()
+        losses = []
+        for _ in range(steps):
+            batch = ex.shard_batch(_batch(rng))
+            params, opt_state, state, m = ex.train_step(
+                params, opt_state, state, batch
+            )
+            losses.append(float(m["train_loss"]))
+        return losses
+
+    np.testing.assert_allclose(run(1), run(8), rtol=2e-4, atol=1e-6)
+
+
+def test_nmt_includes_interlayer_dropout():
+    from flexflow_tpu.models.nmt import build_nmt
+
+    ff = build_nmt(batch_size=4, src_len=8, tgt_len=8, vocab_size=64,
+                   embed_dim=16, hidden_size=16, num_layers=2)
+    names = [op.name for op in ff.layers]
+    assert "enc_drop0" in names and "dec_drop0" in names
+    # cuDNN RNN semantics: between layers only, never after the last.
+    assert "enc_drop1" not in names
+    ff0 = build_nmt(batch_size=4, src_len=8, tgt_len=8, vocab_size=64,
+                    embed_dim=16, hidden_size=16, num_layers=2, dropout=0.0)
+    assert not any("drop" in n for n in (op.name for op in ff0.layers))
