@@ -210,6 +210,7 @@ def run_training(
         )
     iters = cfg.iterations * max(cfg.epochs, 1)
     stats = trainer.fit(iterations=iters, batches=batches, warmup=1,
+                        log_every=cfg.print_freq,
                         accum_steps=cfg.accum_steps)
     print(f"ELAPSED TIME = {stats['elapsed_s']:.4f}s")
     print(f"THROUGHPUT = {stats['samples_per_s']:.2f} {label}/s")

@@ -38,6 +38,10 @@ class FFConfig:
     # Data / strategy files.
     dataset_path: Optional[str] = None  # -d; None => synthetic input
     strategy_file: Optional[str] = None  # -s
+    # -p/--print-freq: metric-print frequency in iterations (reference
+    # README.md flag table; default 10 there, 0 = quiet here to keep
+    # benchmark stdout clean).
+    print_freq: int = 0
     profiling: bool = False
     # Numerics.  Activations/params follow the input tensors' dtype,
     # which defaults to this (FFModel.create_tensor).
@@ -130,6 +134,8 @@ class FFConfig:
                 cfg.num_devices = int(_next())
             elif a == "--nodes":
                 cfg.num_nodes = int(_next())
+            elif a == "-p" or a == "--print-freq":
+                cfg.print_freq = int(_next())
             elif a == "--profiling":
                 cfg.profiling = True
             elif a == "--dry-run":
