@@ -65,6 +65,10 @@ class Op:
 
     #: Set True for ops producing a scalar loss contribution + metrics.
     is_loss = False
+    #: Loss-contributing ops are normally exempt from per-layer remat
+    #: (terminal losses are cheap); heavy non-terminal loss ops (MoE's
+    #: aux-loss byproduct) opt back in with True.
+    allow_remat = False
 
     def __init__(self, name: str, inputs: Sequence[TensorSpec]):
         self.name = name

@@ -28,7 +28,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,10 @@ class MixtureOfExperts(Op):
     """
 
     is_loss = True
+    #: MoE is the heaviest op in its block and its loss term is a cheap
+    #: scalar byproduct — per-layer remat must include it despite
+    #: ``is_loss`` (the executor's guard exists for terminal loss ops).
+    allow_remat = True
 
     def __init__(
         self,
@@ -113,11 +117,6 @@ class MixtureOfExperts(Op):
             "w2": ParamSpec((e, f, d), dt, ki, ("c", None, None)),
             "b2": ParamSpec((e, d), dt, ZeroInitializer(), ("c", None)),
         }
-
-    #: MoE is the heaviest op in its block and its loss term is a cheap
-    #: scalar byproduct — per-layer remat must include it despite
-    #: ``is_loss`` (the executor's guard exists for terminal loss ops).
-    allow_remat = True
 
     def forward(self, params, xs, state, training):
         (x,) = xs

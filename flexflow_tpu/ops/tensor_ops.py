@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from flexflow_tpu.ops.base import Op, TensorSpec
@@ -143,8 +144,6 @@ class Dropout(Op):
         rate = self.attrs["rate"]
         if not training or rate == 0.0:
             return [x], state
-        import jax
-
         new_key, sub = jax.random.split(state["rng"])
         keep = jax.random.bernoulli(sub, 1.0 - rate, x.shape)
         y = jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)

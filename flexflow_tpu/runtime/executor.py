@@ -186,7 +186,7 @@ class Executor:
         stats) keeps its own initializers, which are already
         deterministic."""
         ones = None
-        if getattr(self.config, "parameter_all_ones", False):
+        if self.config.parameter_all_ones:
             from flexflow_tpu.initializers import OnesInitializer
 
             ones = OnesInitializer()
@@ -239,7 +239,7 @@ class Executor:
                     rows_override[op.name], xs, s, training
                 )
             elif self.config.remat and training and (
-                not op.is_loss or getattr(op, "allow_remat", False)
+                not op.is_loss or op.allow_remat
             ):
                 # Per-layer rematerialization: drop this op's
                 # activations after forward and recompute them in the

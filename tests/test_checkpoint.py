@@ -146,3 +146,40 @@ class TestTrainerIntegration:
         with CheckpointManager(str(tmp_path / "ck")) as ck:
             Trainer(ex2).fit(iterations=2, warmup=1, checkpoint=ck)
             assert ck.latest_step() == 7
+
+
+def test_dropout_rng_state_resumes_exactly(tmp_path):
+    """Dropout's PRNG key is op STATE: a restore must continue the
+    mask stream exactly where the run left off (4 straight steps ==
+    2 steps + save/restore + 2 steps, bit-for-bit)."""
+    def model():
+        ff = FFModel(FFConfig(batch_size=8, seed=9))
+        x = ff.create_tensor((8, 12), name="x")
+        lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+        t = ff.dense(x, 16, activation="relu", name="fc1")
+        t = ff.dropout(t, 0.5, name="drop")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    ex = Executor(model(), optimizer=SGDOptimizer(lr=0.05))
+    batches = [_batch(ex, seed=s) for s in range(4)]
+
+    p, o, s = ex.init()
+    p4, o4, s4 = _run_steps(ex, p, o, s, batches)
+
+    ex2 = Executor(model(), optimizer=SGDOptimizer(lr=0.05))
+    p, o, s = ex2.init()
+    p2, o2, s2 = _run_steps(ex2, p, o, s, batches[:2])
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        ck.save(2, p2, o2, s2)
+        ex3 = Executor(model(), optimizer=SGDOptimizer(lr=0.05))
+        pr, orr, sr = ex3.init()
+        _, pr, orr, sr = ck.restore(templates=(pr, orr, sr))
+    pr4, _, sr4 = _run_steps(ex3, pr, orr, sr, batches[2:])
+
+    for a, b in zip(jax.tree.leaves(p4), jax.tree.leaves(pr4)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(s4["drop"]["rng"]), np.asarray(sr4["drop"]["rng"])
+    )
