@@ -89,3 +89,38 @@ def test_initialize_rejects_partial_config(monkeypatch):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="process_id"):
         initialize()
+
+
+def test_moe_expert_parallel_on_hybrid_mesh(rng):
+    """Expert parallelism composes with the DCN-outer pod layout: dp
+    rides the d0 (DCN) axis, the experts' c-shard stays on ICI axes,
+    and numerics match the flat single-granule mesh."""
+    from flexflow_tpu.models.transformer import (
+        build_transformer_lm,
+        transformer_strategy,
+    )
+
+    def run(plan):
+        ff = build_transformer_lm(
+            batch_size=4, seq_len=8, vocab_size=64, d_model=16,
+            num_heads=2, num_layers=1, moe_experts=4,
+            config=FFConfig(batch_size=4, seed=2),
+        )
+        store = transformer_strategy(8, num_layers=1, dp=2, tp=4, moe=True)
+        ex = Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.05),
+                      mesh_plan=plan)
+        params, opt_state, state = ex.init()
+        r = np.random.default_rng(0)
+        batch = ex.shard_batch({
+            "tokens": r.integers(0, 64, size=(4, 8)).astype(np.int32),
+            "label": r.integers(0, 64, size=(4, 8)).astype(np.int32),
+        })
+        params, opt_state, state, m = ex.train_step(
+            params, opt_state, state, batch
+        )
+        jax.block_until_ready(m)
+        return float(m["train_loss"])
+
+    hybrid = run(build_hybrid_mesh_plan(num_granules=2))
+    flat = run(build_hybrid_mesh_plan(num_granules=1))
+    np.testing.assert_allclose(hybrid, flat, rtol=2e-4)
