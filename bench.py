@@ -223,24 +223,33 @@ def bench_candle(on_tpu: bool):
 
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
-    speedup (the ICML'18 headline; reference prints dpCompTime /
-    bestCompTime from the simulator, ``simulator.cc:117-118``).
-    Multi-chip hardware is not reachable from the bench harness, so
-    the number comes from the same place the reference's does: the
-    strategy-search simulator (native ffsim) with the analytic
-    roofline device model over the AlexNet graph on ``n_devices``
-    chips."""
+    speedup (the ICML'18 headline claims it for AlexNet/VGG/Inception;
+    reference prints dpCompTime / bestCompTime from the simulator,
+    ``simulator.cc:117-118``).  Multi-chip hardware is not reachable
+    from the bench harness, so the numbers come from the same place
+    the reference's do: the strategy-search simulator (native ffsim)
+    with the analytic roofline device model on ``n_devices`` chips."""
     from flexflow_tpu.models.alexnet import build_alexnet
+    from flexflow_tpu.models.cnn_catalog import build_inception_v3, build_vgg16
     from flexflow_tpu.search import search_strategy
 
     ff = build_alexnet(batch_size=256, image_size=229, num_classes=1000)
     result = search_strategy(ff, num_devices=n_devices)
-    return {
+    out = {
         "op_parallel_speedup_sim": round(result.speedup, 3),
         "dp_time_us": round(result.dp_time_us, 1),
         "best_time_us": round(result.best_time_us, 1),
         "devices": n_devices,
     }
+    for name, build in (("vgg16", build_vgg16), ("inception", build_inception_v3)):
+        try:
+            r = search_strategy(
+                build(batch_size=64), num_devices=n_devices, iters=20_000
+            )
+            out[f"{name}_speedup_sim"] = round(r.speedup, 3)
+        except Exception as e:  # a catalog model must not sink the metric
+            out[f"{name}_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def main():
