@@ -76,6 +76,11 @@ class OpCost:
     #: (the reference's replica-grad ``backward2`` saxpy-reduction,
     #: ``linear.cu:494-520``).
     contracted_input_bytes: float = 0.0
+    #: bytes that cross the ``c``-group per step for expert-parallel
+    #: ops (MoE dispatch + combine all-to-alls: tokens to experts and
+    #: back — the activation traffic Legion coherence generated for
+    #: the reference's pinned tables).
+    ep_alltoall_bytes: float = 0.0
 
 
 def contracted_input_dims(op: Op) -> Tuple[int, ...]:
@@ -126,6 +131,7 @@ def op_cost(op: Op) -> OpCost:
             continue  # only capacity-many tokens contract each expert
         if len(spec.shape) >= 2:
             flops += 2.0 * non_c * psize
+    moe_ep_bytes = 0.0
     if moe:
         # Switch MoE: router matmul, dispatch/combine one-hot einsums
         # (O(S * E*C * d), the GShard dispatch cost), and the expert
@@ -138,6 +144,10 @@ def op_cost(op: Op) -> OpCost:
         flops += 2.0 * s * d * e                  # router
         flops += 2.0 * 2.0 * s * e * cap * d      # dispatch + combine
         flops += 2.0 * 2.0 * e * cap * d * fdim   # expert up+down matmuls
+        # Tokens to experts and back under a c-split (fwd; bwd mirrors
+        # it — FWD_BWD_FACTOR is applied by the caller's compute side,
+        # so charge fwd+bwd = 2 round trips here explicitly).
+        moe_ep_bytes = 2.0 * 2.0 * e * cap * d * esize
     if isinstance(op, MultiHeadAttention):
         b, s, d = op.inputs[0].shape
         flops += 4.0 * b * float(s) ** 2 * d  # QK^T and PV
@@ -156,6 +166,7 @@ def op_cost(op: Op) -> OpCost:
     return OpCost(
         flops=flops, bytes=bytes_, param_bytes=params,
         contracted_input_bytes=cib,
+        ep_alltoall_bytes=moe_ep_bytes,
     )
 
 
@@ -196,5 +207,11 @@ def sync_cost_us(cost: OpCost, degrees: Dict[str, int], dev: DeviceModel) -> flo
         # TP input-grad reduce-scatter across the c-group.
         total += (
             2.0 * (c - 1) / c * cost.contracted_input_bytes / dev.ici_bytes_per_us
+        )
+    if c > 1 and cost.ep_alltoall_bytes > 0:
+        # Expert-parallel dispatch/combine: each device keeps 1/c of
+        # its tokens and exchanges the rest (all-to-all over ICI).
+        total += (
+            (c - 1) / c * cost.ep_alltoall_bytes / dev.ici_bytes_per_us
         )
     return total

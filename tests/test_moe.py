@@ -177,15 +177,22 @@ def test_search_reaches_expert_parallelism():
 def test_moe_cost_model_scales_with_capacity():
     """op_cost must charge the switch compute (~cf*S tokens through
     one expert FFN + dispatch einsums), not a dense contraction of
-    every token against every expert weight."""
-    from flexflow_tpu.search.cost_model import op_cost
+    every token against every expert weight — and sync_cost must
+    price the expert-parallel token all-to-all under a c-split."""
+    from flexflow_tpu.search.cost_model import DeviceModel, op_cost, sync_cost_us
 
     ff = moe_model()  # cf=8 -> effectively no drop, E=4, ffn=16, d=8
     op = ff.find_op("moe")
     s, d, e, f = 32, 8, 4, 16
     cap = op.capacity(s)
+    cost = op_cost(op)
     expect = (2 * s * d * e) + (4 * s * e * cap * d) + (4 * e * cap * d * f)
-    assert op_cost(op).flops == pytest.approx(expect)
+    assert cost.flops == pytest.approx(expect)
+    assert cost.ep_alltoall_bytes == pytest.approx(4 * e * cap * d * 4)
+    dev = DeviceModel()
+    ep = sync_cost_us(cost, {"n": 1, "c": 4}, dev)
+    dp = sync_cost_us(cost, {"n": 4, "c": 1}, dev)
+    assert ep != dp  # EP pays all-to-all; DP pays full grad all-reduce
 
 
 def test_moe_transformer_builds_and_steps(rng):
