@@ -36,7 +36,10 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 1500.0 / 4.0
 V5E_BF16_PEAK_FLOPS = 1.97e14
 
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
-PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+# 2 x 120s + one 5s backoff ~= 4 min worst case before the CPU
+# fallback; a third retry never helped on a wedged tunnel (it stays
+# down for hours) and risks crowding the driver's bench timeout.
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
 
 
 def probe_backend():
