@@ -90,6 +90,13 @@ class FFConfig:
     # strategies (the reference's ``#ifdef PARAMETER_ALL_ONES``,
     # ``conv_2d.cu:394-399``).
     parameter_all_ones: bool = False
+    # --zero-opt: ZeRO-1-style optimizer-state sharding — each
+    # parameter's optimizer moments (Adam m/v, SGD momentum) shard
+    # their leading dim across the mesh axes the op's strategy assigns
+    # to data parallelism, instead of replicating with the weights.
+    # GSPMD gathers the update slices; numerics are unchanged (pinned
+    # by tests/test_zero_opt.py).  Full-mesh Executor only.
+    zero_sharded_optimizer: bool = False
 
     @staticmethod
     def parse_args(argv: Sequence[str]) -> "FFConfig":
@@ -166,6 +173,8 @@ class FFConfig:
                 cfg.trace_dir = _next()
             elif a == "--ones-init":
                 cfg.parameter_all_ones = True
+            elif a == "--zero-opt":
+                cfg.zero_sharded_optimizer = True
             i += 1
         return cfg
 

@@ -55,6 +55,11 @@ class SGDOptimizer:
             step = g
         return (pf - self.lr * step).astype(p.dtype), v_new
 
+    def map_param_states(self, opt_state, fn):
+        """Apply ``fn`` to every params-structured subtree of the
+        optimizer state (ZeRO sharding hook; scalars pass through)."""
+        return None if opt_state is None else fn(opt_state)
+
     def update(self, params, opt_state, grads):
         """Returns (new_params, new_opt_state).  Pure; jit-safe."""
         if self.momentum == 0.0:
@@ -90,6 +95,15 @@ class AdamOptimizer:
             "m": jax.tree.map(zeros, params),
             "v": jax.tree.map(zeros, params),
             "t": jnp.zeros((), jnp.int32),
+        }
+
+    def map_param_states(self, opt_state, fn):
+        """Apply ``fn`` to the params-structured m/v subtrees (ZeRO
+        sharding hook); the step scalar passes through."""
+        return {
+            "m": fn(opt_state["m"]),
+            "v": fn(opt_state["v"]),
+            "t": opt_state["t"],
         }
 
     def update(self, params, opt_state, grads):

@@ -126,6 +126,7 @@ class MeshPlan:
         pc: ParallelConfig,
         dim_axes: Sequence[Optional[str]],
         shape: Optional[Sequence[int]] = None,
+        extra_leading_axes: Sequence[str] = (),
     ) -> PartitionSpec:
         """Build a PartitionSpec for a tensor whose dims map to semantic
         axes ``dim_axes`` (entries: 'n'/'c'/'h'/'w' or None).
@@ -135,13 +136,19 @@ class MeshPlan:
         uneven extents via Legion rect partitions (``model.cc:213-280``
         rounds up); GSPMD wants exact divisibility, so an odd spatial
         extent simply stays unsharded along the offending factor.
+
+        ``extra_leading_axes``: additional MESH axes to fold into the
+        leading dim where divisibility allows (requires ``shape``) —
+        the ZeRO-1 optimizer-moment split over an op's data-parallel
+        axes.  The combined tuple is canonicalized to mesh order like
+        every other assignment.
         """
         asg = self.assign(pc)
         size_of = dict(zip(self.axis_names, self.axis_sizes))
         entries = []
         for i, sem in enumerate(dim_axes):
             if sem is None:
-                entries.append(None)
+                entries.append(())
                 continue
             axes = asg.get(sem, ())
             if shape is not None:
@@ -163,10 +170,20 @@ class MeshPlan:
                             sem, "x".join(dropped), i, dim, list(dropped),
                         )
                 axes = tuple(kept)
-            entries.append(axes if len(axes) != 1 else axes[0])
+            entries.append(tuple(axes))
+        if extra_leading_axes and shape is not None and entries:
+            picked = list(entries[0])
+            prod = int(np.prod([size_of[a] for a in picked])) if picked else 1
+            for ax in extra_leading_axes:
+                if ax not in picked and shape[0] % (prod * size_of[ax]) == 0:
+                    picked.append(ax)
+                    prod *= size_of[ax]
+            entries[0] = tuple(sorted(picked, key=self.axis_names.index))
         # PartitionSpec treats () like None.
-        entries = [None if e == () else e for e in entries]
-        return PartitionSpec(*entries)
+        out = [
+            None if e == () else (e[0] if len(e) == 1 else e) for e in entries
+        ]
+        return PartitionSpec(*out)
 
     def sharding(
         self,

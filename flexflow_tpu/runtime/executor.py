@@ -138,8 +138,67 @@ class Executor:
         params, state = jax.jit(self._init_fn, out_shardings=out_sh)(
             jax.random.PRNGKey(seed)
         )
-        opt_state = self.optimizer.init(params)
+        if self.config.zero_sharded_optimizer:
+            # Moments are BORN sharded: creating them replicated first
+            # would OOM at exactly the scale the flag exists for.
+            avals = jax.eval_shape(self.optimizer.init, params)
+            if avals is None:
+                opt_state = None
+            else:
+                zsh = self._zero_opt_shardings
+                out_sh = self.optimizer.map_param_states(
+                    avals,
+                    lambda tree: jax.tree.map(lambda _, s: s, tree, zsh),
+                )
+                out_sh = jax.tree.map(
+                    lambda x: x if isinstance(x, NamedSharding) else None,
+                    out_sh,
+                )
+                opt_state = jax.jit(
+                    self.optimizer.init, out_shardings=out_sh
+                )(params)
+        else:
+            opt_state = self.optimizer.init(params)
         return params, opt_state, state
+
+    # -- ZeRO-1 optimizer-state sharding -----------------------------------
+
+    def _zero_sharding(self, op: Op, spec) -> NamedSharding:
+        """The param's own sharding with its leading dim additionally
+        split over the op's data-parallel mesh axes (the replica group
+        the moments would otherwise be replicated across) — ZeRO-1:
+        each DP rank stores and updates 1/dp of the optimizer state,
+        GSPMD inserting the update all-gather."""
+        pc = self._pc(op)
+        return NamedSharding(
+            self.plan.mesh,
+            self.plan.spec(
+                pc, spec.dim_axes, spec.shape,
+                extra_leading_axes=self.plan.assign(pc).get("n", ()),
+            ),
+        )
+
+    @functools.cached_property
+    def _zero_opt_shardings(self):
+        """Params-structured tree of ZeRO shardings for moment leaves."""
+        return {
+            op.name: {
+                k: self._zero_sharding(op, spec)
+                for k, spec in op.param_specs().items()
+            }
+            for op in self.model.layers
+            if op.param_specs()
+        }
+
+    def _constrain_zero_opt(self, new_opt):
+        if not self.config.zero_sharded_optimizer or new_opt is None:
+            return new_opt
+        return self.optimizer.map_param_states(
+            new_opt,
+            lambda tree: jax.tree.map(
+                jax.lax.with_sharding_constraint, tree, self._zero_opt_shardings
+            ),
+        )
 
     # -- sparse embedding updates ------------------------------------------
 
@@ -284,7 +343,7 @@ class Executor:
                     self._loss_fn, has_aux=True
                 )(params, state, batch)
                 new_params, new_opt = self.optimizer.update(params, opt_state, grads)
-                return new_params, new_opt, new_state, metrics
+                return new_params, self._constrain_zero_opt(new_opt), new_state, metrics
 
             return train_step
 
@@ -371,7 +430,7 @@ class Executor:
                 for k, v in metrics.items()
             }
             new_params, new_opt = self.optimizer.update(params, opt_state, g)
-            return new_params, new_opt, new_state, m
+            return new_params, self._constrain_zero_opt(new_opt), new_state, m
 
         fn = jax.jit(step, donate_argnums=(0, 1, 2))
         self._accum_cache[accum_steps] = fn

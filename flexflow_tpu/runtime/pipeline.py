@@ -211,6 +211,16 @@ class PipelineExecutor:
     ):
         self.model = model
         self.config = config or model.config
+        if getattr(self.config, "zero_sharded_optimizer", False):
+            # Loudly reject rather than half-apply: stage init would
+            # shard moments but this executor's update path would not
+            # re-pin them (Executor.__init__ rejects unrealizable
+            # placements the same way).
+            raise PlacementError(
+                "--zero-opt supports the full-mesh Executor only; "
+                "layer-wise (device-subset) strategies keep replicated "
+                "optimizer state"
+            )
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
