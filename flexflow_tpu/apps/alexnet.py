@@ -10,13 +10,14 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import pop_int, run_training
+from flexflow_tpu.apps.common import check_help, pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.alexnet import build_alexnet
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    check_help(argv, __doc__)
     # App-specific knob (like DLRM's --arch-*): input resolution.
     # Default 229 matches the reference (alexnet.cc:8).
     image_size = pop_int(argv, "--image-size", 229)

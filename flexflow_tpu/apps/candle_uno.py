@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, run_training
+from flexflow_tpu.apps.common import check_help, load_strategy, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.candle_uno import (
     CandleConfig,
@@ -21,6 +21,7 @@ from flexflow_tpu.models.candle_uno import (
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    check_help(argv, __doc__)
     # --dense-layers / --dense-feature-layers (A-B-C widths) parse via
     # CandleConfig; FFConfig ignores unknown flags (the DLRM app's
     # pattern, dlrm.py).

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import run_training
+from flexflow_tpu.apps.common import check_help, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.alexnet import build_alexnet
 from flexflow_tpu.models.cnn_catalog import (
@@ -32,6 +32,7 @@ MODELS = {
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    check_help(argv, __doc__)
     model = "alexnet"
     if "--model" in argv:
         i = argv.index("--model")

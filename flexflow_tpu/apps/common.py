@@ -40,6 +40,30 @@ from flexflow_tpu.runtime.pipeline import PipelineExecutor, make_executor
 from flexflow_tpu.runtime.trainer import Trainer
 
 
+COMMON_FLAGS = """\
+Common flags (reference: model.cc:729-785 + README.md flag table):
+  -e/--epochs N         -b/--batch-size N    --lr F        --wd F
+  -i/--iterations N     -d/--dataset PATH    -s FILE       -p/--print-freq N
+  -ll:tpu N (devices)   -ll:cpu N (loaders)  --nodes N     --seed N
+  --dtype float32|bfloat16   --optimizer sgd|adam   --momentum F
+  --profiling   --dry-run   --remat   --trace DIR   --ones-init
+  --accum-steps N   --microbatches N   --granules N   --zero-opt
+  --search | --search-iters N (inline strategy autotuning)"""
+
+
+def check_help(argv, doc: Optional[str]) -> None:
+    """-h/--help: print the app's docstring (its specific flags) plus
+    the common flag table, then exit 0 — FFConfig.parse_args otherwise
+    ignores unknown flags Legion-style, which must not swallow a help
+    request."""
+    if "-h" in argv or "--help" in argv:
+        if doc:
+            print(doc.strip())
+            print()
+        print(COMMON_FLAGS)
+        raise SystemExit(0)
+
+
 def _pop(argv, flag, default, cast, what):
     """Extract an app-specific ``--flag V`` from argv (the FFConfig
     parser passes unknown flags through, Legion-style)."""

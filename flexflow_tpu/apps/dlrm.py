@@ -17,13 +17,14 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, run_training
+from flexflow_tpu.apps.common import check_help, load_strategy, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm, dlrm_strategy
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    check_help(argv, __doc__)
     cfg = FFConfig.parse_args(argv)
     if any(a.startswith("--arch-") for a in argv):
         dlrm = DLRMConfig.parse_args(argv)

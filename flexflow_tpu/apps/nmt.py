@@ -15,13 +15,14 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, pop_float, pop_int, run_training
+from flexflow_tpu.apps.common import check_help, load_strategy, pop_float, pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.nmt import build_nmt, nmt_pipeline_strategy, nmt_strategy
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    check_help(argv, __doc__)
     pipeline = "--pipeline" in argv
     if pipeline:
         argv.remove("--pipeline")

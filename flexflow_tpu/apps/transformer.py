@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import load_strategy, pop_int, run_training
+from flexflow_tpu.apps.common import check_help, load_strategy, pop_int, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.transformer import (
     build_transformer_lm,
@@ -26,6 +26,7 @@ from flexflow_tpu.models.transformer import (
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    check_help(argv, __doc__)
     seq = pop_int(argv, "--seq", 512)
     vocab = pop_int(argv, "--vocab", 32 * 1024)
     d_model = pop_int(argv, "--d-model", 512)

@@ -182,3 +182,14 @@ def test_shipped_strategy_files_load():
     assert StrategyStore.load("strategies/dlrm_8chip.json").num_devices == 8
     pb = StrategyStore.load_pb("strategies/dlrm_8chip.pb", num_devices=8)
     assert pb.num_devices == 8
+
+
+@pytest.mark.parametrize("mod", [alexnet, dlrm, nmt, candle_uno, transformer])
+def test_apps_print_help(mod, capsys):
+    """-h/--help prints the app docstring + common flag table and
+    exits 0 instead of being swallowed by Legion-style pass-through."""
+    with pytest.raises(SystemExit) as e:
+        mod.main(["--help"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    assert "Common flags" in out and "-ll:tpu" in out
