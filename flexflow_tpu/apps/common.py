@@ -48,6 +48,7 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --dtype float32|bfloat16   --optimizer sgd|adam   --momentum F
   --profiling   --dry-run   --remat   --trace DIR   --ones-init
   --accum-steps N   --microbatches N   --granules N   --zero-opt
+  --eval-iters N (held-out eval after training)
   --search | --search-iters N (inline strategy autotuning)"""
 
 
@@ -222,6 +223,21 @@ def run_training(
     if arrays is None and num_samples is not None:
         arrays = synthetic_arrays(ff, num_samples, seed=cfg.seed,
                                   int_high=int_high)
+    eval_arrays = None
+    if cfg.eval_iters > 0 and arrays is not None:
+        # True holdout: reserve the trailing rows (batch-aligned, at
+        # most 20% of the data) BEFORE the training loader sees them.
+        n = len(next(iter(arrays.values())))
+        want = min(cfg.eval_iters * cfg.batch_size,
+                   max(cfg.batch_size, n // 5))
+        hold = (want // cfg.batch_size) * cfg.batch_size
+        if 0 < hold < n:
+            eval_arrays = {k: v[n - hold:] for k, v in arrays.items()}
+            arrays = {k: v[: n - hold] for k, v in arrays.items()}
+        else:
+            eval_arrays = arrays
+            print("eval: dataset too small to hold out; "
+                  "evaluating in-sample")
     if arrays is not None:
         # Background prefetch overlaps the host gather + H2D transfer
         # with the device step (the reference's double-buffered ZC
@@ -238,4 +254,26 @@ def run_training(
                         accum_steps=cfg.accum_steps)
     print(f"ELAPSED TIME = {stats['elapsed_s']:.4f}s")
     print(f"THROUGHPUT = {stats['samples_per_s']:.2f} {label}/s")
+    if cfg.eval_iters > 0:
+        # --eval-iters: read-only pass on the trained params (the
+        # reference computes metrics only inside the training
+        # backward, mse_loss.cu:61-112).  With a dataset the rows
+        # held out before training (above) are evaluated; synthetic
+        # mode draws fresh batches per iteration.
+        params, _, state = trainer.final
+        if eval_arrays is not None:
+            eval_batches = iter(ArrayDataLoader(
+                eval_arrays, cfg.batch_size, shuffle=False,
+                seed=cfg.seed + 1, nthreads=cfg.loaders_per_node,
+            ))
+        else:
+            eval_batches = (
+                trainer.synthetic_batch(seed=cfg.seed + 1 + i)
+                for i in range(cfg.eval_iters)
+            )
+        ev = trainer.evaluate(params, state, eval_batches,
+                              iterations=cfg.eval_iters)
+        print(f"EVAL loss = {ev['loss']:.6f} "
+              f"accuracy = {100.0 * ev['accuracy']:.2f}%")
+        stats["eval"] = ev
     return stats

@@ -90,6 +90,11 @@ class FFConfig:
     # strategies (the reference's ``#ifdef PARAMETER_ALL_ONES``,
     # ``conv_2d.cu:394-399``).
     parameter_all_ones: bool = False
+    # --eval-iters N: after training, run N read-only evaluation
+    # batches and print loss/accuracy (the reference computes metrics
+    # only inside the training backward, ``mse_loss.cu:61-112``; a
+    # held-out eval pass is this rebuild's addition).
+    eval_iters: int = 0
     # --zero-opt: ZeRO-1-style optimizer-state sharding — each
     # parameter's optimizer moments (Adam m/v, SGD momentum) shard
     # their leading dim across the mesh axes the op's strategy assigns
@@ -175,6 +180,8 @@ class FFConfig:
                 cfg.parameter_all_ones = True
             elif a == "--zero-opt":
                 cfg.zero_sharded_optimizer = True
+            elif a == "--eval-iters":
+                cfg.eval_iters = int(_next())
             i += 1
         return cfg
 

@@ -153,7 +153,10 @@ class Trainer:
         # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).
         print(f"time = {elapsed:.4f}s")
         print(f"tp = {throughput:.2f} samples/s")
-        self._final = (params, opt_state, state)
+        #: Public contract: the trained (params, opt_state, state) of
+        #: the run that just finished — for post-training evaluation
+        #: or manual checkpointing.
+        self.final = (params, opt_state, state)
         return {
             "elapsed_s": elapsed,
             "samples_per_s": throughput,

@@ -4,7 +4,7 @@
 import numpy as np
 import pytest
 
-from flexflow_tpu.apps import alexnet, candle_uno, dlrm, nmt, transformer
+from flexflow_tpu.apps import alexnet, candle_uno, cnn, dlrm, nmt, transformer
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 
 
@@ -184,7 +184,7 @@ def test_shipped_strategy_files_load():
     assert pb.num_devices == 8
 
 
-@pytest.mark.parametrize("mod", [alexnet, dlrm, nmt, candle_uno, transformer])
+@pytest.mark.parametrize("mod", [alexnet, cnn, dlrm, nmt, candle_uno, transformer])
 def test_apps_print_help(mod, capsys):
     """-h/--help prints the app docstring + common flag table and
     exits 0 instead of being swallowed by Legion-style pass-through."""
@@ -193,3 +193,11 @@ def test_apps_print_help(mod, capsys):
     assert e.value.code == 0
     out = capsys.readouterr().out
     assert "Common flags" in out and "-ll:tpu" in out
+
+
+def test_alexnet_app_eval_iters(capsys):
+    assert alexnet.main([
+        "-b", "4", "-i", "1", "--image-size", "67", "--eval-iters", "2",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "EVAL loss =" in out and "accuracy =" in out
