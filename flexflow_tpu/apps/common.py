@@ -48,7 +48,7 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --dtype float32|bfloat16   --optimizer sgd|adam   --momentum F
   --profiling   --dry-run   --remat   --trace DIR   --ones-init
   --accum-steps N   --microbatches N   --granules N   --zero-opt
-  --eval-iters N (held-out eval after training)
+  --eval-iters N (held-out eval after training)   --clip-norm F
   --search | --search-iters N (inline strategy autotuning)"""
 
 

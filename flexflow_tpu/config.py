@@ -90,6 +90,10 @@ class FFConfig:
     # strategies (the reference's ``#ifdef PARAMETER_ALL_ONES``,
     # ``conv_2d.cu:394-399``).
     parameter_all_ones: bool = False
+    # --clip-norm F: clip gradients to a global L2 norm before the
+    # optimizer step (0 = off).  Applied to the fully-reduced gradient
+    # tree, so the clip decision is identical under every sharding.
+    clip_norm: float = 0.0
     # --eval-iters N: after training, run N read-only evaluation
     # batches and print loss/accuracy (the reference computes metrics
     # only inside the training backward, ``mse_loss.cu:61-112``; a
@@ -182,6 +186,8 @@ class FFConfig:
                 cfg.zero_sharded_optimizer = True
             elif a == "--eval-iters":
                 cfg.eval_iters = int(_next())
+            elif a == "--clip-norm":
+                cfg.clip_norm = float(_next())
             i += 1
         return cfg
 

@@ -15,6 +15,7 @@ Legion coherence + the mapper produced on GPUs.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,6 +28,9 @@ from flexflow_tpu.ops.base import Op, TensorSpec
 from flexflow_tpu.optim import SGDOptimizer
 from flexflow_tpu.parallel.mesh import MeshPlan, build_mesh_plan
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+
+_log = logging.getLogger("ff.executor")
 
 
 def _merge_metrics(acc: Dict[str, jax.Array], m: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
@@ -212,6 +216,18 @@ class Executor:
             return []
         if not getattr(self.optimizer, "supports_sparse_rows", False):
             return []
+        if self.config.clip_norm > 0.0:
+            # Global-norm clipping needs the true whole-table gradient
+            # norm (duplicate-id row cotangents sum BEFORE the norm);
+            # the row-sparse path cannot reproduce that exactly — use
+            # dense gradients when clipping is on.
+            if any(op.sparse_keys() for op in self.model.layers):
+                _log.warning(
+                    "--clip-norm forces DENSE embedding gradients (the "
+                    "row-sparse path cannot compute the exact global "
+                    "norm); expect table-sized gradient buffers"
+                )
+            return []
         input_names = {t.name for t in self.model.input_tensors}
         out = []
         for op in self.model.layers:
@@ -330,6 +346,20 @@ class Executor:
         loss, metrics, new_state, _ = self.forward(params, state, batch, training=True)
         return loss, (metrics, new_state)
 
+    def _clip_grads(self, grads):
+        """--clip-norm: global-L2 gradient clipping before the update
+        (identical under every sharding: the norm reduces over the
+        fully-reduced gradient tree)."""
+        c = self.config.clip_norm
+        if not c or c <= 0.0:
+            return grads
+        sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(grads)
+        )
+        scale = jnp.minimum(1.0, c * jax.lax.rsqrt(jnp.maximum(sq, 1e-30)))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
     def build_train_step(self):
         """The whole iteration — fwd, bwd (autodiff), SGD — as one pure
         function.  Reference equivalent: forward() + zero_gradients() +
@@ -342,6 +372,7 @@ class Executor:
                 (loss, (metrics, new_state)), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
                 )(params, state, batch)
+                grads = self._clip_grads(grads)
                 new_params, new_opt = self.optimizer.update(params, opt_state, grads)
                 return new_params, self._constrain_zero_opt(new_opt), new_state, metrics
 
@@ -422,7 +453,9 @@ class Executor:
                 return new_state, (metrics, grads)
 
             new_state, (metrics, grads) = jax.lax.scan(micro, state, stacked)
-            g = jax.tree.map(lambda x: jnp.mean(x, axis=0), grads)
+            g = self._clip_grads(
+                jax.tree.map(lambda x: jnp.mean(x, axis=0), grads)
+            )
             m = {
                 k: jnp.sum(v, axis=0)
                 if jnp.issubdtype(v.dtype, jnp.integer)

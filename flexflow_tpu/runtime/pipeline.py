@@ -341,6 +341,31 @@ class PipelineExecutor:
         return [self._stage_bwd(i) for i in range(len(self.stages))]
 
     @functools.cached_property
+    def _grad_sq_fns(self):
+        def make(si):
+            def sq(grads):
+                return sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)
+                )
+
+            return jax.jit(sq)
+
+        return [make(i) for i in range(len(self.stages))]
+
+    @functools.cached_property
+    def _scale_fns(self):
+        def make(si):
+            def scale(grads, s):
+                return jax.tree.map(
+                    lambda g: (g * s).astype(g.dtype), grads
+                )
+
+            return jax.jit(scale)
+
+        return [make(i) for i in range(len(self.stages))]
+
+    @functools.cached_property
     def _opt_fns(self):
         def make(si):
             def upd(params, opt_state, grads):
@@ -460,6 +485,23 @@ class PipelineExecutor:
                     metrics_acc = _merge_metrics(metrics_acc, {
                         k: v for k, v in mets.items()
                     })
+
+        # --clip-norm: the global L2 norm spans ALL stages' gradients;
+        # per-stage squared norms combine on the host (the pipeline
+        # step is host-orchestrated anyway), then each stage scales —
+        # numerically identical to Executor._clip_grads, keeping the
+        # DP≡strategy invariant under layer-wise placement.
+        if self.config.clip_norm > 0.0:
+            total = sum(
+                float(jax.device_get(self._grad_sq_fns[si](grads[si])))
+                for si in range(S)
+            )
+            c = self.config.clip_norm
+            scale = min(1.0, c / max(total ** 0.5, 1e-15))
+            if scale < 1.0:
+                s_arr = jnp.float32(scale)
+                for si in range(S):
+                    grads[si] = self._scale_fns[si](grads[si], s_arr)
 
         # Optimizer (per stage, concurrent across submeshes).
         new_params, new_opt = {}, {}
