@@ -46,6 +46,8 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   -i/--iterations N     -d/--dataset PATH    -s FILE       -p/--print-freq N
   -ll:tpu N (devices)   -ll:cpu N (loaders)  --nodes N     --seed N
   --dtype float32|bfloat16   --optimizer sgd|adam   --momentum F
+  --lr-schedule constant|cosine|step  --warmup N  --decay-steps N
+  --min-lr F  --lr-gamma F (adam only)
   --profiling   --dry-run   --remat   --trace DIR   --ones-init
   --accum-steps N   --microbatches N   --granules N   --zero-opt
   --eval-iters N (held-out eval after training)   --clip-norm F
@@ -91,13 +93,28 @@ def make_optimizer(cfg: FFConfig):
     """``--optimizer sgd|adam`` (sgd matches the reference's only
     optimizer, ``optimizer_kernel.cu:28-129``; adam is the rebuild's
     addition)."""
+    if cfg.lr_schedule not in ("constant", "cosine", "step"):
+        raise SystemExit(
+            f"unknown --lr-schedule {cfg.lr_schedule!r} "
+            f"(constant|cosine|step)"
+        )
+    if cfg.lr_schedule != "constant" and cfg.optimizer != "adam":
+        raise SystemExit(
+            "--lr-schedule requires --optimizer adam (SGD keeps the "
+            "reference's fixed-lr semantics)"
+        )
     if cfg.optimizer == "sgd":
         return SGDOptimizer(
             lr=cfg.learning_rate, momentum=cfg.momentum,
             weight_decay=cfg.weight_decay,
         )
     if cfg.optimizer == "adam":
-        return AdamOptimizer(lr=cfg.learning_rate, weight_decay=cfg.weight_decay)
+        return AdamOptimizer(
+            lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
+            schedule=cfg.lr_schedule, warmup_steps=cfg.warmup_steps,
+            decay_steps=cfg.decay_steps, min_lr=cfg.min_lr,
+            gamma=cfg.lr_gamma,
+        )
     raise SystemExit(f"unknown --optimizer {cfg.optimizer!r} (sgd|adam)")
 
 

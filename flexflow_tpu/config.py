@@ -57,6 +57,14 @@ class FFConfig:
     # rebuild's addition — see flexflow_tpu/optim.py).
     optimizer: str = "sgd"
     momentum: float = 0.9
+    # --lr-schedule constant|cosine|step (+ --warmup/--decay-steps/
+    # --min-lr): Adam learning-rate schedules; the reference trains at
+    # a fixed lr, and SGD keeps those semantics.
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    decay_steps: int = 10_000
+    min_lr: float = 0.0
+    lr_gamma: float = 0.1  # --lr-gamma: step-schedule decay factor
     # Gradient accumulation: microbatches per optimizer step
     # (Executor.accum_train_step).
     accum_steps: int = 1
@@ -168,6 +176,16 @@ class FFConfig:
                 cfg.optimizer = _next().lower()
             elif a == "--momentum":
                 cfg.momentum = float(_next())
+            elif a == "--lr-schedule":
+                cfg.lr_schedule = _next().lower()
+            elif a == "--warmup":
+                cfg.warmup_steps = int(_next())
+            elif a == "--decay-steps":
+                cfg.decay_steps = int(_next())
+            elif a == "--min-lr":
+                cfg.min_lr = float(_next())
+            elif a == "--lr-gamma":
+                cfg.lr_gamma = float(_next())
             elif a == "--accum-steps":
                 cfg.accum_steps = int(_next())
             elif a == "--granules":

@@ -252,3 +252,59 @@ def test_clip_norm_pipeline_matches_full_mesh(rng):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
         )
+
+
+def test_adam_lr_schedules():
+    """Cosine warmup/decay and step-decay shapes, evaluated at exact
+    points; scheduled lr drives the parameter update."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.optim import AdamOptimizer
+
+    cos = AdamOptimizer(lr=1.0, schedule="cosine", warmup_steps=10,
+                        decay_steps=100, min_lr=0.1)
+    assert float(cos._lr_at(jnp.int32(5))) == pytest.approx(0.5)    # ramp
+    assert float(cos._lr_at(jnp.int32(10))) == pytest.approx(1.0)   # peak
+    assert float(cos._lr_at(jnp.int32(60))) == pytest.approx(
+        0.1 + 0.9 * 0.5, rel=1e-5
+    )  # halfway: cos(pi/2) midpoint
+    assert float(cos._lr_at(jnp.int32(110))) == pytest.approx(0.1)  # floor
+    assert float(cos._lr_at(jnp.int32(500))) == pytest.approx(0.1)
+
+    step = AdamOptimizer(lr=1.0, schedule="step", decay_steps=10, gamma=0.5)
+    assert float(step._lr_at(jnp.int32(1))) == pytest.approx(1.0)
+    assert float(step._lr_at(jnp.int32(10))) == pytest.approx(1.0)
+    assert float(step._lr_at(jnp.int32(11))) == pytest.approx(0.5)
+    assert float(step._lr_at(jnp.int32(25))) == pytest.approx(0.25)
+
+    with pytest.raises(ValueError, match="unknown schedule"):
+        AdamOptimizer(schedule="exp")._lr_at(jnp.int32(1))
+
+    # The schedule actually changes the applied update.
+    import numpy as _np
+
+    p = {"w": jnp.ones((4,), jnp.float32)}
+    g = {"w": jnp.full((4,), 0.5, jnp.float32)}
+    o1 = AdamOptimizer(lr=1.0)
+    o2 = AdamOptimizer(lr=1.0, schedule="cosine", warmup_steps=10,
+                       decay_steps=100)
+    p1, _ = o1.update(p, o1.init(p), g)
+    p2, _ = o2.update(p, o2.init(p), g)  # t=1 -> lr 0.1 of peak
+    d1 = float(_np.abs(1.0 - _np.asarray(p1["w"])[0]))
+    d2 = float(_np.abs(1.0 - _np.asarray(p2["w"])[0]))
+    assert d2 < d1 * 0.2
+
+
+def test_lr_schedule_app_flags(capsys):
+    from flexflow_tpu.apps import alexnet
+
+    assert alexnet.main([
+        "-b", "4", "-i", "2", "--image-size", "67", "--optimizer", "adam",
+        "--lr-schedule", "cosine", "--warmup", "2", "--decay-steps", "10",
+    ]) == 0
+    assert "tp =" in capsys.readouterr().out
+    with pytest.raises(SystemExit, match="adam"):
+        alexnet.main([
+            "-b", "4", "-i", "1", "--image-size", "67",
+            "--lr-schedule", "cosine",
+        ])
