@@ -103,6 +103,10 @@ def make_optimizer(cfg: FFConfig):
             "--lr-schedule requires --optimizer adam (SGD keeps the "
             "reference's fixed-lr semantics)"
         )
+    if cfg.lr_schedule != "cosine" and (cfg.warmup_steps or cfg.min_lr):
+        raise SystemExit(
+            "--warmup/--min-lr apply to --lr-schedule cosine only"
+        )
     if cfg.optimizer == "sgd":
         return SGDOptimizer(
             lr=cfg.learning_rate, momentum=cfg.momentum,

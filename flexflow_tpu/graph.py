@@ -304,10 +304,16 @@ class FFModel:
     def reshape(self, x: TensorSpec, shape: Sequence[int], name: Optional[str] = None) -> TensorSpec:
         return self._add(Reshape(self._unique("reshape", name), x, shape))
 
-    def softmax(self, logits: TensorSpec, labels: TensorSpec, name: Optional[str] = None) -> TensorSpec:
+    def softmax(self, logits: TensorSpec, labels: TensorSpec,
+                label_smoothing: float = 0.0,
+                name: Optional[str] = None) -> TensorSpec:
         """Fused softmax + cross-entropy loss (reference: softmax op is
-        fused with the loss, ``src/ops/softmax.cu:91-160``)."""
-        return self._add(SoftmaxCrossEntropy(self._unique("softmax", name), logits, labels))
+        fused with the loss, ``src/ops/softmax.cu:91-160``);
+        ``label_smoothing`` mixes in the uniform distribution."""
+        return self._add(SoftmaxCrossEntropy(
+            self._unique("softmax", name), logits, labels,
+            label_smoothing=label_smoothing,
+        ))
 
     def mse_loss(self, pred: TensorSpec, label: TensorSpec, reduction: str = "mean",
                  name: Optional[str] = None) -> TensorSpec:

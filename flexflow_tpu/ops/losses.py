@@ -33,12 +33,19 @@ class SoftmaxCrossEntropy(Op):
 
     is_loss = True
 
-    def __init__(self, name: str, logits: TensorSpec, labels: TensorSpec):
+    def __init__(self, name: str, logits: TensorSpec, labels: TensorSpec,
+                 label_smoothing: float = 0.0):
         super().__init__(name, [logits, labels])
         assert logits.ndim >= 2
         assert labels.shape == logits.shape[:-1], (
             f"labels must be {logits.shape[:-1]}, got {labels.shape}"
         )
+        if not 0.0 <= label_smoothing < 1.0:  # also rejects nan
+            raise ValueError(
+                f"{name}: label_smoothing must be in [0, 1), "
+                f"got {label_smoothing}"
+            )
+        self.attrs = dict(label_smoothing=label_smoothing)
         # Loss op still exposes the softmax probabilities as an output
         # (the reference's softmax op output region).  ND logits (the
         # per-token NMT case, ``nmt/softmax_data_parallel.cu``) are
@@ -98,13 +105,25 @@ class SoftmaxCrossEntropy(Op):
         fused = self._fused_nll_pred(logits, labels)
         if fused is not None:
             nll, pred = fused
+            row_lse = None  # the kernel keeps lse internal
             # Probabilities only if a consumer reads them (DCE'd else).
             logp = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
         else:
             lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+            row_lse = lse[..., 0]
             logp = logits - lse
             nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
             pred = jnp.argmax(logits, axis=-1)
+        eps = self.attrs["label_smoothing"]
+        if eps > 0.0:
+            # Uniform-smoothed CE: (1-eps)*nll + eps*(1/V) sum_j -log p_j
+            # = (1-eps)*nll + eps*(lse - mean(logits)) — exact from row
+            # statistics, so it composes with the fused kernel's nll.
+            if row_lse is None:
+                row_lse = jax.nn.logsumexp(logits, axis=-1)
+            nll = (1.0 - eps) * nll + eps * (
+                row_lse - jnp.mean(logits, axis=-1)
+            )
         loss = jnp.mean(nll)
         correct = jnp.sum((pred == labels).astype(jnp.int32))
         metrics = {

@@ -289,3 +289,44 @@ class TestFusedXentInLoss:
             ),
             p1, p8,
         )
+
+
+def test_softmax_label_smoothing_oracle(rng):
+    """Uniform-smoothed CE from row statistics must equal the explicit
+    soft-target cross entropy; smoothing=0 is the plain loss; grads
+    flow."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.base import TensorSpec
+    from flexflow_tpu.ops.losses import SoftmaxCrossEntropy
+
+    n, v = 16, 32
+    logits = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, size=(n,)), jnp.int32)
+    lg_spec = TensorSpec("lg", (n, v), jnp.float32, ("n", None))
+    lb_spec = TensorSpec("lb", (n,), jnp.int32, ("n",))
+
+    def loss_of(eps):
+        op = SoftmaxCrossEntropy("sm", lg_spec, lb_spec, label_smoothing=eps)
+        (loss, metrics, _), _ = op.forward({}, [logits, labels], {}, True)
+        return loss
+
+    eps = 0.1
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, v)
+    soft = (1 - eps) * onehot + eps / v
+    want = float(jnp.mean(-jnp.sum(soft * logp, axis=-1)))
+    np.testing.assert_allclose(float(loss_of(eps)), want, rtol=1e-6)
+
+    plain = float(jnp.mean(-jnp.take_along_axis(
+        logp, labels[:, None], axis=-1)))
+    np.testing.assert_allclose(float(loss_of(0.0)), plain, rtol=1e-6)
+
+    g = jax.grad(lambda lg: SoftmaxCrossEntropy(
+        "sm", lg_spec, lb_spec, label_smoothing=eps
+    ).forward({}, [lg, labels], {}, True)[0][0])(logits)
+    assert np.isfinite(np.asarray(g)).all()
+
+    with pytest.raises(ValueError, match="label_smoothing"):
+        SoftmaxCrossEntropy("sm", lg_spec, lb_spec, label_smoothing=1.5)
