@@ -193,6 +193,104 @@ class MeshPlan:
     ) -> NamedSharding:
         return NamedSharding(self.mesh, self.spec(pc, dim_axes, shape))
 
+    def reshard_hops(
+        self, frm: PartitionSpec, to: PartitionSpec, ndim: int
+    ) -> List[PartitionSpec]:
+        """Decompose the sharding transition ``frm -> to`` into
+        intermediate constraints GSPMD reshards efficiently.
+
+        GSPMD full-remats ("replicate then partition", the involuntary-
+        full-rematerialization warning) any transition that moves a mesh
+        axis between tensor dims WHILE also adding/dropping axes or
+        moving from several source dims at once — exactly what happens
+        at strategy boundaries (spatial conv block -> DP dense block,
+        table-parallel embedding -> DP reshape).  The reference never
+        hits this because Legion materializes arbitrary repartitions as
+        explicit copies (``flat.cu:81-124``); here we get the same
+        effect by walking through hops that XLA maps onto single
+        collectives:
+
+        - axes only in ``to`` are first added minor-most at their
+          target dim (a local dynamic-slice, zero communication),
+        - axes moving between dims go one (src,dst) chunk per hop
+          (a subgroup all-to-all),
+        - axes only in ``frm`` are dropped by the final ``to``
+          constraint (a subgroup all-gather).
+
+        Returns the intermediate specs strictly between ``frm`` and
+        ``to`` — empty when no axis moves dims (GSPMD already handles
+        pure add/drop transitions) or when a hop would break the
+        mesh-order invariant every spec in this plan obeys.
+        """
+        order = self.axis_names.index
+
+        def chains(spec) -> List[List[str]]:
+            entries = list(spec) + [None] * (ndim - len(spec))
+            out = []
+            for e in entries[:ndim]:
+                if e is None:
+                    out.append([])
+                elif isinstance(e, str):
+                    out.append([e])
+                else:
+                    out.append(list(e))
+            return out
+
+        f, t = chains(frm), chains(to)
+        if f == t:
+            return []
+        pos_f = {a: d for d, ch in enumerate(f) for a in ch}
+        pos_t = {a: d for d, ch in enumerate(t) for a in ch}
+        movers = sorted(
+            (a for a in pos_f if a in pos_t and pos_f[a] != pos_t[a]),
+            key=order,
+        )
+        if not movers:
+            return []
+
+        def as_spec(cur: List[List[str]]) -> PartitionSpec:
+            return PartitionSpec(*[
+                None if not ch else (ch[0] if len(ch) == 1 else tuple(ch))
+                for ch in cur
+            ])
+
+        hops: List[PartitionSpec] = []
+        cur = [list(ch) for ch in f]
+        # 1. Adds: each new axis must land minor-most (only a tail
+        #    append is a pure local slice).
+        adds = sorted((a for a in pos_t if a not in pos_f), key=order)
+        for a in adds:
+            ch = cur[pos_t[a]]
+            if ch and order(ch[-1]) > order(a):
+                return []  # non-minor insert: no efficient decomposition
+            ch.append(a)
+        if adds:
+            hops.append(as_spec(cur))
+        # 2. Moves: one (src,dst) chunk per hop, appended minor-most.
+        chunks: Dict[Tuple[int, int], List[str]] = {}
+        for a in movers:
+            chunks.setdefault((pos_f[a], pos_t[a]), []).append(a)
+        for (s, d), axes in sorted(
+            chunks.items(), key=lambda kv: min(order(a) for a in kv[1])
+        ):
+            dst = cur[d]
+            for a in sorted(axes, key=order):
+                if dst and order(dst[-1]) > order(a):
+                    return []
+                cur[s].remove(a)
+                dst.append(a)
+            hops.append(as_spec(cur))
+        # 3. Drops happen in the caller's final `to` constraint; they
+        #    must be chain suffixes there to stay a clean all-gather.
+        for d in range(ndim):
+            if cur[d][: len(t[d])] != t[d]:
+                return []
+        # The last hop may already equal `to` (no drops): keep it out
+        # so callers always terminate the chain with `to` itself.
+        if hops and chains(hops[-1]) == t:
+            hops.pop()
+        return hops
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec())
 

@@ -292,6 +292,7 @@ class Executor:
         those ops run ``sparse_forward`` (never touching their table)
         so autodiff produces row-sized cotangents."""
         env: Dict[str, jax.Array] = {}
+        env_spec: Dict[str, PartitionSpec] = {}
         for t in self.model.input_tensors:
             x = batch[t.name]
             # The sample dim may shrink (pipeline microbatching splits
@@ -300,13 +301,18 @@ class Executor:
             assert x.shape[strict_from:] == t.shape[strict_from:], (
                 f"input {t.name}: expected {t.shape}, got {x.shape}"
             )
-            env[t.name] = jax.lax.with_sharding_constraint(x, self.input_sharding(t))
+            sh = self.input_sharding(t)
+            env[t.name] = jax.lax.with_sharding_constraint(x, sh)
+            env_spec[t.name] = sh.spec
         total_loss = jnp.float32(0.0)
         metrics: Dict[str, jax.Array] = {}
         new_state: Dict[str, Dict[str, jax.Array]] = {}
         for op in self.model.layers:
             op.bind_mesh(self.plan, self._pc(op))
-            xs = [env[t.name] for t in op.inputs]
+            xs = [
+                self._reshard_input(env[t.name], env_spec.get(t.name), t, op)
+                for t in op.inputs
+            ]
             p = params.get(op.name, {})
             s = state.get(op.name, {})
             if rows_override is not None and op.name in rows_override:
@@ -332,13 +338,36 @@ class Executor:
             else:
                 ys = result
             for t, y in zip(op.outputs, ys):
-                y = jax.lax.with_sharding_constraint(y, self.output_sharding(op, t))
+                sh = self.output_sharding(op, t)
+                y = jax.lax.with_sharding_constraint(y, sh)
                 env[t.name] = y
+                env_spec[t.name] = sh.spec
             if s_new is not s and s_new:
                 new_state[op.name] = s_new
             elif s:
                 new_state[op.name] = s
         return total_loss, metrics, new_state, env
+
+    def _reshard_input(self, x, frm_spec, t: TensorSpec, op: Op):
+        """Reshard a consumer's input through explicit decomposed hops
+        when the producer/consumer strategy boundary moves mesh axes
+        across tensor dims — the transitions GSPMD otherwise handles by
+        involuntary full rematerialization (replicate + repartition).
+        The reverse chain constrains the cotangent in the backward pass,
+        so both directions reshard with subgroup collectives.  The
+        reference analogue is Legion materializing explicit copies for
+        arbitrary repartitions between ops (``flat.cu:81-124``)."""
+        if frm_spec is None:
+            return x
+        to_spec = self.plan.spec(self._pc(op), t.dim_axes, t.shape)
+        hops = self.plan.reshard_hops(frm_spec, to_spec, len(t.shape))
+        if not hops:
+            return x  # GSPMD handles pure add/drop transitions itself
+        for spec in hops + [to_spec]:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.plan.mesh, spec)
+            )
+        return x
 
     # -- steps -------------------------------------------------------------
 
