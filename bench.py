@@ -113,7 +113,8 @@ def bench_alexnet(n_chips: int, on_tpu: bool):
 def bench_dlrm(n_chips: int, on_tpu: bool):
     """``run_random.sh`` shape: 8 x 1M-row x 64-dim tables, 256
     samples/chip/iter (``dlrm.cc:165-166``; tables shrunk on the CPU
-    fallback where the 2 GB of tables would swamp the probe)."""
+    fallback where the 2 GB of tables would swamp the probe).
+    Returns (samples/s, mfu, sparse_error_or_None)."""
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.models.dlrm import (
         build_dlrm,
@@ -136,10 +137,14 @@ def bench_dlrm(n_chips: int, on_tpu: bool):
         ex = Executor(ff, strategy=dlrm_strategy(n_chips, cfg),
                       optimizer=SGDOptimizer(lr=0.01))
         stats = Trainer(ex).fit(iterations=10 if on_tpu else 3, warmup=2)
-        return stats["samples_per_s"]
+        mfu = (_train_flops(ff) / batch) * stats["samples_per_s"] / (
+            V5E_BF16_PEAK_FLOPS * n_chips
+        )
+        return stats["samples_per_s"], mfu
 
     try:
-        return run(sparse=True), None
+        sps, mfu = run(sparse=True)
+        return sps, mfu, None
     except Exception as e:
         # Row-sparse path failed (e.g. kernel regression on a new
         # runtime): the dense-gradient number is still an honest
@@ -147,13 +152,15 @@ def bench_dlrm(n_chips: int, on_tpu: bool):
         # configuration ran and why.
         err = f"sparse path failed, dense fallback: {type(e).__name__}: {e}"
         print(err, file=sys.stderr)
-        return run(sparse=False), err
+        sps, mfu = run(sparse=False)
+        return sps, mfu, err
 
 
 def bench_transformer(on_tpu: bool):
     """Long-context flagship: GPT-style LM step with the Pallas flash
     attention kernel (dense single-chip path; the ring/CP path is
-    exercised by the driver's multi-chip dry run).  Reports tokens/s."""
+    exercised by the driver's multi-chip dry run).  Returns
+    (tokens/s, mfu)."""
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.models.transformer import build_transformer_lm
     from flexflow_tpu.optim import AdamOptimizer
@@ -173,7 +180,10 @@ def bench_transformer(on_tpu: bool):
     ex = Executor(ff, optimizer=AdamOptimizer(lr=1e-4),
                   devices=jax.devices()[:1])  # single-chip by contract
     stats = Trainer(ex).fit(iterations=10 if on_tpu else 3, warmup=2)
-    return stats["samples_per_s"] * seq
+    mfu = (_train_flops(ff) / batch) * stats["samples_per_s"] / (
+        V5E_BF16_PEAK_FLOPS
+    )
+    return stats["samples_per_s"] * seq, mfu
 
 
 def bench_nmt(n_chips: int, on_tpu: bool):
@@ -286,17 +296,18 @@ def main():
     extra["alexnet_mfu"] = round(mfu, 4)
     try:
         with contextlib.redirect_stdout(sys.stderr):
-            dlrm_sps, dlrm_fallback = bench_dlrm(n_chips, on_tpu)
+            dlrm_sps, dlrm_mfu, dlrm_fallback = bench_dlrm(n_chips, on_tpu)
         extra["dlrm_samples_per_s"] = round(dlrm_sps, 2)
+        extra["dlrm_mfu"] = round(dlrm_mfu, 4)
         if dlrm_fallback:
             extra["dlrm_sparse_error"] = dlrm_fallback
     except Exception as e:  # DLRM failure must not sink the headline
         extra["dlrm_error"] = f"{type(e).__name__}: {e}"
     try:
         with contextlib.redirect_stdout(sys.stderr):
-            extra["transformer_tokens_per_s"] = round(
-                bench_transformer(on_tpu), 1
-            )
+            tfm_tps, tfm_mfu = bench_transformer(on_tpu)
+        extra["transformer_tokens_per_s"] = round(tfm_tps, 1)
+        extra["transformer_mfu"] = round(tfm_mfu, 4)
     except Exception as e:
         extra["transformer_error"] = f"{type(e).__name__}: {e}"
     try:
@@ -339,7 +350,10 @@ def main():
         actual_n = len(jax.devices())
         per_chip = per_chip * n_chips / actual_n
         n_chips = extra["n_chips"] = actual_n
-        extra["alexnet_mfu"] = None  # computed against a TPU roofline
+        # MFU fields are computed against the TPU roofline.
+        for k in ("alexnet_mfu", "dlrm_mfu", "transformer_mfu"):
+            if k in extra:
+                extra[k] = None
 
     print(
         json.dumps(

@@ -51,6 +51,7 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --profiling   --dry-run   --remat   --trace DIR   --ones-init
   --accum-steps N   --microbatches N   --granules N   --zero-opt
   --eval-iters N (held-out eval after training)   --clip-norm F
+  --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)"""
 
 
@@ -111,6 +112,7 @@ def make_optimizer(cfg: FFConfig):
         return SGDOptimizer(
             lr=cfg.learning_rate, momentum=cfg.momentum,
             weight_decay=cfg.weight_decay,
+            lazy_sparse=cfg.lazy_sparse_optimizer,
         )
     if cfg.optimizer == "adam":
         return AdamOptimizer(
@@ -118,6 +120,7 @@ def make_optimizer(cfg: FFConfig):
             schedule=cfg.lr_schedule, warmup_steps=cfg.warmup_steps,
             decay_steps=cfg.decay_steps, min_lr=cfg.min_lr,
             gamma=cfg.lr_gamma,
+            lazy_sparse=cfg.lazy_sparse_optimizer,
         )
     raise SystemExit(f"unknown --optimizer {cfg.optimizer!r} (sgd|adam)")
 

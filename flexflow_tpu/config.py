@@ -101,7 +101,16 @@ class FFConfig:
     # --clip-norm F: clip gradients to a global L2 norm before the
     # optimizer step (0 = off).  Applied to the fully-reduced gradient
     # tree, so the clip decision is identical under every sharding.
+    # With row-sparse embedding updates the exact norm comes from
+    # per-unique-id segment sums of the row cotangents (never a
+    # table-sized gradient).
     clip_norm: float = 0.0
+    # --lazy-sparse-opt: keep the row-sparse embedding path under
+    # momentum SGD / Adam with torch-SparseAdam lazy semantics (decay
+    # and moments advance only for rows the step touches; documented
+    # deviation from the dense update).  Off = those optimizers force
+    # dense table gradients.
+    lazy_sparse_optimizer: bool = False
     # --eval-iters N: after training, run N read-only evaluation
     # batches and print loss/accuracy (the reference computes metrics
     # only inside the training backward, ``mse_loss.cu:61-112``; a
@@ -206,6 +215,8 @@ class FFConfig:
                 cfg.eval_iters = int(_next())
             elif a == "--clip-norm":
                 cfg.clip_norm = float(_next())
+            elif a == "--lazy-sparse-opt":
+                cfg.lazy_sparse_optimizer = True
             i += 1
         return cfg
 

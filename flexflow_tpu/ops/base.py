@@ -131,6 +131,14 @@ class Op:
         """Scatter row cotangents: p.at[ids].add(-lr * g)."""
         raise NotImplementedError
 
+    def sparse_flat_ids(self, params, xs):
+        """Row ids of every gathered row into the ``(R, D)`` flat view
+        of the (single) sparse table — ``table.reshape(-1, last_dim)``.
+        Shape matches ``row_grads[..., 0]``.  Lets the executor compute
+        duplicate-id row sums generically (exact global-norm clipping;
+        unique-row lazy momentum/Adam updates)."""
+        raise NotImplementedError
+
     # -- execution --------------------------------------------------------
 
     def forward(

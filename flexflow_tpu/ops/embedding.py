@@ -139,6 +139,10 @@ class Embedding(Op):
         )
         return {**params, "table": table}
 
+    def sparse_flat_ids(self, params, xs):
+        (idx,) = xs
+        return idx
+
 
 class MultiEmbedding(Op):
     """T same-shaped tables stacked into one sharded parameter — the
@@ -217,6 +221,10 @@ class MultiEmbedding(Op):
             -lr * row_grads,
         )
         return {**params, "tables": new.reshape(T, V, D)}
+
+    def sparse_flat_ids(self, params, xs):
+        (idx,) = xs
+        return self._flat_ids(params["tables"], idx)
 
 
 class HeteroEmbedding(Op):
@@ -329,6 +337,11 @@ class HeteroEmbedding(Op):
         )
         return {**params, "table": table}
 
+    def sparse_flat_ids(self, params, xs):
+        (idx,) = xs
+        offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
+        return idx + offsets[None, :]
+
     def forward(self, params, xs, state, training):
         import jax
         from jax.sharding import PartitionSpec
@@ -429,3 +442,7 @@ class WordEmbedding(Op):
             self, params["table"], idx, -lr * row_grads
         )
         return {**params, "table": table}
+
+    def sparse_flat_ids(self, params, xs):
+        (idx,) = xs
+        return idx

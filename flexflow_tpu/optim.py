@@ -26,14 +26,66 @@ class SGDOptimizer:
     momentum: float = 0.0
     nesterov: bool = False
     weight_decay: float = 0.0
+    #: Opt-in lazy row-sparse semantics (--lazy-sparse-opt): momentum
+    #: and weight decay apply only to rows touched by the step (the
+    #: torch SparseAdam deviation, documented in PARITY.md) — rows hit
+    #: every step update exactly; cold rows keep stale momentum.
+    lazy_sparse: bool = False
 
     @property
     def supports_sparse_rows(self) -> bool:
         """Row-sparse embedding updates (Executor sparse path) are
         numerically identical to the dense update only for plain SGD:
         momentum needs a dense buffer and weight decay touches every
-        row every step."""
+        row every step.  ``lazy_sparse`` opts into the documented lazy
+        deviation instead."""
+        return (
+            self.momentum == 0.0 and self.weight_decay == 0.0
+        ) or self.lazy_sparse
+
+    @property
+    def stateless_sparse(self) -> bool:
+        """True when the row update is a pure scaled scatter-add (no
+        per-row state, linear in the gradient): duplicate-id cotangents
+        may be scattered per occurrence instead of per unique id."""
         return self.momentum == 0.0 and self.weight_decay == 0.0
+
+    def sparse_state_buffers(self, opt_state, op_name: str, key: str):
+        """Per-row state arrays (table-shaped) backing one sparse
+        param, by buffer name."""
+        if self.momentum == 0.0 or opt_state is None:
+            return {}
+        return {"v": opt_state[op_name][key]}
+
+    def with_sparse_state_buffers(self, opt_state, op_name: str, key: str, new):
+        if not new:
+            return opt_state
+        out = dict(opt_state)
+        out[op_name] = {**out[op_name], key: new["v"]}
+        return out
+
+    def sparse_step_count(self, opt_state):
+        """Step counter the row step needs (None for SGD)."""
+        return None
+
+    def sparse_row_step(self, p_rows, g_rows, state_rows, t=None):
+        """One optimizer step restricted to gathered rows: returns
+        (delta_p, delta_state) so the caller can scatter-ADD deltas
+        back (unique row ids: add == assign).  Lazy semantics: decay/
+        momentum see only the touched rows."""
+        g = g_rows.astype(jnp.float32)
+        pf = p_rows.astype(jnp.float32)
+        if self.weight_decay > 0.0:
+            g = g + self.weight_decay * pf
+        if self.momentum > 0.0:
+            v = state_rows["v"].astype(jnp.float32)
+            v_new = self.momentum * v + g
+            step = g + self.momentum * v_new if self.nesterov else v_new
+            d_state = {"v": (v_new - v).astype(state_rows["v"].dtype)}
+        else:
+            step = g
+            d_state = {}
+        return (-self.lr * step).astype(p_rows.dtype), d_state
 
     def init(self, params) -> Any:
         """Momentum buffers (the reference's per-parameter ``v_regions``,
@@ -59,6 +111,17 @@ class SGDOptimizer:
         """Apply ``fn`` to every params-structured subtree of the
         optimizer state (ZeRO sharding hook; scalars pass through)."""
         return None if opt_state is None else fn(opt_state)
+
+    def restore_param_states(self, new_state, old_state, names):
+        """Reinsert ``names`` param subtrees from ``old_state`` into
+        ``new_state`` (executor sparse path)."""
+        if old_state is None:
+            return new_state
+        merged = dict(new_state or {})
+        for n in names:
+            if n in old_state:
+                merged[n] = old_state[n]
+        return merged
 
     def update(self, params, opt_state, grads):
         """Returns (new_params, new_opt_state).  Pure; jit-safe."""
@@ -100,6 +163,59 @@ class AdamOptimizer:
     decay_steps: int = 10_000
     min_lr: float = 0.0
     gamma: float = 0.1
+    #: Opt-in lazy row-sparse semantics (--lazy-sparse-opt): torch
+    #: SparseAdam — moments/decay advance only for rows the step
+    #: touches; bias correction uses the global step count.
+    lazy_sparse: bool = False
+
+    @property
+    def supports_sparse_rows(self) -> bool:
+        return self.lazy_sparse
+
+    @property
+    def stateless_sparse(self) -> bool:
+        return False
+
+    def sparse_state_buffers(self, opt_state, op_name: str, key: str):
+        return {
+            "m": opt_state["m"][op_name][key],
+            "v": opt_state["v"][op_name][key],
+        }
+
+    def with_sparse_state_buffers(self, opt_state, op_name: str, key: str, new):
+        out = {
+            "m": dict(opt_state["m"]),
+            "v": dict(opt_state["v"]),
+            "t": opt_state["t"],
+        }
+        out["m"][op_name] = {**out["m"][op_name], key: new["m"]}
+        out["v"][op_name] = {**out["v"][op_name], key: new["v"]}
+        return out
+
+    def sparse_step_count(self, opt_state):
+        return opt_state["t"]
+
+    def sparse_row_step(self, p_rows, g_rows, state_rows, t=None):
+        """SparseAdam row step (lazy: only touched rows advance).
+        ``t`` is the global post-increment step count from the dense
+        update; returns scatter-addable deltas."""
+        tf = t.astype(jnp.float32)
+        lr = self._lr_at(t)
+        g = g_rows.astype(jnp.float32)
+        m = state_rows["m"]
+        v = state_rows["v"]
+        m_new = self.b1 * m + (1.0 - self.b1) * g
+        v_new = self.b2 * v + (1.0 - self.b2) * jnp.square(g)
+        mh = m_new / (1.0 - self.b1 ** tf)
+        vh = v_new / (1.0 - self.b2 ** tf)
+        pf = p_rows.astype(jnp.float32)
+        upd = mh / (jnp.sqrt(vh) + self.eps)
+        if self.weight_decay > 0.0:
+            upd = upd + self.weight_decay * pf
+        return (
+            (-lr * upd).astype(p_rows.dtype),
+            {"m": m_new - m, "v": v_new - v},
+        )
 
     def _lr_at(self, t):
         """Scheduled lr for (traced, 1-based) step ``t``."""
@@ -140,8 +256,25 @@ class AdamOptimizer:
             "t": opt_state["t"],
         }
 
+    def restore_param_states(self, new_state, old_state, names):
+        """Reinsert ``names`` param subtrees from ``old_state`` into
+        ``new_state`` (executor sparse path: those params were filtered
+        out of the dense update and get row-wise state updates)."""
+        out = {
+            "m": dict(new_state["m"]),
+            "v": dict(new_state["v"]),
+            "t": new_state["t"],
+        }
+        for n in names:
+            if n in old_state["m"]:
+                out["m"][n] = old_state["m"][n]
+                out["v"][n] = old_state["v"][n]
+        return out
+
     def update(self, params, opt_state, grads):
         t = opt_state["t"] + 1
+        if not params:  # all-sparse model: only the step count advances
+            return params, {"m": {}, "v": {}, "t": t}
         tf = t.astype(jnp.float32)
         lr = self._lr_at(t)
         c1 = 1.0 - self.b1 ** tf

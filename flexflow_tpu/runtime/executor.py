@@ -33,6 +33,27 @@ from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 _log = logging.getLogger("ff.executor")
 
 
+def _unique_row_sums(flat_ids, flat_g):
+    """Sum duplicate-id row cotangents: returns ``(uids, gsum, mask)``
+    with one summed row per unique id in the first ``nuniq`` slots
+    (zeros beyond).  This is exactly what the dense scatter-add
+    gradient holds per touched row (the reference's atomicAdd backward,
+    ``embedding.cu:144-158``), computed at batch size instead of table
+    size: sort ids, segment-sum adjacent equals."""
+    n = flat_ids.shape[0]
+    order = jnp.argsort(flat_ids)
+    sid = jnp.take(flat_ids, order)
+    sg = jnp.take(flat_g, order, axis=0)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), (sid[1:] != sid[:-1]).astype(jnp.int32)]
+    )
+    seg = jnp.cumsum(starts)
+    gsum = jax.ops.segment_sum(sg, seg, num_segments=n)
+    uids = jnp.zeros((n,), sid.dtype).at[seg].set(sid)
+    mask = jnp.arange(n) <= seg[-1]
+    return uids, gsum, mask
+
+
 def _merge_metrics(acc: Dict[str, jax.Array], m: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     out = dict(acc)
     for k, v in m.items():
@@ -216,18 +237,6 @@ class Executor:
             return []
         if not getattr(self.optimizer, "supports_sparse_rows", False):
             return []
-        if self.config.clip_norm > 0.0:
-            # Global-norm clipping needs the true whole-table gradient
-            # norm (duplicate-id row cotangents sum BEFORE the norm);
-            # the row-sparse path cannot reproduce that exactly — use
-            # dense gradients when clipping is on.
-            if any(op.sparse_keys() for op in self.model.layers):
-                _log.warning(
-                    "--clip-norm forces DENSE embedding gradients (the "
-                    "row-sparse path cannot compute the exact global "
-                    "norm); expect table-sized gradient buffers"
-                )
-            return []
         input_names = {t.name for t in self.model.input_tensors}
         out = []
         for op in self.model.layers:
@@ -375,6 +384,18 @@ class Executor:
         loss, metrics, new_state, _ = self.forward(params, state, batch, training=True)
         return loss, (metrics, new_state)
 
+    def _clip_scale(self, grads, extra_sq=0.0):
+        """--clip-norm scale factor from the global L2 norm of ``grads``
+        plus ``extra_sq`` (the sparse ops' per-unique-row squared sums).
+        One formula for every execution path, so the clip decision is
+        identical under dense, sparse and accumulated gradients."""
+        c = self.config.clip_norm
+        sq = extra_sq + sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(grads)
+        )
+        return jnp.minimum(1.0, c * jax.lax.rsqrt(jnp.maximum(sq, 1e-30)))
+
     def _clip_grads(self, grads):
         """--clip-norm: global-L2 gradient clipping before the update
         (identical under every sharding: the norm reduces over the
@@ -382,11 +403,7 @@ class Executor:
         c = self.config.clip_norm
         if not c or c <= 0.0:
             return grads
-        sq = sum(
-            jnp.sum(jnp.square(g.astype(jnp.float32)))
-            for g in jax.tree.leaves(grads)
-        )
-        scale = jnp.minimum(1.0, c * jax.lax.rsqrt(jnp.maximum(sq, 1e-30)))
+        scale = self._clip_scale(grads)
         return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
     def build_train_step(self):
@@ -408,6 +425,8 @@ class Executor:
             return train_step
 
         sparse_names = {op.name for op in sparse_ops}
+        stateless = getattr(self.optimizer, "stateless_sparse", True)
+        clip = self.config.clip_norm
 
         def sparse_train_step(params, opt_state, state, batch):
             rows = {}
@@ -427,16 +446,112 @@ class Executor:
             (loss, (metrics, new_state)), (dg, rg) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True
             )(dense, rows)
-            new_params, new_opt = self.optimizer.update(dense, opt_state, dg)
+
+            # Duplicate-id row sums per sparse op — needed by exact
+            # global-norm clipping (the dense gradient's norm sums
+            # duplicate-id cotangents BEFORE squaring) and by stateful
+            # (lazy momentum/Adam) row updates (nonlinear in g, so one
+            # update per unique row).
+            uniq = {}
+            if clip > 0.0 or not stateless:
+                for op in sparse_ops:
+                    xs = [batch[t.name] for t in op.inputs]
+                    ids = op.sparse_flat_ids(params[op.name], xs)
+                    g = rg[op.name]
+                    uniq[op.name] = _unique_row_sums(
+                        ids.reshape(-1), g.reshape(-1, g.shape[-1])
+                    )
+
+            scale = None
+            if clip > 0.0:
+                extra_sq = sum(
+                    jnp.sum(jnp.square(gsum.astype(jnp.float32)))
+                    for (_, gsum, _) in uniq.values()
+                )
+                scale = self._clip_scale(dg, extra_sq)
+                dg = jax.tree.map(
+                    lambda g: (g * scale).astype(g.dtype), dg
+                )
+
+            # Dense update over the non-sparse params; sparse subtrees
+            # of the optimizer state are filtered out and row-updated
+            # below (SGD: None state passes through untouched).
+            opt_dense = self.optimizer.map_param_states(
+                opt_state,
+                lambda tree: {
+                    k: v for k, v in tree.items() if k not in sparse_names
+                },
+            )
+            new_params, new_opt = self.optimizer.update(dense, opt_dense, dg)
+            new_opt = self.optimizer.restore_param_states(
+                new_opt, opt_state, sparse_names
+            ) if new_opt is not None else None
+
             lr = self.optimizer.lr
             for op in sparse_ops:
-                xs = [batch[t.name] for t in op.inputs]
-                new_params[op.name] = op.sparse_apply(
-                    params[op.name], xs, rg[op.name], lr
-                )
-            return new_params, new_opt, new_state, metrics
+                if stateless:
+                    xs = [batch[t.name] for t in op.inputs]
+                    g = rg[op.name]
+                    if scale is not None:
+                        g = g * scale
+                    # Linear update: per-occurrence scatter-add
+                    # (duplicates distribute), Pallas row-DMA kernels.
+                    new_params[op.name] = op.sparse_apply(
+                        params[op.name], xs, g, lr
+                    )
+                else:
+                    new_params[op.name], new_opt = self._sparse_stateful_apply(
+                        op, params[op.name], new_opt, uniq[op.name], scale
+                    )
+            return new_params, self._constrain_zero_opt(new_opt), new_state, metrics
 
         return sparse_train_step
+
+    def _sparse_stateful_apply(self, op: Op, op_params, opt_state, uniq, scale):
+        """Lazy momentum/Adam row update for one sparse op: gather the
+        unique rows' param + optimizer-state rows, run the optimizer's
+        row step, scatter-add the deltas back (unique ids: add ==
+        assign; padding slots carry zero deltas into row 0 — a no-op
+        compatible with both the jnp and Pallas scatter paths)."""
+        from flexflow_tpu.ops.embedding import (
+            _gather_dispatch,
+            _scatter_add_dispatch,
+        )
+
+        uids, gsum, mask = uniq
+        if scale is not None:
+            gsum = gsum * scale
+        key = op.sparse_keys()[0]
+        table = op_params[key]
+        flat = table.reshape(-1, table.shape[-1])
+        safe = jnp.where(mask, uids, 0)
+        p_rows = _gather_dispatch(op, flat, safe)
+        bufs = self.optimizer.sparse_state_buffers(opt_state, op.name, key)
+        buf_rows = {
+            k: _gather_dispatch(op, b.reshape(-1, b.shape[-1]), safe)
+            for k, b in bufs.items()
+        }
+        t = self.optimizer.sparse_step_count(opt_state)
+        d_p, d_bufs = self.optimizer.sparse_row_step(
+            p_rows, gsum, buf_rows, t=t
+        )
+        m = mask[:, None]
+        new_flat = _scatter_add_dispatch(
+            op, flat, safe, jnp.where(m, d_p, 0)
+        )
+        new_bufs = {}
+        for k, b in bufs.items():
+            b2 = b.reshape(-1, b.shape[-1])
+            nb = _scatter_add_dispatch(
+                op, b2, safe, jnp.where(m, d_bufs[k], 0)
+            )
+            new_bufs[k] = nb.reshape(b.shape)
+        new_params = {**op_params, key: new_flat.reshape(table.shape)}
+        if new_bufs:
+            opt_state = self.optimizer.with_sparse_state_buffers(
+                opt_state, op.name, key, new_bufs
+            )
+        return new_params, opt_state
 
     @functools.cached_property
     def train_step(self):

@@ -114,6 +114,195 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+def _shard_shapes(op, pc):
+    """Shard-local shapes for one candidate config: every dim tagged
+    with a semantic axis is divided by that axis's degree, except dims
+    the op contracts in full on every shard (linear/attention feature
+    dim, conv input channels).  This is the reference's microbenchmark
+    geometry: ``measure_conv2d_time`` benches the shard's rect on ONE
+    device (``scripts/cnn.h:204+``)."""
+    from flexflow_tpu.search.cost_model import contracted_input_dims
+
+    contracted = set(contracted_input_dims(op))
+
+    def local(shape, dim_axes, skip_dims=()):
+        out = []
+        for d, (ext, ax) in enumerate(zip(shape, dim_axes)):
+            deg = 1 if (ax is None or d in skip_dims) else pc.degree(ax)
+            out.append(max(1, int(ext) // max(deg, 1)))
+        return tuple(out)
+
+    xs = [
+        local(t.shape, t.dim_axes, contracted if ti == 0 else ())
+        for ti, t in enumerate(op.inputs)
+    ]
+    ps = {k: local(s.shape, s.dim_axes) for k, s in op.param_specs().items()}
+    ss = {k: local(s.shape, s.dim_axes) for k, s in op.state_specs().items()}
+    return xs, ps, ss
+
+
+def _synth(shape, dtype, key):
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        # Index inputs: 0 is valid for every table/vocab extent.
+        return jnp.zeros(shape, dtype)
+    return jax.random.normal(key, shape, dtype) * 0.02
+
+
+def _time_shard_forward(op, p, xs, s, loops=(4, 20), reps=2):
+    """Per-iteration forward time (us) of one op at fixed shapes.
+
+    Relay-proof protocol: the op runs ``n`` serially-dependent times
+    inside ONE jitted ``fori_loop`` call (a tiny carry-derived
+    perturbation defeats CSE), at two loop counts; the difference
+    cancels dispatch + fence overhead — the ~16 ms/call relay floor
+    that makes single-shot eager timing meaningless (the reference's
+    analogue concern: cudaEvent pairs around repeated kernel launches,
+    ``scripts/cnn.h:231-246``).  Two dispatches per measurement, each
+    fenced by host readback, so the relay chain stays short.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def perturbed(tree, eps):
+        leaves, treedef = jax.tree.flatten(tree)
+        done = False
+        out = []
+        for leaf in leaves:
+            if not done and jnp.issubdtype(leaf.dtype, jnp.floating):
+                out.append(leaf + eps.astype(leaf.dtype))
+                done = True
+            else:
+                out.append(leaf)
+        return jax.tree.unflatten(treedef, out), done
+
+    def make(n):
+        def run(p, xs, s):
+            def body(i, acc):
+                eps = acc * jnp.float32(1e-30)
+                xs2, ok = perturbed(list(xs), eps)
+                p2 = p
+                if not ok:
+                    p2, _ = perturbed(p, eps)
+                result, _ = op.forward(p2, xs2, s, False)
+                ys = result[2] if op.is_loss else result
+                first = jax.tree.leaves(ys)[0]
+                return acc + first.ravel()[0].astype(jnp.float32) * 1e-30
+
+            return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+        return jax.jit(run)
+
+    lo, hi = loops
+    times = {}
+    for n in (lo, hi):
+        fn = make(n)
+        jax.device_get(fn(p, xs, s))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.device_get(fn(p, xs, s))
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    return max((times[hi] - times[lo]) / (hi - lo) * 1e6, 1e-3)
+
+
+def measured_degree_table(
+    model,
+    num_devices: int,
+    max_candidates: int = 64,
+    loops=(4, 20),
+    measure=None,
+    seed: int = 0,
+) -> Dict[str, Dict[tuple, float]]:
+    """Measure every (op, parallel-degree) candidate live — the
+    reference's ``computeTime[]`` cache filled by per-config cuDNN
+    microbenchmarks (``scripts/cnn.h:204-260``, ``simulator.cc:
+    142-151``).  Returns ``{op name: {(n,c,h,w,s): per-shard fwd us}}``
+    for ``search_strategy(measured_costs=...)``; per-shard times come
+    from running the shard's LOCAL shapes on one device, so nonlinear
+    scaling (MXU under-utilization at small tiles, fixed overheads)
+    is captured instead of the old measured/parts linear assumption.
+
+    Structurally identical shards (same op type, attrs and local
+    shapes — e.g. repeated Inception blocks, or a (n=2,c=1) shard
+    equal to a (n=2,c=1,h=1...) one) are measured once via a shape
+    cache.  ``measure(op, pc, p, xs, s) -> us`` is injectable (tests,
+    alternative timers); ops whose forward cannot run at sliced shapes
+    (static-shape reshapes) are skipped — the search falls back to the
+    roofline for them.
+    """
+    from flexflow_tpu.parallel.mesh import build_mesh_plan
+    from flexflow_tpu.parallel.strategy import AXES, ParallelConfig
+    from flexflow_tpu.search.problem import build_virtual_plan, enumerate_candidates
+
+    vplan = build_virtual_plan(num_devices)
+    plan1 = build_mesh_plan(1)
+    key = jax.random.PRNGKey(seed)
+    cache: Dict[tuple, float] = {}
+    table: Dict[str, Dict[tuple, float]] = {}
+    for op in model.layers:
+        op.bind_mesh(plan1, ParallelConfig())
+        entries: Dict[tuple, float] = {}
+        for pc in enumerate_candidates(op, vplan, max_candidates):
+            degs = tuple(pc.degree(a) for a in AXES)
+            if degs in entries:
+                continue  # device-shifted variant: same shard geometry
+            xs_shapes, p_shapes, s_shapes = _shard_shapes(op, pc)
+            ck = (
+                type(op).__name__,
+                str(sorted(getattr(op, "attrs", {}).items())),
+                tuple(zip(xs_shapes, (str(t.dtype) for t in op.inputs))),
+                tuple(sorted((k, v) for k, v in p_shapes.items())),
+            )
+            if ck in cache:
+                entries[degs] = cache[ck]
+                continue
+            key, *subs = jax.random.split(key, 4)
+            try:
+                xs = [
+                    _synth(sh, t.dtype, subs[0])
+                    for sh, t in zip(xs_shapes, op.inputs)
+                ]
+                p = {
+                    k: _synth(sh, op.param_specs()[k].dtype, subs[1])
+                    for k, sh in p_shapes.items()
+                }
+                s = {
+                    k: _synth(sh, op.state_specs()[k].dtype, subs[2])
+                    for k, sh in s_shapes.items()
+                }
+                if measure is not None:
+                    us = measure(op, pc, p, xs, s)
+                else:
+                    us = _time_shard_forward(op, p, xs, s, loops=loops)
+            except Exception as e:
+                _log_measure_skip(op, pc, e)
+                continue
+            cache[ck] = us
+            entries[degs] = us
+        if entries:
+            table[op.name] = entries
+    return table
+
+
+_seen_measure_skips: set = set()
+
+
+def _log_measure_skip(op, pc, e):
+    import logging
+
+    k = (op.name, type(e).__name__)
+    if k not in _seen_measure_skips:
+        _seen_measure_skips.add(k)
+        logging.getLogger("ff.profiler").warning(
+            "measured_degree_table: %s at %s failed (%s: %s); roofline "
+            "fallback for this candidate",
+            op.name, {a: pc.degree(a) for a in "nchws"}, type(e).__name__, e,
+        )
+
+
 def measured_cost_table(
     ex: Executor,
     params: Any,

@@ -71,18 +71,18 @@ def main(argv=None):
     if args.measured:
         import jax
 
-        from flexflow_tpu.runtime.executor import Executor
-        from flexflow_tpu.runtime.profiler import measured_cost_table
-        from flexflow_tpu.runtime.trainer import Trainer
+        from flexflow_tpu.runtime.profiler import measured_degree_table
 
-        # Single-device executor: whole-op times, no collectives mixed
-        # into the compute estimate (the search adds comm itself).
-        ex = Executor(model, devices=jax.devices()[:1])
-        params, _, state = ex.init()
-        table = measured_cost_table(
-            ex, params, state, Trainer(ex).synthetic_batch()
+        # Per-(op, degree) shard-local microbenchmarks on one device —
+        # the reference's computeTime[config] cache (scripts/cnn.h:
+        # 204-260); comm costs stay model-derived (the search prices
+        # them itself).
+        table = measured_degree_table(model, num_devices=args.devices)
+        n_cfg = sum(len(v) for v in table.values())
+        print(
+            f"measured {len(table)} op costs on {jax.default_backend()} "
+            f"({n_cfg} (op, degree) configs)"
         )
-        print(f"measured {len(table)} op costs on {jax.default_backend()}")
         measured = table
     res = search_strategy(
         model, num_devices=args.devices, iters=args.iters,

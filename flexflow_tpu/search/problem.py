@@ -177,11 +177,19 @@ def build_problem(
     max_candidates: int = 64,
     measured_costs: Optional[Dict[str, float]] = None,
 ) -> SearchProblem:
-    """``measured_costs`` (op name → measured full-op forward time, us;
-    from ``runtime.profiler.measured_cost_table``) overrides the
-    roofline compute estimate per op — the reference's measured-
-    microbenchmark mode (``simulator.cc:1420-1440``).  Comm and sync
-    stay model-derived."""
+    """``measured_costs`` overrides the roofline compute estimate per
+    op — the reference's measured-microbenchmark mode
+    (``simulator.cc:1420-1440``).  Two formats per op name:
+
+    - ``{(n,c,h,w,s): per-shard fwd us}`` from
+      ``runtime.profiler.measured_degree_table`` — per-(op, degree)
+      live measurements, the reference's ``computeTime[config]`` cache
+      (``scripts/cnn.h:204-260``); candidates with no entry fall back
+      to the roofline.
+    - a float (legacy ``measured_cost_table``): whole-op time scaled
+      by the linear ``/num_parts`` assumption.
+
+    Comm and sync stay model-derived."""
     dev = dev or DeviceModel()
     measured_costs = measured_costs or {}
     ops = list(model.layers)
@@ -204,10 +212,20 @@ def build_problem(
         measured = measured_costs.get(op.name)
         for pc in cands:
             degrees = {a: pc.degree(a) for a in AXES}
-            if measured is not None:
-                c_us = dev.task_overhead_us + measured * FWD_BWD_FACTOR / pc.num_parts
-            else:
-                c_us = shard_cost_us(cost, pc.num_parts, dev)
+            m_us: Optional[float] = None
+            if isinstance(measured, dict):
+                m = measured.get(tuple(pc.degree(a) for a in AXES))
+                if m is not None:
+                    m_us = dev.task_overhead_us + m * FWD_BWD_FACTOR
+            elif measured is not None:
+                m_us = (
+                    dev.task_overhead_us
+                    + measured * FWD_BWD_FACTOR / pc.num_parts
+                )
+            c_us = (
+                m_us if m_us is not None
+                else shard_cost_us(cost, pc.num_parts, dev)
+            )
             s_us = sync_cost_us(cost, degrees, dev)
             devs = shard_devices(plan, pc)
             degs = " ".join(str(pc.degree(a)) for a in AXES)

@@ -343,3 +343,120 @@ class TestDeviceShiftedCandidates:
             params, opt_state, state, batch
         )
         assert np.isfinite(float(jax.device_get(m["train_loss"])))
+
+
+class TestMeasuredDegrees:
+    """Per-(op, degree) measured cost tables (the reference's
+    ``computeTime[config]`` cache filled by live microbenchmarks per
+    parallel degree, ``scripts/cnn.h:204-260``, ``simulator.cc:
+    142-151``) replacing the whole-op / num_parts linear assumption."""
+
+    def _model(self):
+        import jax.numpy as jnp
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+
+        batch = 8
+        ff = FFModel(FFConfig(batch_size=batch))
+        x = ff.create_tensor((batch, 1024), name="x")
+        lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+        t = ff.dense(x, 1024, activation="relu", name="fc")
+        t = ff.dense(t, 16, name="head")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    def test_shard_local_shapes(self):
+        from flexflow_tpu.runtime.profiler import _shard_shapes
+
+        ff = self._model()
+        fc = ff.layers[0]
+        xs, ps, _ = _shard_shapes(fc, ParallelConfig(n=2, c=4))
+        # Input: batch split by n, contracted feature dim kept FULL.
+        assert xs == [(4, 1024)]
+        # Kernel rows (out features, 'c') split 4-ways; bias likewise.
+        assert ps["kernel"] == (256, 1024)
+        assert ps["bias"] == (256,)
+
+    def test_structural_cache_dedupes(self):
+        """Identical shard geometries (same type/attrs/local shapes)
+        are measured once — the reference's computeTime[] keyed by op
+        hash + config (``simulator.cc:142-151``)."""
+        from flexflow_tpu.runtime.profiler import measured_degree_table
+
+        import jax.numpy as jnp
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+
+        calls = []
+
+        def measure(op, pc, p, xs, s):
+            calls.append((op.name, tuple(x.shape for x in xs)))
+            return 10.0
+
+        # Two structurally identical dense layers (the repeated-block
+        # Inception case): the second one's candidates must all hit
+        # the first one's cache entries.
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 64), name="x")
+        lbl = ff.create_tensor((8,), dtype=jnp.int32, name="label")
+        t = ff.dense(x, 64, activation="relu", name="fc1")
+        t = ff.dense(t, 64, activation="relu", name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        table = measured_degree_table(ff, 8, measure=measure)
+        assert set(table) == {"fc1", "fc2", "softmax"}
+        assert table["fc1"] == table["fc2"]
+        assert not any(name == "fc2" for name, _ in calls)
+        assert all(us > 0 for v in table.values() for us in v.values())
+
+    def test_measured_search_diverges_from_roofline(self):
+        """The VERDICT-item acceptance: measured per-degree costs make
+        the search pick a different (simulated-better-under-measure)
+        strategy than the roofline on the same graph.  The injected
+        measure models an MXU utilization floor: per-shard time scales
+        with local rows but TP shards pay a fixed small-tile penalty —
+        exactly the nonlinearity the old measured/parts linear scaling
+        could not express."""
+        from flexflow_tpu.runtime.profiler import measured_degree_table
+
+        ff = self._model()
+        roofline = search_strategy(ff, num_devices=8, iters=5000, seed=0)
+        # Roofline: the big fc weight makes DP grad-sync dominant, so
+        # the search tensor-parallelizes fc.
+        assert roofline.assignment["fc"].c > 1
+
+        def measure(op, pc, p, xs, s):
+            return 10.0 * xs[0].shape[0] + 200.0 * (pc.degree("c") - 1)
+
+        table = measured_degree_table(ff, 8, measure=measure)
+        measured = search_strategy(
+            ff, num_devices=8, iters=5000, seed=0, measured_costs=table
+        )
+        assert measured.assignment["fc"].c == 1
+        assert measured.assignment["fc"] != roofline.assignment["fc"]
+
+    def test_real_timing_smoke(self):
+        """The real two-point fori_loop timer produces positive,
+        finite per-degree times on the CPU backend for a tiny model
+        and the search consumes them end to end."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+        from flexflow_tpu.runtime.profiler import measured_degree_table
+
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 32), name="x")
+        lbl = ff.create_tensor((8,), dtype=jnp.int32, name="label")
+        t = ff.dense(x, 16, activation="relu", name="fc")
+        ff.softmax(t, lbl, name="softmax")
+        table = measured_degree_table(ff, 4, loops=(2, 6))
+        assert table and all(
+            np.isfinite(us) and us > 0
+            for v in table.values() for us in v.values()
+        )
+        res = search_strategy(
+            ff, num_devices=4, iters=1000, seed=0, measured_costs=table
+        )
+        assert res.best_time_us > 0
