@@ -296,10 +296,18 @@ char* dup_result(const std::string& s) {
 extern "C" {
 
 // Metropolis MCMC over per-op config choices (reference acceptance rule:
-// accept better always, worse with prob exp(-alpha * delta / current),
-// simulator.cc:1444-1470).  Starts from config 0 for every op (the
-// Python layer puts the data-parallel fallback first).  Returns a text
-// blob: "init_us I\nbest_us B\nassign i0 i1 ...\n" or "error: ...".
+// accept better always, worse with prob exp(-alpha * delta),
+// simulator.cc:1444-1470 — the reference's delta is ABSOLUTE time, so
+// its chain is near-greedy at real step scales).  Here delta is scaled
+// to PERCENT of the current makespan so alpha is problem-size-free:
+// alpha=5 accepts a +1% move with p=exp(-5)~0.7%.  (An earlier
+// delta/current scaling made +1% moves accept at p=0.95 — on 100+-op
+// graphs the chain random-walked off the DP optimum into scrambled
+// states and never got back below the initial point.)  After a stale
+// streak the state re-anchors to the best seen, turning long runs into
+// restarts around the incumbent.  Starts from config 0 for every op
+// (the Python layer puts the data-parallel fallback first).  Returns a
+// text blob: "init_us I\nbest_us B\nassign i0 i1 ...\n" or "error: ...".
 char* ffsim_search(const char* problem, long iters, unsigned seed,
                    double alpha) {
   Problem p;
@@ -320,6 +328,9 @@ char* ffsim_search(const char* problem, long iters, unsigned seed,
   for (int i = 0; i < n; ++i)
     if (p.ops[i].cfgs.size() > 1) movable.push_back(i);
   if (!movable.empty()) {
+    // Re-anchor after ~8 sweeps without a new incumbent.
+    const long stale_limit = 8L * (long)movable.size();
+    long stale = 0;
     for (long it = 0; it < iters; ++it) {
       int oi = movable[rng() % movable.size()];
       int old = cur[oi];
@@ -328,17 +339,22 @@ char* ffsim_search(const char* problem, long iters, unsigned seed,
       if (nxt >= old) ++nxt;
       cur[oi] = nxt;
       double t = simulate(p, cur);
-      bool accept = t < cur_t ||
-                    unif(rng) < std::exp(-alpha * (t - cur_t) /
-                                         std::max(cur_t, 1e-9));
+      double pct = 100.0 * (t - cur_t) / std::max(cur_t, 1e-9);
+      bool accept = t < cur_t || unif(rng) < std::exp(-alpha * pct);
       if (accept) {
         cur_t = t;
         if (t < best_t) {
           best_t = t;
           best = cur;
+          stale = 0;
         }
       } else {
         cur[oi] = old;
+      }
+      if (++stale >= stale_limit) {
+        cur = best;
+        cur_t = best_t;
+        stale = 0;
       }
     }
   }
