@@ -583,8 +583,8 @@ class PipelineExecutor:
     def eval_step(self, params, state, batch):
         graph_inputs = {t.name for t in self.model.input_tensors}
         boundary: Dict[str, Any] = {}
-        total_loss = jnp.float32(0.0)
-        metrics: Dict[str, jax.Array] = {}
+        losses: List[Any] = []
+        mets_list: List[Dict[str, Any]] = []
         for si, st in enumerate(self.stages):
             inputs = {}
             for n in st.in_names:
@@ -593,10 +593,19 @@ class PipelineExecutor:
             loss, mets, _, env = self._eval_fns[si](
                 params[si], state[si], inputs
             )
-            total_loss = total_loss + jax.device_get(loss)
-            metrics = _merge_metrics(metrics, mets)
+            losses.append(loss)
+            mets_list.append(mets)
             boundary.update({n: env[n] for n in st.out_names})
-        return total_loss, metrics
+        # ONE host sync for the whole pass: per-stage losses/metrics
+        # live on different submeshes (device arithmetic across meshes
+        # is invalid), so they are summed host-side — but fetching
+        # inside the loop serialized every stage on a device_get
+        # (pipeline-overhead finding, PIPELINE_OVERHEAD.md).
+        losses, mets_list = jax.device_get((losses, mets_list))
+        metrics: Dict[str, Any] = {}
+        for mets in mets_list:
+            metrics = _merge_metrics(metrics, mets)
+        return float(sum(losses)), metrics
 
     @functools.cached_property
     def _eval_fns(self):
