@@ -10,7 +10,12 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import check_help, pop_int, run_training
+from flexflow_tpu.apps.common import (
+    check_help,
+    load_image_dataset,
+    pop_int,
+    run_training,
+)
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.alexnet import build_alexnet
 
@@ -24,7 +29,8 @@ def main(argv=None) -> int:
     cfg = FFConfig.parse_args(argv)
     ff = build_alexnet(batch_size=cfg.batch_size, image_size=image_size,
                        config=cfg)
-    stats = run_training(ff, cfg, int_high={"label": 1000}, label="images")
+    stats = run_training(ff, cfg, int_high={"label": 1000}, label="images",
+                         arrays=load_image_dataset(cfg, image_size))
     if not stats.get("dry_run"):
         print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
     return 0

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import check_help, run_training
+from flexflow_tpu.apps.common import check_help, load_image_dataset, run_training
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.alexnet import build_alexnet
 from flexflow_tpu.models.cnn_catalog import (
@@ -43,7 +43,8 @@ def main(argv=None) -> int:
     cfg = FFConfig.parse_args(argv)
     build, image_size = MODELS[model]
     ff = build(batch_size=cfg.batch_size, image_size=image_size, config=cfg)
-    stats = run_training(ff, cfg, int_high={"label": 1000}, label="images")
+    stats = run_training(ff, cfg, int_high={"label": 1000}, label="images",
+                         arrays=load_image_dataset(cfg, image_size))
     if not stats.get("dry_run"):
         print(f"tp = {stats['samples_per_s']:.2f} images/s")  # cnn.cc:128-129
     return 0

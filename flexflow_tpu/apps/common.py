@@ -125,6 +125,19 @@ def make_optimizer(cfg: FFConfig):
     raise SystemExit(f"unknown --optimizer {cfg.optimizer!r} (sgd|adam)")
 
 
+def load_image_dataset(cfg: FFConfig, image_size: int):
+    """-d DIR for the CNN apps: folder-of-images ingestion (host
+    decode + normalize, the reference's JPEG path, ``model.cu:45-257``).
+    Returns the arrays dict, or None when no dataset is given — or
+    under ``--dry-run``, which performs no compute and must not decode
+    a whole image folder first."""
+    if not cfg.dataset_path or cfg.dry_run:
+        return None
+    from flexflow_tpu.data.images import load_image_folder
+
+    return load_image_folder(cfg.dataset_path, image_size)
+
+
 def load_strategy(cfg: FFConfig, num_devices: int) -> Optional[StrategyStore]:
     """``-s file.pb`` reads the reference protobuf format; anything
     else is our JSON schema (``parallel/strategy.py``)."""

@@ -1,0 +1,88 @@
+"""Folder-of-images ingestion for the CNN apps.
+
+Reference: the (ifdef'd) JPEG input path — host-side decode into the
+full-dataset region plus a GPU normalize kernel
+(``src/runtime/model.cu:45-257``).  TPU-native shape of the same
+pattern: decode + resize + normalize ON THE HOST into one resident
+f32 array (the reference's zero-copy staging region), then batch via
+the standard ``ArrayDataLoader`` host-gather; ``Executor.shard_batch``
+device-puts each batch directly in its consumer's sharding.
+
+Layout: ImageNet-style class folders — ``root/<class>/<img>`` — or a
+flat folder (all label 0).  Labels are assigned by sorted class-dir
+name.  Synthetic input stays the default benchmark path (`-d` opts in,
+matching the reference's ``syntheticInput`` flag, ``config.h:73``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
+
+#: Channel normalization — the reference's normalize kernel recenters
+#: raw pixels on the device (``model.cu``); same math, host-side.
+MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def list_image_files(root: str) -> List[Tuple[str, int]]:
+    """(path, label) pairs; label = sorted class-dir index, or 0 for a
+    flat folder of images."""
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d))
+    )
+    out: List[Tuple[str, int]] = []
+    if classes:
+        for li, cls in enumerate(classes):
+            cdir = os.path.join(root, cls)
+            for f in sorted(os.listdir(cdir)):
+                if f.lower().endswith(IMAGE_EXTS):
+                    out.append((os.path.join(cdir, f), li))
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(IMAGE_EXTS):
+                out.append((os.path.join(root, f), 0))
+    if not out:
+        raise FileNotFoundError(f"no images under {root!r} ({IMAGE_EXTS})")
+    return out
+
+
+def decode_image(path: str, image_size: int) -> np.ndarray:
+    """Host decode → RGB → resize (bilinear) → normalized f32 HWC."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize(
+            (image_size, image_size), Image.BILINEAR
+        )
+        arr = np.asarray(im, np.float32) / 255.0
+    return (arr - MEAN) / STD
+
+
+def load_image_folder(
+    root: str,
+    image_size: int,
+    limit: Optional[int] = None,
+    image_key: str = "image",
+    label_key: str = "label",
+) -> Dict[str, np.ndarray]:
+    """Decode every image under ``root`` into one resident array pair
+    — the reference's load-entire-dataset-to-ZC-memory staging
+    (``dlrm.cc:226-330``; JPEG path ``model.cu:45-257``).  Returns
+    ``{image: (N, S, S, 3) f32 NHWC, label: (N,) i32}`` for
+    ``ArrayDataLoader``/``apps.common.run``."""
+    files = list_image_files(root)
+    if limit is not None:
+        files = files[:limit]
+    n = len(files)
+    images = np.empty((n, image_size, image_size, 3), np.float32)
+    labels = np.empty((n,), np.int32)
+    for i, (path, label) in enumerate(files):
+        images[i] = decode_image(path, image_size)
+        labels[i] = label
+    return {image_key: images, label_key: labels}

@@ -80,6 +80,14 @@ def _load(name: str, configure) -> ctypes.CDLL:
 
 
 def _configure_ffsim(lib):
+    lib.ffsim_validate.restype = ctypes.c_void_p
+    lib.ffsim_validate.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+    ]
+    lib.ffsim_check_intervals.restype = ctypes.c_void_p
+    lib.ffsim_check_intervals.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+    ]
     lib.ffsim_search.restype = ctypes.c_void_p
     lib.ffsim_search.argtypes = [
         ctypes.c_char_p, ctypes.c_long, ctypes.c_uint, ctypes.c_double,
@@ -144,6 +152,36 @@ def ffsim_simulate(problem: str, assign) -> float:
         lib.ffsim_simulate, problem.encode(), arr, len(assign)
     )
     return float(text.split()[1])
+
+
+def ffsim_validate(problem: str, assign) -> Dict[str, float]:
+    """Validating simulate — the reference's VERBOSE schedule-
+    consistency mode (``simulator.cc:1012-1031``): every compute/comm
+    occupancy is recorded and checked for per-resource overlap.
+    Returns ``{"time_us": ..., "ntasks": ...}``; raises ``ValueError``
+    on an inconsistent schedule."""
+    lib = load_ffsim()
+    arr = (ctypes.c_int * len(assign))(*assign)
+    text = _call_returning_text(
+        lib.ffsim_validate, problem.encode(), arr, len(assign)
+    )
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        key, val = line.split()
+        out[key] = float(val)
+    return out
+
+
+def ffsim_check_intervals(triples: Sequence[Tuple[int, float, float]]) -> None:
+    """Run the schedule-consistency checker on raw (resource, start,
+    end) occupancies; raises ``ValueError`` on overlap or bad bounds
+    (test surface for the validator itself)."""
+    lib = load_ffsim()
+    flat: List[float] = []
+    for res, s, e in triples:
+        flat.extend((float(res), float(s), float(e)))
+    arr = (ctypes.c_double * len(flat))(*flat)
+    _call_returning_text(lib.ffsim_check_intervals, arr, len(triples))
 
 
 # ---------------------------------------------------------------------------

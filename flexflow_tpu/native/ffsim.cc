@@ -224,9 +224,52 @@ double overlap_volume(const EdgeT& e, const Cfg& sc, int si, const Cfg& dc,
   return vol;
 }
 
+// One scheduled occupancy of a resource: a shard task on a device
+// (res = device id) or a transfer on a (src,dst) channel
+// (res = ndev + src*ndev + dst).  Recorded by the validating simulate
+// for the schedule self-check (the reference's VERBOSE consistency
+// assertions, simulator.cc:1012-1031).
+struct Interval {
+  int res;
+  double s, e;
+};
+
+// Schedule-consistency check: on every resource, occupancies must be
+// non-overlapping and time-ordered with finite non-negative bounds —
+// the exact property the reference asserts over allTasks in VERBOSE
+// mode (no two same-guid tasks overlap, simulator.cc:1028-1031).
+bool check_intervals(std::vector<Interval> iv, std::string& err) {
+  const double eps = 1e-6;
+  for (const Interval& x : iv) {
+    if (!(x.s >= 0.0) || !(x.e >= x.s) || !std::isfinite(x.e)) {
+      std::ostringstream o;
+      o << "bad interval on res " << x.res << ": [" << x.s << ", " << x.e
+        << ")";
+      err = o.str();
+      return false;
+    }
+  }
+  std::sort(iv.begin(), iv.end(), [](const Interval& a, const Interval& b) {
+    return a.res != b.res ? a.res < b.res : a.s < b.s;
+  });
+  for (size_t i = 1; i < iv.size(); ++i) {
+    if (iv[i].res == iv[i - 1].res && iv[i].s + eps < iv[i - 1].e) {
+      std::ostringstream o;
+      o << "overlap on res " << iv[i].res << ": [" << iv[i - 1].s << ", "
+        << iv[i - 1].e << ") vs [" << iv[i].s << ", " << iv[i].e << ")";
+      err = o.str();
+      return false;
+    }
+  }
+  return true;
+}
+
 // Greedy earliest-start list scheduling of shard tasks + comm tasks over
 // per-device compute timelines and per-(src,dst) channel timelines.
-double simulate(const Problem& p, const std::vector<int>& assign) {
+// When ``rec`` is non-null every compute/comm occupancy is recorded for
+// the consistency self-check.
+double simulate(const Problem& p, const std::vector<int>& assign,
+                std::vector<Interval>* rec = nullptr) {
   const int n = (int)p.ops.size();
   std::vector<double> dev_free(p.ndev, 0.0);
   std::vector<double> chan(p.ndev * p.ndev, 0.0);
@@ -256,6 +299,7 @@ double simulate(const Problem& p, const std::vector<int>& assign) {
           double start = std::max(sfin[i], ch);
           ch = start + t;
           ready[j] = std::max(ready[j], start + t);
+          if (rec) rec->push_back({p.ndev + sd * p.ndev + dd, start, start + t});
         }
       }
     }
@@ -268,6 +312,7 @@ double simulate(const Problem& p, const std::vector<int>& assign) {
       dev_free[d] = fin;
       finish[oi][j] = fin;
       op_end = std::max(op_end, fin);
+      if (rec) rec->push_back({d, start, fin});
     }
     if (cfg.sync_us > 0.0) {
       // Gradient reduction over this op's replica group: charge every
@@ -384,6 +429,51 @@ char* ffsim_simulate(const char* problem, const int* assign, int n) {
   std::ostringstream out;
   out << "time_us " << simulate(p, a) << '\n';
   return dup_result(out.str());
+}
+
+// Validating simulate (the reference's VERBOSE schedule-consistency
+// mode, simulator.cc:1012-1031): records every compute/comm occupancy
+// and checks non-overlap per resource.  Returns
+// "time_us T\nntasks N\nvalid 1\n" or "error: schedule inconsistent: ...".
+char* ffsim_validate(const char* problem, const int* assign, int n) {
+  Problem p;
+  std::string err;
+  if (!parse_problem(problem, p, err)) {
+    return dup_result("error: " + err);
+  }
+  if (n != (int)p.ops.size()) {
+    return dup_result("error: assignment length mismatch");
+  }
+  std::vector<int> a(assign, assign + n);
+  for (int i = 0; i < n; ++i) {
+    if (a[i] < 0 || a[i] >= (int)p.ops[i].cfgs.size()) {
+      return dup_result("error: config index out of range");
+    }
+  }
+  std::vector<Interval> rec;
+  double t = simulate(p, a, &rec);
+  if (!check_intervals(rec, err)) {
+    return dup_result("error: schedule inconsistent: " + err);
+  }
+  std::ostringstream out;
+  out << "time_us " << t << "\nntasks " << rec.size() << "\nvalid 1\n";
+  return dup_result(out.str());
+}
+
+// Test entry for the consistency checker itself: ``triples`` is n
+// rows of (res, start, end).  Returns "valid 1\n" or "error: ...".
+char* ffsim_check_intervals(const double* triples, int n) {
+  std::vector<Interval> iv;
+  iv.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    iv.push_back({(int)triples[3 * i], triples[3 * i + 1],
+                  triples[3 * i + 2]});
+  }
+  std::string err;
+  if (!check_intervals(iv, err)) {
+    return dup_result("error: schedule inconsistent: " + err);
+  }
+  return dup_result("valid 1\n");
 }
 
 void ffsim_free(char* ptr) { std::free(ptr); }

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional
 _log = logging.getLogger("ff.search")
 
 from flexflow_tpu.graph import FFModel
-from flexflow_tpu.native import ffsim_search, ffsim_simulate
+from flexflow_tpu.native import ffsim_search, ffsim_simulate, ffsim_validate
 from flexflow_tpu.parallel.mesh import MeshPlan
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 from flexflow_tpu.search.cost_model import DeviceModel
@@ -79,6 +79,11 @@ def search_strategy(
         model, plan, device_model, max_candidates, measured_costs=measured_costs
     )
     res = ffsim_search(prob.text, iters, seed, alpha)
+    # Schedule self-check on the winning assignment (the reference's
+    # VERBOSE consistency assertions, ``simulator.cc:1012-1031``): an
+    # inconsistent schedule means the simulator itself is broken, and
+    # a search result must never silently rest on one.
+    ffsim_validate(prob.text, [int(i) for i in res["assign"]])
     table: Dict[str, ParallelConfig] = {}
     for op, cands, idx in zip(prob.ops, prob.candidates, res["assign"]):
         table[op.name] = cands[idx]

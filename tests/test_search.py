@@ -500,3 +500,54 @@ class TestSearchTemperature:
             iters=20_000, seed=0,
         )
         assert res.speedup > 1.03
+
+
+class TestScheduleValidation:
+    """ffsim self-check — the reference's VERBOSE schedule-consistency
+    mode (``simulator.cc:1012-1031``): every compute/comm occupancy
+    recorded and checked for per-resource overlap."""
+
+    def test_valid_schedule_passes(self):
+        from flexflow_tpu.native import ffsim_validate
+
+        p = _problem([
+            "ffsim 1", "ndevices 2", "devices_per_node 2",
+            "bw_intra 10", "bw_inter 1",
+            "nops 2",
+            "op 0 1 producer",
+            "cfg 2 1 1 1 1 5.0 0.0 0 1",
+            "op 1 1 consumer",
+            "cfg 1 2 1 1 1 7.0 0.0 0 1",
+            "nedges 1",
+            "edge 0 1 4 2 8 4 0 -1 -1 1",
+        ])
+        out = ffsim_validate(p, [0, 0])
+        assert out["valid"] == 1
+        # 2 producer shards + 2 consumer shards + 2 cross-device
+        # transfers (each consumer pulls the remote half).
+        assert out["ntasks"] == 6
+        assert out["time_us"] == pytest.approx(16.2)
+
+    def test_search_result_validates(self):
+        res = search_strategy(
+            build_alexnet(batch_size=64, image_size=229, num_classes=1000),
+            num_devices=4, iters=2000, seed=0,
+        )  # search_strategy itself runs ffsim_validate on the winner
+        assert res.best_time_us <= res.dp_time_us
+
+    def test_overlap_detected(self):
+        from flexflow_tpu.native import ffsim_check_intervals
+
+        ffsim_check_intervals([(0, 0.0, 5.0), (0, 5.0, 9.0), (1, 1.0, 2.0)])
+        with pytest.raises(ValueError, match="schedule inconsistent"):
+            ffsim_check_intervals([(0, 0.0, 5.0), (0, 4.0, 9.0)])
+
+    def test_bad_bounds_detected(self):
+        from flexflow_tpu.native import ffsim_check_intervals
+
+        with pytest.raises(ValueError, match="schedule inconsistent"):
+            ffsim_check_intervals([(0, -1.0, 5.0)])
+        with pytest.raises(ValueError, match="schedule inconsistent"):
+            ffsim_check_intervals([(0, 3.0, 2.0)])
+        with pytest.raises(ValueError, match="schedule inconsistent"):
+            ffsim_check_intervals([(0, 0.0, float("inf"))])
