@@ -31,22 +31,28 @@ STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 def list_image_files(root: str) -> List[Tuple[str, int]]:
     """(path, label) pairs; label = sorted class-dir index, or 0 for a
-    flat folder of images."""
+    flat folder of images.  Only non-hidden subdirs that actually
+    contain images count as classes (a stray ``.cache/`` or empty dir
+    must neither hijack flat mode nor shift the label indices)."""
+
+    def images_in(d: str) -> List[str]:
+        return sorted(
+            f for f in os.listdir(d) if f.lower().endswith(IMAGE_EXTS)
+        )
+
     classes = sorted(
         d for d in os.listdir(root)
-        if os.path.isdir(os.path.join(root, d))
+        if not d.startswith(".")
+        and os.path.isdir(os.path.join(root, d))
+        and images_in(os.path.join(root, d))
     )
     out: List[Tuple[str, int]] = []
     if classes:
         for li, cls in enumerate(classes):
             cdir = os.path.join(root, cls)
-            for f in sorted(os.listdir(cdir)):
-                if f.lower().endswith(IMAGE_EXTS):
-                    out.append((os.path.join(cdir, f), li))
+            out.extend((os.path.join(cdir, f), li) for f in images_in(cdir))
     else:
-        for f in sorted(os.listdir(root)):
-            if f.lower().endswith(IMAGE_EXTS):
-                out.append((os.path.join(root, f), 0))
+        out.extend((os.path.join(root, f), 0) for f in images_in(root))
     if not out:
         raise FileNotFoundError(f"no images under {root!r} ({IMAGE_EXTS})")
     return out

@@ -156,10 +156,12 @@ def ffsim_simulate(problem: str, assign) -> float:
 
 def ffsim_validate(problem: str, assign) -> Dict[str, float]:
     """Validating simulate — the reference's VERBOSE schedule-
-    consistency mode (``simulator.cc:1012-1031``): every compute/comm
-    occupancy is recorded and checked for per-resource overlap.
-    Returns ``{"time_us": ..., "ntasks": ...}``; raises ``ValueError``
-    on an inconsistent schedule."""
+    consistency mode (``simulator.cc:1012-1031``): every compute and
+    comm occupancy is recorded and checked for per-resource overlap
+    (sync windows are device-free bumps, not exclusive occupancies —
+    the reference's check covers shard+comm tasks, not the optimizer
+    update).  Returns ``{"time_us": ..., "ntasks": ...}``; raises
+    ``ValueError`` on an inconsistent schedule."""
     lib = load_ffsim()
     arr = (ctypes.c_int * len(assign))(*assign)
     text = _call_returning_text(

@@ -432,8 +432,12 @@ char* ffsim_simulate(const char* problem, const int* assign, int n) {
 }
 
 // Validating simulate (the reference's VERBOSE schedule-consistency
-// mode, simulator.cc:1012-1031): records every compute/comm occupancy
-// and checks non-overlap per resource.  Returns
+// mode, simulator.cc:1012-1031): records every compute and comm
+// occupancy and checks non-overlap per resource.  Sync windows are
+// deliberately NOT intervals: the model treats gradient reduction as
+// a device-free bump, not an exclusive occupancy — the same scope as
+// the reference, whose VERBOSE assertions cover allTasks (shard +
+// comm) and not the optimizer update.  Returns
 // "time_us T\nntasks N\nvalid 1\n" or "error: schedule inconsistent: ...".
 char* ffsim_validate(const char* problem, const int* assign, int n) {
   Problem p;

@@ -157,6 +157,29 @@ class MultiHeadAttention(Op):
     # -- helpers -----------------------------------------------------------
 
     def _project(self, params, x):
+        pc = getattr(self, "_pc", None)
+        if pc is None or pc.c == 1:
+            # One fused (d, 3d) QKV matmul: XLA does not merge the
+            # three separate gemms itself, and one (tokens, d) x
+            # (d, 3d) dot tiles the MXU better than three (tokens, d)
+            # x (d, d) dots.  Params stay separate (checkpoint layout
+            # unchanged); the per-step concat is one cheap weight-
+            # sized copy, and numerics are bit-identical (each output
+            # column contracts only its own weight column either way).
+            w = jnp.concatenate(
+                [params["wq"], params["wk"], params["wv"]], axis=1
+            )
+            qkv = x @ w
+            if self.attrs["use_bias"]:
+                qkv = qkv + jnp.concatenate(
+                    [params["bq"], params["bk"], params["bv"]]
+                )
+            return jnp.split(qkv, 3, axis=-1)
+        # Head-parallel (c-split) strategies keep the three gemms
+        # separate: the fused concat's column interleaving does not
+        # align with the 'c' shard boundaries, so GSPMD would have to
+        # regather the weights every step — exactly the comm the
+        # Megatron-style split exists to avoid.
         q = x @ params["wq"]
         k = x @ params["wk"]
         v = x @ params["wv"]

@@ -1,0 +1,75 @@
+"""Flash-attention block-size sweep on the live TPU.
+
+Round-2 finding (BASELINE.md / memory): the fwd kernel measured
+~14.7 ms at (b16, h8, t2048, hd64) and is NOT MXU-bound (bf16 vs f32
+dots changed <5%) — suspected VPU exp + per-block streaming-softmax
+correction overhead.  Larger blocks amortize the corrections; this
+sweeps FF_FLASH_BLOCK (which pallas_kernels reads at import) in fresh
+subprocesses and times fwd and fwd+bwd with relay-safe fencing
+(jitted loop, one jax.device_get per measurement, <=20 reps).
+
+Usage: python tools/sweep_flash.py [b h t hd]
+"""
+
+import os
+import subprocess
+import sys
+
+BODY = r"""
+import os, sys, time
+import jax, jax.numpy as jnp
+
+b, h, t, hd = (int(x) for x in sys.argv[1:5])
+from flexflow_tpu.ops import pallas_kernels as pk
+
+shape = (b, h, t, hd)
+if not pk.flash_supported(shape, jnp.bfloat16):
+    print(f"block {os.environ.get('FF_FLASH_BLOCK')}: unsupported at {shape}")
+    sys.exit(0)
+key = jax.random.PRNGKey(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
+           for i in range(3))
+
+fwd = jax.jit(lambda q, k, v: pk.flash_attention(q, k, v, True))
+
+def loss(q, k, v):
+    return jnp.sum(pk.flash_attention(q, k, v, True).astype(jnp.float32))
+
+bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+def timeit(fn, reps=10):
+    out = fn(q, k, v)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])  # compile+warm fence
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(q, k, v)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / reps * 1e3
+
+fwd_ms = timeit(fwd)
+bwd_ms = timeit(bwd)
+flops = 4.0 * b * h * t * t * hd / 2  # causal fwd
+print(f"block {os.environ.get('FF_FLASH_BLOCK', '128'):>4s}: "
+      f"fwd {fwd_ms:7.2f} ms ({flops / (fwd_ms * 1e-3) / 1.97e14 * 100:4.1f}% "
+      f"of bf16 peak)  fwd+bwd {bwd_ms:7.2f} ms")
+"""
+
+
+def main():
+    shape = sys.argv[1:5] or ["16", "8", "2048", "64"]
+    print(f"flash sweep at (b,h,t,hd)={tuple(int(x) for x in shape)}")
+    for block in ("128", "256", "512", "1024"):
+        env = dict(os.environ, FF_FLASH_BLOCK=block)
+        # NO timeout: killing a child mid-TPU-claim wedges the relay
+        # tunnel for hours (CLAUDE.md environment hazards).  A wedged
+        # config must be waited out or the whole sweep abandoned.
+        proc = subprocess.run(
+            [sys.executable, "-c", BODY, *shape],
+            env=env, capture_output=True, text=True,
+        )
+        out = proc.stdout.strip() or proc.stderr.strip()[-300:]
+        print(out)
+
+
+if __name__ == "__main__":
+    main()
