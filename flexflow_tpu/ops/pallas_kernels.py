@@ -118,35 +118,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                       # (bq, bk) f32
-        if causal:
-            k_pos = kb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+    def make_body(masked):
+        def body(kb, carry):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                   # (bq, bk) f32
+            if masked:
+                k_pos = kb * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            acc = acc * corr + lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        acc = acc * corr + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        return m_new, l, acc
+            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            return m_new, l, acc
+
+        return body
 
     if causal:
-        # Blocks strictly above the diagonal contribute nothing.
+        # The streaming loop splits at the diagonal: blocks fully
+        # below it need no mask (skipping the per-block iota/compare/
+        # select — pure VPU overhead on every interior block), the
+        # 1-2 diagonal-straddling blocks run masked, and blocks
+        # strictly above contribute nothing.
+        full_upper = lax.div(qi * block_q, block_k)
         upper = lax.div((qi + 1) * block_q + block_k - 1, block_k)
         upper = jnp.minimum(upper, num_kb)
+        carry = lax.fori_loop(0, full_upper, make_body(False), (m0, l0, acc0))
+        m, l, acc = lax.fori_loop(full_upper, upper, make_body(True), carry)
     else:
-        upper = num_kb
-    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        m, l, acc = lax.fori_loop(0, num_kb, make_body(False), (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # lse is stored with a trailing lane dim of LSE_LANES (broadcast
     # copies) so its blocks satisfy the TPU (8, 128)-or-full tile rule.
@@ -170,33 +180,43 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     num_kb = seq_k // block_k
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            k_pos = kb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+    def make_body(masked):
+        def body(kb, dq):
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if masked:
+                k_pos = kb * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse)                        # (bq, bk) f32
+            dp = lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                            # (bq, bk) f32
-        dp = lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)
-        return dq + lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            ds = p * (dp - delta)
+            return dq + lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
+        return body
+
+    dq0 = jnp.zeros((block_q, hd), jnp.float32)
     if causal:
+        # Unmasked below-diagonal blocks, masked diagonal straddlers
+        # (same split as the forward kernel).
+        full_upper = lax.div(qi * block_q, block_k)
         upper = lax.div((qi + 1) * block_q + block_k - 1, block_k)
         upper = jnp.minimum(upper, num_kb)
+        dq = lax.fori_loop(0, full_upper, make_body(False), dq0)
+        dq = lax.fori_loop(full_upper, upper, make_body(True), dq)
     else:
-        upper = num_kb
-    dq = lax.fori_loop(0, upper, body, jnp.zeros((block_q, hd), jnp.float32))
+        dq = lax.fori_loop(0, num_kb, make_body(False), dq0)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -210,44 +230,57 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_qb = seq_q // block_q
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0:1]
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                       # (bq, bk)
-        if causal:
-            q_pos = qb * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv = carry
+            q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+            do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+            lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+            delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                   # (bq, bk)
+            if masked:
+                q_pos = qb * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse)
+            dv = dv + lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        dv = dv + lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)                           # (bq, bk)
-        dk = dk + lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk, dv
+            dp = lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)                       # (bq, bk)
+            dk = dk + lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk, dv
 
-    if causal:
-        # Query blocks entirely above this K block see none of it.
-        lower = lax.div(ki * block_k, block_q)
-    else:
-        lower = 0
-    dk, dv = lax.fori_loop(
-        lower, num_qb, body,
-        (jnp.zeros((block_k, hd), jnp.float32), jnp.zeros((block_k, hd), jnp.float32)),
+        return body
+
+    zeros = (
+        jnp.zeros((block_k, hd), jnp.float32),
+        jnp.zeros((block_k, hd), jnp.float32),
     )
+    if causal:
+        # Query blocks entirely above this K block see none of it;
+        # blocks straddling the diagonal run masked; blocks fully
+        # below the diagonal need no mask.
+        lower = lax.div(ki * block_k, block_q)
+        first_full = lax.div(
+            (ki + 1) * block_k + block_q - 2, block_q
+        )
+        first_full = jnp.clip(first_full, lower, num_qb)
+        carry = lax.fori_loop(lower, first_full, make_body(True), zeros)
+        dk, dv = lax.fori_loop(first_full, num_qb, make_body(False), carry)
+    else:
+        dk, dv = lax.fori_loop(0, num_qb, make_body(False), zeros)
     # ds·q still needs the ∂s/∂k = scale·q factor (q is no longer
     # pre-scaled; s scales post-dot).
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
