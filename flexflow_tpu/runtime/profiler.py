@@ -21,7 +21,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
@@ -150,41 +150,63 @@ def _synth(shape, dtype, key):
     return jax.random.normal(key, shape, dtype) * 0.02
 
 
+def _perturbed(tree, eps):
+    """Add a carry-derived epsilon to the first float leaf — defeats
+    CSE/LICM across fori_loop iterations without measurable cost."""
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(tree)
+    done = False
+    out = []
+    for leaf in leaves:
+        if not done and jnp.issubdtype(leaf.dtype, jnp.floating):
+            out.append(leaf + eps.astype(leaf.dtype))
+            done = True
+        else:
+            out.append(leaf)
+    return jax.tree.unflatten(treedef, out), done
+
+
+def _two_point_time(make, args, loops, reps):
+    """Run ``make(n)(*args)`` at two loop counts; the per-iteration
+    slope cancels dispatch + fence overhead — the ~16 ms/call relay
+    floor that makes single-shot eager timing meaningless (the
+    reference's analogue concern: cudaEvent pairs around repeated
+    kernel launches, ``scripts/cnn.h:231-246``).  Two dispatches per
+    measurement, each fenced by host readback, so the relay chain
+    stays short."""
+    lo, hi = loops
+    times = {}
+    for n in (lo, hi):
+        fn = make(n)
+        jax.device_get(fn(*args))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.device_get(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    return max((times[hi] - times[lo]) / (hi - lo) * 1e6, 1e-3)
+
+
 def _time_shard_forward(op, p, xs, s, loops=(4, 20), reps=2):
     """Per-iteration forward time (us) of one op at fixed shapes.
 
     Relay-proof protocol: the op runs ``n`` serially-dependent times
     inside ONE jitted ``fori_loop`` call (a tiny carry-derived
-    perturbation defeats CSE), at two loop counts; the difference
-    cancels dispatch + fence overhead — the ~16 ms/call relay floor
-    that makes single-shot eager timing meaningless (the reference's
-    analogue concern: cudaEvent pairs around repeated kernel launches,
-    ``scripts/cnn.h:231-246``).  Two dispatches per measurement, each
-    fenced by host readback, so the relay chain stays short.
+    perturbation defeats CSE), at two loop counts (``_two_point_time``).
     """
     import jax.numpy as jnp
     from jax import lax
-
-    def perturbed(tree, eps):
-        leaves, treedef = jax.tree.flatten(tree)
-        done = False
-        out = []
-        for leaf in leaves:
-            if not done and jnp.issubdtype(leaf.dtype, jnp.floating):
-                out.append(leaf + eps.astype(leaf.dtype))
-                done = True
-            else:
-                out.append(leaf)
-        return jax.tree.unflatten(treedef, out), done
 
     def make(n):
         def run(p, xs, s):
             def body(i, acc):
                 eps = acc * jnp.float32(1e-30)
-                xs2, ok = perturbed(list(xs), eps)
+                xs2, ok = _perturbed(list(xs), eps)
                 p2 = p
                 if not ok:
-                    p2, _ = perturbed(p, eps)
+                    p2, _ = _perturbed(p, eps)
                 result, _ = op.forward(p2, xs2, s, False)
                 ys = result[2] if op.is_loss else result
                 first = jax.tree.leaves(ys)[0]
@@ -194,18 +216,67 @@ def _time_shard_forward(op, p, xs, s, loops=(4, 20), reps=2):
 
         return jax.jit(run)
 
-    lo, hi = loops
-    times = {}
-    for n in (lo, hi):
-        fn = make(n)
-        jax.device_get(fn(p, xs, s))  # compile + warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.device_get(fn(p, xs, s))
-            best = min(best, time.perf_counter() - t0)
-        times[n] = best
-    return max((times[hi] - times[lo]) / (hi - lo) * 1e6, 1e-3)
+    return _two_point_time(make, (p, xs, s), loops, reps)
+
+
+def _time_shard_fwd_bwd(op, p, xs, s, loops=(4, 20), reps=2):
+    """Measured (fwd_us, bwd_us) of one op at fixed shard-local shapes.
+
+    The reference measures forward AND both backward legs per config —
+    ``measure_conv2d_time`` returns ``t1+t2+t3`` (fwd + bwd-filter +
+    bwd-data, ``scripts/cnn.h:252-277``) — so backward cost structure
+    that differs from forward (spatial conv bwd-data halos, embedding
+    scatter, flash bwd's two kernels) is *measured*, not assumed.
+    Here: time the forward loop, then a ``jax.vjp`` fwd+bwd loop
+    (cotangent of ones ≙ the reference's unit upstream grad, gradients
+    w.r.t. params and float inputs ≙ bwd-filter + bwd-data); the
+    difference is the backward time.  Loss ops differentiate
+    ``(loss, ys)`` jointly — grad of the scalar loss alone would let
+    XLA dead-code-eliminate the main-output backward of non-terminal
+    loss ops (MoE's aux loss vs its expert FFNs).  Same two-point
+    relay-proof protocol.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    fwd_us = _time_shard_forward(op, p, xs, s, loops=loops, reps=reps)
+
+    float_ix = [
+        i for i, x in enumerate(xs)
+        if jnp.issubdtype(jnp.dtype(x.dtype), jnp.floating)
+    ]
+
+    def make(n):
+        def run(p, xs, s):
+            def body(i, acc):
+                eps = acc * jnp.float32(1e-30)
+                p2, okp = _perturbed(p, eps)
+                fxs = [xs[j] for j in float_ix]
+                if not okp:
+                    fxs, _ = _perturbed(fxs, eps)
+
+                def fwd_fn(p3, fxs3):
+                    xs2 = list(xs)
+                    for k, j in enumerate(float_ix):
+                        xs2[j] = fxs3[k]
+                    result, _ = op.forward(p3, xs2, s, False)
+                    return (result[0], result[2]) if op.is_loss else result
+
+                y, vjp = jax.vjp(fwd_fn, p2, fxs)
+                grads = vjp(jax.tree.map(jnp.ones_like, y))
+                leaves = [
+                    g for g in jax.tree.leaves(grads)
+                    if jnp.issubdtype(g.dtype, jnp.floating)
+                ]
+                first = leaves[0] if leaves else jnp.float32(0.0)
+                return acc + first.ravel()[0].astype(jnp.float32) * 1e-30
+
+            return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+        return jax.jit(run)
+
+    total_us = _two_point_time(make, (p, xs, s), loops, reps)
+    return fwd_us, max(total_us - fwd_us, 0.0)
 
 
 def measured_degree_table(
@@ -215,21 +286,27 @@ def measured_degree_table(
     loops=(4, 20),
     measure=None,
     seed: int = 0,
-) -> Dict[str, Dict[tuple, float]]:
+) -> Dict[str, Dict[tuple, Tuple[float, float]]]:
     """Measure every (op, parallel-degree) candidate live — the
     reference's ``computeTime[]`` cache filled by per-config cuDNN
     microbenchmarks (``scripts/cnn.h:204-260``, ``simulator.cc:
-    142-151``).  Returns ``{op name: {(n,c,h,w,s): per-shard fwd us}}``
-    for ``search_strategy(measured_costs=...)``; per-shard times come
-    from running the shard's LOCAL shapes on one device, so nonlinear
-    scaling (MXU under-utilization at small tiles, fixed overheads)
-    is captured instead of the old measured/parts linear assumption.
+    142-151``).  Returns ``{op name: {(n,c,h,w,s): (fwd us, bwd us)}}``
+    for ``search_strategy(measured_costs=...)`` — both legs measured
+    per config like the reference's ``t1+t2+t3`` (fwd + bwd-filter +
+    bwd-data, ``scripts/cnn.h:252-277``), so no fwd×factor assumption
+    survives in the measured path.  Per-shard times come from running
+    the shard's LOCAL shapes on one device, so nonlinear scaling (MXU
+    under-utilization at small tiles, fixed overheads, asymmetric
+    backward) is captured instead of the old measured/parts linear
+    assumption.
 
     Structurally identical shards (same op type, attrs and local
     shapes — e.g. repeated Inception blocks, or a (n=2,c=1) shard
     equal to a (n=2,c=1,h=1...) one) are measured once via a shape
-    cache.  ``measure(op, pc, p, xs, s) -> us`` is injectable (tests,
-    alternative timers); ops whose forward cannot run at sliced shapes
+    cache.  ``measure(op, pc, p, xs, s) -> us | (fwd_us, bwd_us)`` is
+    injectable (tests, alternative timers; a bare float is treated as
+    fwd-only and scaled by the legacy ×``FWD_BWD_FACTOR`` downstream);
+    ops whose forward cannot run at sliced shapes
     (static-shape reshapes) are skipped — the search falls back to the
     roofline for them.
     """
@@ -240,11 +317,11 @@ def measured_degree_table(
     vplan = build_virtual_plan(num_devices)
     plan1 = build_mesh_plan(1)
     key = jax.random.PRNGKey(seed)
-    cache: Dict[tuple, float] = {}
-    table: Dict[str, Dict[tuple, float]] = {}
+    cache: Dict[tuple, Tuple[float, float]] = {}
+    table: Dict[str, Dict[tuple, Tuple[float, float]]] = {}
     for op in model.layers:
         op.bind_mesh(plan1, ParallelConfig())
-        entries: Dict[tuple, float] = {}
+        entries: Dict[tuple, Tuple[float, float]] = {}
         for pc in enumerate_candidates(op, vplan, max_candidates):
             degs = tuple(pc.degree(a) for a in AXES)
             if degs in entries:
@@ -276,7 +353,7 @@ def measured_degree_table(
                 if measure is not None:
                     us = measure(op, pc, p, xs, s)
                 else:
-                    us = _time_shard_forward(op, p, xs, s, loops=loops)
+                    us = _time_shard_fwd_bwd(op, p, xs, s, loops=loops)
             except Exception as e:
                 _log_measure_skip(op, pc, e)
                 continue

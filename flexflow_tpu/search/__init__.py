@@ -71,9 +71,12 @@ def search_strategy(
     """MCMC-search a per-op strategy table for ``model`` on
     ``num_devices`` devices.  Runs entirely offline (no TPU needed).
 
-    ``measured_costs``: per-op measured forward times from
-    ``flexflow_tpu.runtime.profiler.measured_cost_table`` replace the
-    roofline compute estimates (measured-microbenchmark mode)."""
+    ``measured_costs``: measured per-op costs replace the roofline
+    compute estimates (measured-microbenchmark mode).  Preferred
+    format: ``runtime.profiler.measured_degree_table``'s per-(op,
+    degree) ``(fwd_us, bwd_us)`` tuples — both legs measured; legacy
+    fwd-only floats (``measured_cost_table``) are scaled by
+    ``FWD_BWD_FACTOR``.  See ``build_problem`` for mode logging."""
     plan = build_virtual_plan(num_devices)
     prob = build_problem(
         model, plan, device_model, max_candidates, measured_costs=measured_costs

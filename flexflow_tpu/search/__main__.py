@@ -80,8 +80,8 @@ def main(argv=None):
         table = measured_degree_table(model, num_devices=args.devices)
         n_cfg = sum(len(v) for v in table.values())
         print(
-            f"measured {len(table)} op costs on {jax.default_backend()} "
-            f"({n_cfg} (op, degree) configs)"
+            f"measured {len(table)} op costs (fwd+bwd) on "
+            f"{jax.default_backend()} ({n_cfg} (op, degree) configs)"
         )
         measured = table
     res = search_strategy(

@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -175,21 +175,28 @@ def build_problem(
     plan: MeshPlan,
     dev: Optional[DeviceModel] = None,
     max_candidates: int = 64,
-    measured_costs: Optional[Dict[str, float]] = None,
+    measured_costs: Optional[Dict[str, Any]] = None,
 ) -> SearchProblem:
     """``measured_costs`` overrides the roofline compute estimate per
     op — the reference's measured-microbenchmark mode
-    (``simulator.cc:1420-1440``).  Two formats per op name:
+    (``simulator.cc:1420-1440``).  Three formats per op name:
 
-    - ``{(n,c,h,w,s): per-shard fwd us}`` from
+    - ``{(n,c,h,w,s): (fwd us, bwd us)}`` from
       ``runtime.profiler.measured_degree_table`` — per-(op, degree)
-      live measurements, the reference's ``computeTime[config]`` cache
-      (``scripts/cnn.h:204-260``); candidates with no entry fall back
-      to the roofline.
+      live measurements of BOTH legs, the reference's
+      ``computeTime[config]`` cache filled by fwd+bwd microbenchmarks
+      (``scripts/cnn.h:204-277`` returns ``t1+t2+t3``); used directly,
+      no fwd×factor assumption.  Candidates with no entry fall back to
+      the roofline.
+    - ``{(n,c,h,w,s): fwd us}`` (legacy fwd-only per-degree): scaled
+      by ``FWD_BWD_FACTOR``.
     - a float (legacy ``measured_cost_table``): whole-op time scaled
       by the linear ``/num_parts`` assumption.
 
-    Comm and sync stay model-derived."""
+    A summary of which mode each op actually got is logged on
+    ``ff.search`` (WARNING when any legacy assumption is in play) so
+    callers can tell a fully-measured search from a partly-assumed
+    one.  Comm and sync stay model-derived."""
     dev = dev or DeviceModel()
     measured_costs = measured_costs or {}
     ops = list(model.layers)
@@ -203,6 +210,7 @@ def build_problem(
         f"nops {len(ops)}",
     ]
     candidates: List[List[ParallelConfig]] = []
+    mode_ops: Dict[str, List[str]] = {}
     for i, op in enumerate(ops):
         cands = enumerate_candidates(op, plan, max_candidates)
         candidates.append(cands)
@@ -210,18 +218,26 @@ def build_problem(
         name = op.name.replace(" ", "_")
         lines.append(f"op {i} {len(cands)} {name}")
         measured = measured_costs.get(op.name)
+        cand_modes: Dict[str, int] = {}
         for pc in cands:
             degrees = {a: pc.degree(a) for a in AXES}
             m_us: Optional[float] = None
+            mode = "roofline"
             if isinstance(measured, dict):
                 m = measured.get(tuple(pc.degree(a) for a in AXES))
-                if m is not None:
+                if isinstance(m, (tuple, list)):
+                    m_us = dev.task_overhead_us + float(m[0]) + float(m[1])
+                    mode = "measured fwd+bwd"
+                elif m is not None:
                     m_us = dev.task_overhead_us + m * FWD_BWD_FACTOR
+                    mode = "legacy fwd-only x%.1f" % FWD_BWD_FACTOR
             elif measured is not None:
                 m_us = (
                     dev.task_overhead_us
                     + measured * FWD_BWD_FACTOR / pc.num_parts
                 )
+                mode = "legacy whole-op /parts"
+            cand_modes[mode] = cand_modes.get(mode, 0) + 1
             c_us = (
                 m_us if m_us is not None
                 else shard_cost_us(cost, pc.num_parts, dev)
@@ -231,6 +247,34 @@ def build_problem(
             degs = " ".join(str(pc.degree(a)) for a in AXES)
             devs_s = " ".join(map(str, devs))
             lines.append(f"cfg {degs} {c_us:.4f} {s_us:.4f} {devs_s}")
+        if len(cand_modes) == 1:
+            op_mode = next(iter(cand_modes))
+        else:  # per-candidate fallbacks: report the split, not a winner
+            total = sum(cand_modes.values())
+            op_mode = "mixed (" + ", ".join(
+                f"{m} {c}/{total}" for m, c in sorted(cand_modes.items())
+            ) + ")"
+        mode_ops.setdefault(op_mode, []).append(op.name)
+    if measured_costs:
+        import logging
+
+        log = logging.getLogger("ff.search")
+        summary = ", ".join(
+            f"{mode}: {len(names)} ops" for mode, names in mode_ops.items()
+        )
+        assumed = [
+            m for m in mode_ops
+            if m.startswith("legacy") or m.startswith("mixed")
+        ]
+        if assumed:
+            log.warning(
+                "measured search cost modes — %s; non-'measured fwd+bwd' "
+                "modes keep a fwd-derived backward or roofline assumption "
+                "(%s)", summary,
+                ", ".join(f"{m}: {mode_ops[m][:4]}" for m in assumed),
+            )
+        else:
+            log.info("measured search cost modes — %s", summary)
     edges: List[str] = []
     for j, op in enumerate(ops):
         contracted = set(contracted_input_dims(op))

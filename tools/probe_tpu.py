@@ -1,0 +1,65 @@
+"""TPU tunnel health probe with a persistent, committable log.
+
+The axon relay wedges for hours at a time (every ``jax.devices()`` in
+a fresh process hangs); the only safe check is a subprocess under a
+hard timeout.  Each probe appends one line to
+``MEASURED_r4/probe_log.txt`` so the round's artifact trail shows
+exactly when the tunnel was up — or that it never was (VERDICT r3
+item 1: the evidence that measurement couldn't happen is itself the
+artifact).
+
+Usage: ``python tools/probe_tpu.py [--timeout 150]``
+Exit code 0 = TPU reachable, 1 = not.
+"""
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "MEASURED_r4", "probe_log.txt")
+
+
+def probe(timeout_s: float) -> tuple:
+    """(ok, detail) — runs jax.devices() in a throwaway subprocess."""
+    code = (
+        "import jax; d = jax.devices(); "
+        "print('PLATFORM=' + jax.default_backend(), len(d))"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"timeout after {timeout_s:.0f}s (backend hang)"
+    dt = time.time() - t0
+    if out.returncode == 0 and "PLATFORM=" in out.stdout:
+        fields = out.stdout.split("PLATFORM=")[1].split()
+        if fields[0] != "cpu":
+            return True, f"{fields[0]} x{fields[1]} in {dt:.1f}s"
+        return False, f"probe fell back to cpu in {dt:.1f}s"
+    return False, f"rc={out.returncode}: {out.stderr.strip()[-200:]}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=float, default=150.0)
+    args = ap.parse_args(argv)
+    ok, detail = probe(args.timeout)
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {'UP' if ok else 'DOWN'} {detail}\n")
+    print(f"{stamp} {'UP' if ok else 'DOWN'} {detail}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
