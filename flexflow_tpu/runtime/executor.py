@@ -369,10 +369,10 @@ class Executor:
         if frm_spec is None:
             return x
         to_spec = self.plan.spec(self._pc(op), t.dim_axes, t.shape)
-        hops = self.plan.reshard_hops(frm_spec, to_spec, len(t.shape))
-        if not hops:
-            return x  # GSPMD handles pure add/drop transitions itself
-        for spec in hops + [to_spec]:
+        # Full chain ending with `to_spec` when a mover decomposition
+        # exists; [] for pure add/drop (GSPMD's own single collective)
+        # or undecomposable transitions (warned on ff.mesh).
+        for spec in self.plan.reshard_hops(frm_spec, to_spec, len(t.shape)):
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(self.plan.mesh, spec)
             )

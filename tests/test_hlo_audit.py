@@ -1,0 +1,129 @@
+"""Post-SPMD collective audit of the compiled train step.
+
+Turns round-3's "no remat warnings" into "provably no
+replicate-then-slice": parse the optimized HLO of the real jitted
+train step and assert no all-gather materializes a full
+(unsharded-size) activation on the spatial, table-parallel and hybrid
+graphs (VERDICT r3 item 4; the property the reference gets from
+explicit halo/repartition copies, ``src/ops/conv_2d.cu:177-209``).
+"""
+
+import jax
+import pytest
+
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.runtime.audit import (
+    Collective,
+    collective_stats,
+    count_collectives,
+    full_activation_allgathers,
+)
+from flexflow_tpu.runtime.executor import Executor
+
+
+def _audit(ff, store):
+    ex = Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.1),
+                  devices=jax.devices()[:8])
+    hlo = ex.lower_train_step().compile().as_text()
+    return ex, hlo
+
+
+class TestParser:
+    def test_extracts_collectives_and_sizes(self):
+        hlo = """
+  %all-gather.3 = f32[16,128]{1,0} all-gather(%p0), replica_groups=...
+  %all-to-all.1 = bf16[4,32]{1,0} all-to-all(%x), dimensions={0}
+  %collective-permute.2 = f32[8]{0} collective-permute(%y)
+  %ar = (f32[64]{0}, f32[2,2]{1,0}) all-reduce(%a, %b), to_apply=%sum
+  %ags = (f32[4,8]{1,0}, f32[32,8]{1,0}) all-gather-start(%z), dimensions={0}
+  %agd = f32[32,8]{1,0} all-gather-done(%ags)
+  %add.5 = f32[16,128]{1,0} add(%u, %v)
+"""
+        stats = collective_stats(hlo)
+        # Async pairs: the -start carries the transfer (counted, at
+        # its gathered output size); the -done only unpacks.
+        assert [c.opcode for c in stats] == [
+            "all-gather", "all-to-all", "collective-permute",
+            "all-reduce", "all-gather",
+        ]
+        assert stats[0].elements == 16 * 128
+        assert stats[3].elements == 64  # largest tuple member
+        assert stats[4].elements == 32 * 8
+        assert count_collectives(hlo) == {
+            "all-gather": 2, "all-to-all": 1,
+            "collective-permute": 1, "all-reduce": 1,
+        }
+
+    def test_flags_full_size_allgather(self):
+        class FakePC:
+            num_parts = 8
+
+        class FakeT:
+            name = "conv:out"
+            shape = (16, 128)
+
+        class FakeOp:
+            outputs = [FakeT()]
+
+        class FakeModel:
+            layers = [FakeOp()]
+
+        class FakeEx:
+            model = FakeModel()
+
+            def _pc(self, op):
+                return FakePC()
+
+        hlo = "%all-gather.1 = f32[16,128]{1,0} all-gather(%x)\n"
+        bad = full_activation_allgathers(FakeEx(), hlo)
+        assert len(bad) == 1 and bad[0].elements == 2048
+
+
+class TestCompiledStep:
+    def test_spatial_and_table_boundaries_no_full_allgather(self):
+        """The spatial conv -> DP dense and table-parallel -> DP
+        boundaries (the graphs whose clean dryrun round 3 established)
+        compile to subgroup collectives only — no all-gather of a
+        full sharded activation."""
+        from tests.test_reshard import _boundary_model
+
+        ff, store = _boundary_model()
+        ex, hlo = _audit(ff, store)
+        assert full_activation_allgathers(ex, hlo) == []
+        # The decomposed spatial boundary rides point-to-point /
+        # subgroup collectives; make sure they are actually present
+        # (an empty graph would also "pass" the assert above).
+        counts = count_collectives(hlo)
+        assert counts.get("all-reduce", 0) >= 1  # grad sync
+        assert sum(counts.values()) >= 3
+
+    def test_hybrid_tp_dp_no_full_allgather(self):
+        """A TP(c) dense feeding a DP dense — the vocab-parallel ->
+        DP boundary whose direct GSPMD transition full-remats
+        (tests/test_reshard.py::test_hops_avoid_remat_gspmd_would_do)
+        — compiles remat-free AND all-gathers nothing of full
+        activation size through the executor's hop path."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+        from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+        ff = FFModel(FFConfig(batch_size=16))
+        x = ff.create_tensor((16, 64), name="x")
+        lbl = ff.create_tensor((16,), dtype=jnp.int32, name="label")
+        t = ff.dense(x, 128, activation="relu", name="fc1")
+        t = ff.dense(t, 64, activation="relu", name="fc2")
+        t = ff.dense(t, 8, name="head")
+        ff.softmax(t, lbl, name="softmax")
+        store = StrategyStore(8)
+        store.set("fc1", ParallelConfig(c=8))
+        store.set("fc2", ParallelConfig(n=4, c=2))
+        # head/softmax default to DP.
+        ex, hlo = _audit(ff, store)
+        # Presence guard: an audit that parsed nothing would pass
+        # vacuously (e.g. async `-start` lowering variants).
+        counts = count_collectives(hlo)
+        assert sum(counts.values()) >= 2, counts
+        assert full_activation_allgathers(ex, hlo) == []

@@ -45,11 +45,15 @@ def test_no_hops_for_pure_add_or_drop(plan):
 
 def test_spatial_collapse_hops(plan):
     # conv/pool spatial (n,h,w) -> flat DP: h/w axes move onto the
-    # sample dim, one all-to-all chunk per source dim.
+    # sample dim, one all-to-all chunk per source dim; the chain ends
+    # with the target spec itself (the caller applies exactly this).
     hops = plan.reshard_hops(
         P("x0", "x1", "x2", None), P(("x0", "x1", "x2"), None, None, None), 4
     )
-    assert hops == [P(("x0", "x1"), None, "x2", None)]
+    assert hops == [
+        P(("x0", "x1"), None, "x2", None),
+        P(("x0", "x1", "x2"), None, None, None),
+    ]
 
 
 def test_table_parallel_to_dp_hops(plan):
@@ -58,27 +62,53 @@ def test_table_parallel_to_dp_hops(plan):
     hops = plan.reshard_hops(
         P(None, ("x1", "x2"), None), P(("x0", "x1", "x2"), None, None), 3
     )
-    assert hops == [P("x0", ("x1", "x2"), None)]
+    assert hops == [
+        P("x0", ("x1", "x2"), None),
+        P(("x0", "x1", "x2"), None, None),
+    ]
 
 
 def test_reverse_direction_hops(plan):
-    # The backward-pass direction of the table-parallel boundary.
+    # The backward-pass direction of the table-parallel boundary; the
+    # final `to` spec performs the x0 drop (subgroup all-gather).
     hops = plan.reshard_hops(
         P(("x0", "x1", "x2"), None, None), P(None, ("x1", "x2"), None), 3
     )
-    assert hops == [P("x0", ("x1", "x2"), None)]
+    assert hops == [
+        P("x0", ("x1", "x2"), None),
+        P(None, ("x1", "x2"), None),
+    ]
 
 
-def test_non_minor_insert_declines(plan):
+def test_single_move_returns_terminating_spec(plan):
+    # A transition that is exactly one axis move must return [to]
+    # (ADVICE r3: the old contract popped it and callers then applied
+    # no constraint at all).
+    hops = plan.reshard_hops(P("x0", "x1", None), P(("x0", "x1"), None, None), 3)
+    assert hops == [P(("x0", "x1"), None, None)]
+
+
+def test_non_minor_insert_declines_and_warns(plan, caplog):
     # x2 moves dims (so decomposition is attempted), but adding x0
     # under the existing x1 chain would not be a local slice; the
-    # decomposition must decline rather than emit a bogus hop.
-    assert (
-        plan.reshard_hops(
-            P("x1", "x2", None), P(("x0", "x1"), None, "x2"), 3
+    # decomposition must decline rather than emit a bogus hop — and
+    # must say so (VERDICT r3 item 5: the fallback used to be silent).
+    import logging
+
+    plan.__dict__.pop("_undecomposable_seen", None)  # per-plan seen set
+    with caplog.at_level(logging.WARNING, logger="ff.mesh"):
+        assert (
+            plan.reshard_hops(
+                P("x1", "x2", None), P(("x0", "x1"), None, "x2"), 3
+            )
+            == []
         )
-        == []
-    )
+    assert any("cannot decompose" in r.message for r in caplog.records)
+    # Once per transition: a repeat does not re-log.
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="ff.mesh"):
+        plan.reshard_hops(P("x1", "x2", None), P(("x0", "x1"), None, "x2"), 3)
+    assert not caplog.records
 
 
 def _boundary_model(batch=8):
@@ -138,12 +168,94 @@ def test_boundary_numerics_match_dp(rng):
     )
 
 
-_REMAT_PROBE = r"""
+# Shared preamble for subprocess compile probes: the GSPMD remat
+# warning comes from XLA's C++ logging, so probes compile in a fresh
+# CPU-forced process and the tests grep its stderr.
+_PROBE_PREAMBLE = r"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+"""
+
+
+def _run_probe(body: str, *argv: str):
+    """Compile ``body`` (appended to the CPU-forcing preamble) in a
+    subprocess; returns True iff GSPMD logged an involuntary full
+    rematerialization.  ``body`` must print COMPILED on success."""
+    out = subprocess.run(
+        [sys.executable, "-c", _PROBE_PREAMBLE + body, *argv],
+        capture_output=True,
+        text=True,
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+        timeout=300,
+    )
+    assert "COMPILED" in out.stdout, out.stderr[-2000:]
+    return "Involuntary full rematerialization" in out.stderr
+
+
+_TRANSITION_PROBE = r"""
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from flexflow_tpu.parallel.mesh import build_mesh_plan
+
+plan = build_mesh_plan(8)
+frm, to = eval(sys.argv[1]), eval(sys.argv[2])
+use_hops = sys.argv[3] == "hops"
+chain = plan.reshard_hops(frm, to, max(len(frm), len(to))) if use_hops else [to]
+assert chain, "expected a decomposition"
+
+def f(x):
+    x = jax.lax.with_sharding_constraint(x, NamedSharding(plan.mesh, frm))
+    x = x * 2.0
+    for spec in chain:
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(plan.mesh, spec))
+    return x
+
+nd = max(len(frm), len(to))
+jax.jit(f).lower(jnp.zeros((8,) * nd, jnp.float32)).compile()
+print("COMPILED")
+"""
+
+
+def _compile_transition(frm: str, to: str, mode: str):
+    return _run_probe(_TRANSITION_PROBE, frm, to, mode)
+
+
+def test_hops_avoid_remat_gspmd_would_do():
+    """The mechanism's value, pinned end to end: a TP-output ->
+    hybrid-DP boundary (axes move dims AND an axis drops — the
+    vocab-parallel dense -> DP transition) is full-rematerialized by
+    GSPMD when constrained directly, and is NOT when walked through
+    ``reshard_hops``' chain on the identical mesh."""
+    frm, to = 'P(None, ("x0", "x1", "x2"))', 'P(("x0", "x1"), None)'
+    assert _compile_transition(frm, to, "direct"), (
+        "GSPMD now reshards this directly without remat; "
+        "reshard_hops may no longer be needed for this shape"
+    )
+    assert not _compile_transition(frm, to, "hops")
+
+
+def test_declined_transitions_do_not_remat_today():
+    """Documents what GSPMD does on transitions ``reshard_hops``
+    DECLINES (and now warns about): on current XLA these compile
+    without the involuntary-full-remat fallback, so the decline is
+    conservative but not a performance hole.  If this ever starts
+    failing, GSPMD regressed on these shapes and the decomposition
+    should be extended to cover them."""
+    declined = [
+        # non-minor-most insert (x0 under x1's chain)
+        ('P("x1", "x2", None)', 'P(("x0", "x1"), None, "x2")'),
+        # non-suffix drop (x0 dropped from under x1) with a mover
+        ('P(("x0", "x1"), "x2", None)', 'P("x1", None, "x2")'),
+    ]
+    for frm, to in declined:
+        assert not _compile_transition(frm, to, "direct"), (frm, to)
+
+
+_REMAT_PROBE = r"""
 from tests.test_reshard import _boundary_model
 from flexflow_tpu.optim import SGDOptimizer
 from flexflow_tpu.runtime.executor import Executor
@@ -158,17 +270,5 @@ print("COMPILED")
 
 def test_no_involuntary_full_remat():
     """The spatial->DP and table-parallel->DP boundaries compile
-    without any GSPMD involuntary-full-rematerialization fallback.
-    The warning is emitted by XLA's C++ logging, so the compile runs
-    in a subprocess and the test greps its stderr."""
-    out = subprocess.run(
-        [sys.executable, "-c", _REMAT_PROBE],
-        capture_output=True,
-        text=True,
-        cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
-        timeout=300,
-    )
-    assert "COMPILED" in out.stdout, out.stderr[-2000:]
-    assert "Involuntary full rematerialization" not in out.stderr, (
-        out.stderr[-3000:]
-    )
+    without any GSPMD involuntary-full-rematerialization fallback."""
+    assert not _run_probe(_REMAT_PROBE)
