@@ -42,19 +42,21 @@ def _interpret_default() -> bool:
 
 def _block_target_from_env() -> int:
     """FF_FLASH_BLOCK tuning knob, sanitized: non-numeric falls back to
-    128, anything else clamps to a multiple of 8 >= 8 (the block rule
+    512, anything else clamps to a multiple of 8 >= 8 (the block rule
     _pick_block enforces — an unaligned target would silently disable
     the kernel for every t > target)."""
-    raw = os.environ.get("FF_FLASH_BLOCK", "128")
+    raw = os.environ.get("FF_FLASH_BLOCK", "512")
     try:
         t = int(raw)
     except ValueError:
-        return 128
+        return 512
     return max(8, t - t % 8)
 
 
-#: Flash block-size target (q and k block edge).  128 matched v5e best
-#: in round-2 measurements at t=2048.
+#: Flash block-size target (q and k block edge).  Round-4 v5e sweep at
+#: (b16, h8, t2048, hd64): fwd 10.08/10.73/5.59 ms and fwd+bwd
+#: 29.51/19.44/13.30 ms for blocks 128/256/512 — bigger blocks amortize
+#: the streaming-softmax corrections; 1024 exceeds scoped VMEM.
 _BLOCK_TARGET = _block_target_from_env()
 
 
@@ -71,16 +73,52 @@ def _pick_block(t: int, target: int = _BLOCK_TARGET) -> int:
     return 0
 
 
-def _require_block(t: int) -> int:
-    """``_pick_block`` for callers already committed to the kernel:
+def _vmem_block_cap(t: int, hd: int, itemsize: int) -> int:
+    """Largest block edge whose kernel VMEM footprint fits the budget.
+
+    The worst kernel (dkv) holds three full (t, hd) operands resident
+    (q, do blocked-as-full plus k/v row blocks elsewhere — modeled as
+    3 full arrays) and per-block f32 scratch: the (block, block)
+    score/prob matrices (x3 with the exp intermediate) plus ~8
+    (block, hd) row buffers (q/o/do/dq/dk/dv/acc + corrections).
+    Blocks beyond this cap compile-fail in Mosaic with a scoped-VMEM
+    OOM (v5e round-4 sweep: 1024 at t=2048/hd=64 bf16 is over)."""
+    budget = _VMEM_BUDGET_BYTES - 3 * t * hd * itemsize
+
+    def fits(b: int) -> bool:
+        return 3 * b * b * 4 + 8 * b * hd * 4 <= budget
+
+    # Whole-dim blocks are legal at any alignment (the _pick_block
+    # rule): a short unaligned t (e.g. 100) runs single-block.
+    if t <= _BLOCK_TARGET and fits(t):
+        return t
+    b = min(_BLOCK_TARGET, t - t % 8)
+    while b >= 8:
+        if fits(b):
+            return b
+        b -= 8
+    return 0
+
+
+def _flash_block(t: int, hd: int, itemsize: int) -> int:
+    """Block edge for the flash kernels at (t, hd): the VMEM cap
+    intersected with the divisor/alignment rule.  0 if no legal block
+    exists (callers gate on flash_supported)."""
+    cap = _vmem_block_cap(t, hd, itemsize)
+    return _pick_block(t, cap) if cap >= 8 else 0
+
+
+def _require_block(t: int, hd: int, itemsize: int) -> int:
+    """``_flash_block`` for callers already committed to the kernel:
     raises the clear error instead of launching Mosaic with an
     unsupported block (the ``flash_supported`` gate, enforced)."""
-    block = _pick_block(t)
+    block = _flash_block(t, hd, itemsize)
     if block < 8 or t < 16:
         raise ValueError(
             f"flash attention needs seq >= 16 with a block divisor that "
-            f"is a multiple of 8 and <= {_BLOCK_TARGET}; got t={t}. Gate "
-            f"callers on flash_supported()."
+            f"is a multiple of 8, <= {_BLOCK_TARGET} and within the VMEM "
+            f"budget; got t={t}, hd={hd}. Gate callers on "
+            f"flash_supported()."
         )
     return block
 
@@ -92,11 +130,7 @@ def flash_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
     _, _, t, hd = shape
     if t < 16 or hd < 8:
         return False
-    # Resident K and V for one (batch, head) must fit VMEM.
-    itemsize = jnp.dtype(dtype).itemsize
-    if 2 * t * hd * itemsize > _VMEM_BUDGET_BYTES:
-        return False
-    return _pick_block(t) >= 8
+    return _flash_block(t, hd, jnp.dtype(dtype).itemsize) >= 8
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +328,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fwd_call(q, k, v, causal, interpret):
     bh, t, hd = q.shape
-    block_q = _require_block(t)
+    block_q = _require_block(t, hd, q.dtype.itemsize)
     block_k = block_q
     scale = 1.0 / math.sqrt(hd)
     kernel = functools.partial(
@@ -320,7 +354,7 @@ def _fwd_call(q, k, v, causal, interpret):
 
 def _bwd_call(q, k, v, do, lse, delta, causal, interpret):
     bh, t, hd = q.shape
-    block_q = _require_block(t)
+    block_q = _require_block(t, hd, q.dtype.itemsize)
     block_k = block_q
     scale = 1.0 / math.sqrt(hd)
     full = pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0))

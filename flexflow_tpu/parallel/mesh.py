@@ -300,7 +300,8 @@ class MeshPlan:
                 return decline(f"non-suffix drop on dim {d}")
         # Terminate the chain with `to` itself (the drop / final
         # constraint), unless the last move already landed there.
-        if not hops or chains(hops[-1]) != t:
+        # (`movers` is non-empty here, so step 2 appended >= 1 hop.)
+        if chains(hops[-1]) != t:
             hops.append(to)
         return hops
 

@@ -33,12 +33,15 @@ COLLECTIVE_OPS = (
 )
 
 # `%all-gather.3 = f32[16,128]{1,0} all-gather(...)` — result shape
-# precedes the opcode; tuple-shaped results list several arrays.
+# precedes the opcode; tuple-shaped results list several arrays and
+# XLA's collective combiner nests them one level deep
+# (`((f32[4,8]{1,0}, ...), (f32[32,8]{1,0}, ...)) all-gather-start`),
+# so the tuple alternative admits one level of inner parens.
 # Async lowering splits each collective into `-start`/`-done` pairs;
 # the `-start` carries the transfer (counted), the `-done` only
 # unpacks its result (excluded by requiring `(` after the suffix).
 _INSTR_RE = re.compile(
-    r"=\s*(?P<shape>\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^\s]*)\s+"
+    r"=\s*(?P<shape>\((?:[^()]|\([^()]*\))*\)|[a-z0-9]+\[[0-9,]*\][^\s]*)\s+"
     r"(?P<opcode>(?:" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?)\("
 )
 _ARRAY_RE = re.compile(r"[a-z0-9]+\[(?P<dims>[0-9,]*)\]")
@@ -96,14 +99,35 @@ def sharded_activation_sizes(ex) -> Dict[str, int]:
     return sizes
 
 
+def _param_sizes(ex) -> set:
+    """Global element counts of trained parameters and op state —
+    tensors a strategy may legitimately all-gather in full (ZeRO-1
+    re-gather, replicated-weight placement)."""
+    sizes = set()
+    for op in ex.model.layers:
+        for specs in (op.param_specs(), op.state_specs()):
+            for ps in specs.values():
+                n = 1
+                for d in ps.shape:
+                    n *= int(d)
+                sizes.add(n)
+    return sizes
+
+
 def full_activation_allgathers(ex, hlo_text: str = None) -> List[Collective]:
     """All-gathers whose per-device result reaches the full global
     size of a sharded activation — the replicate-then-slice pattern
     decomposed resharding exists to prevent.  Empty list = provably
-    no full-activation materialization in the compiled step."""
+    no full-activation materialization in the compiled step.
+
+    Matching is by element count (XLA reshapes/merges dims freely in
+    optimized HLO, so shape strings don't survive); counts that are
+    also parameter/state global sizes are excluded — a weight gathered
+    in full is legitimate and would otherwise alias an activation of
+    coincidentally equal size."""
     if hlo_text is None:
         hlo_text = ex.lower_train_step().compile().as_text()
-    sizes = set(sharded_activation_sizes(ex).values())
+    sizes = set(sharded_activation_sizes(ex).values()) - _param_sizes(ex)
     return [
         c for c in collective_stats(hlo_text)
         if c.opcode == "all-gather" and c.elements in sizes

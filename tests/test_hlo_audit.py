@@ -54,6 +54,18 @@ class TestParser:
             "collective-permute": 1, "all-reduce": 1,
         }
 
+    def test_nested_tuple_combined_collective(self):
+        """XLA's collective combiner emits multi-operand async starts
+        with one level of tuple nesting; the parser must count them
+        (at the largest member size), not silently drop them."""
+        hlo = """
+  %ags = ((f32[4,8]{1,0}, f32[2,8]{1,0}), (f32[32,8]{1,0}, f32[16,8]{1,0})) all-gather-start(%a, %b)
+  %agd = (f32[32,8]{1,0}, f32[16,8]{1,0}) all-gather-done(%ags)
+"""
+        stats = collective_stats(hlo)
+        assert [c.opcode for c in stats] == ["all-gather"]
+        assert stats[0].elements == 32 * 8
+
     def test_flags_full_size_allgather(self):
         class FakePC:
             num_parts = 8
@@ -64,6 +76,12 @@ class TestParser:
 
         class FakeOp:
             outputs = [FakeT()]
+
+            def param_specs(self):
+                return {}
+
+            def state_specs(self):
+                return {}
 
         class FakeModel:
             layers = [FakeOp()]

@@ -26,6 +26,12 @@ shape = (b, h, t, hd)
 if not pk.flash_supported(shape, jnp.bfloat16):
     print(f"block {os.environ.get('FF_FLASH_BLOCK')}: unsupported at {shape}")
     sys.exit(0)
+# The VMEM cap may shrink the requested block (oversized requests now
+# clamp instead of OOMing Mosaic); label the row with what actually ran.
+actual = pk._flash_block(t, hd, 2)
+if str(actual) != os.environ.get("FF_FLASH_BLOCK", ""):
+    print(f"(FF_FLASH_BLOCK={os.environ.get('FF_FLASH_BLOCK')} "
+          f"clamped to {actual})")
 key = jax.random.PRNGKey(0)
 q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
            for i in range(3))
