@@ -156,34 +156,51 @@ def bench_dlrm(n_chips: int, on_tpu: bool):
         return sps, mfu, err
 
 
-def bench_transformer(on_tpu: bool):
-    """Long-context flagship: GPT-style LM step with the Pallas flash
-    attention kernel (dense single-chip path; the ring/CP path is
-    exercised by the driver's multi-chip dry run).  Returns
-    (tokens/s, mfu)."""
+def _bench_lm(batch: int, seq: int, layers: int, iters: int):
+    """One GPT-style LM measurement (shared by the 2k and 8k legs):
+    build, jit, fit, return (tokens/s, mfu)."""
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.models.transformer import build_transformer_lm
     from flexflow_tpu.optim import AdamOptimizer
     from flexflow_tpu.runtime.executor import Executor
     from flexflow_tpu.runtime.trainer import Trainer
 
-    # v5e-1 sweep: b=8 -> 102k tokens/s, b=16 -> 113k, b=32 OOM.
-    batch = 16 if on_tpu else 2
-    seq = 2048 if on_tpu else 128
     ff = build_transformer_lm(
         batch_size=batch, seq_len=seq, vocab_size=32768, d_model=512,
-        num_heads=8, num_layers=6 if on_tpu else 2,
+        num_heads=8, num_layers=layers,
         config=FFConfig(batch_size=batch, compute_dtype="bfloat16"),
     )
     import jax
 
     ex = Executor(ff, optimizer=AdamOptimizer(lr=1e-4),
                   devices=jax.devices()[:1])  # single-chip by contract
-    stats = Trainer(ex).fit(iterations=10 if on_tpu else 3, warmup=2)
+    stats = Trainer(ex).fit(iterations=iters, warmup=2)
     mfu = (_train_flops(ff) / batch) * stats["samples_per_s"] / (
         V5E_BF16_PEAK_FLOPS
     )
     return stats["samples_per_s"] * seq, mfu
+
+
+def bench_transformer(on_tpu: bool):
+    """Long-context flagship: GPT-style LM step with the Pallas flash
+    attention kernel (dense single-chip path; the ring/CP path is
+    exercised by the driver's multi-chip dry run).  Returns
+    (tokens/s, mfu)."""
+    # v5e-1 sweep: b=8 -> 102k tokens/s, b=16 -> 113k, b=32 OOM.
+    if on_tpu:
+        return _bench_lm(batch=16, seq=2048, layers=6, iters=10)
+    return _bench_lm(batch=2, seq=128, layers=2, iters=3)
+
+
+def bench_transformer_longctx(on_tpu: bool):
+    """Long-context leg: same 6-layer LM at seq 8192 on one chip —
+    the flash kernel's O(t) memory (VMEM-capped blocks) is what makes
+    this shape trainable at all; dense attention would materialize a
+    b*h*8192^2 f32 score tensor (16 GB at b=4).  Returns
+    (tokens/s, mfu)."""
+    if on_tpu:
+        return _bench_lm(batch=4, seq=8192, layers=6, iters=5)
+    return _bench_lm(batch=1, seq=256, layers=2, iters=2)
 
 
 def bench_nmt(n_chips: int, on_tpu: bool):
@@ -312,6 +329,13 @@ def main():
         extra["transformer_error"] = f"{type(e).__name__}: {e}"
     try:
         with contextlib.redirect_stdout(sys.stderr):
+            lc_tps, lc_mfu = bench_transformer_longctx(on_tpu)
+        extra["transformer_8k_tokens_per_s"] = round(lc_tps, 1)
+        extra["transformer_8k_mfu"] = round(lc_mfu, 4)
+    except Exception as e:
+        extra["transformer_8k_error"] = f"{type(e).__name__}: {e}"
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
             extra["candle_samples_per_s"] = round(bench_candle(on_tpu), 2)
     except Exception as e:
         extra["candle_error"] = f"{type(e).__name__}: {e}"
@@ -351,7 +375,8 @@ def main():
         per_chip = per_chip * n_chips / actual_n
         n_chips = extra["n_chips"] = actual_n
         # MFU fields are computed against the TPU roofline.
-        for k in ("alexnet_mfu", "dlrm_mfu", "transformer_mfu"):
+        for k in ("alexnet_mfu", "dlrm_mfu", "transformer_mfu",
+                  "transformer_8k_mfu"):
             if k in extra:
                 extra[k] = None
 

@@ -175,9 +175,25 @@ class FFModel:
         name: Optional[str] = None,
         **kw,
     ) -> TensorSpec:
+        self._embedding_dtypes(kw)
         return self._add(
             Embedding(self._unique("embedding", name), x, num_entries, out_dim,
                       aggr=aggr, **kw)
+        )
+
+    def _embedding_dtypes(self, kw) -> None:
+        """Dtype policy for the embedding family: activations follow
+        ``compute_dtype``; the TABLE stays f32 while sparse updates are
+        enabled (the row-DMA kernels are f32-only — Mosaic cannot prove
+        dynamic one-row slices aligned on packed bf16 sublanes) and
+        lookups are gather-bound, so a low-precision table would buy
+        nothing while knocking big-table training onto the full-sweep
+        XLA scatter."""
+        out = jnp.dtype(self.config.compute_dtype)
+        kw.setdefault("out_dtype", out)
+        kw.setdefault(
+            "dtype",
+            jnp.float32 if self.config.sparse_embedding_updates else out,
         )
 
     def multi_embedding(
@@ -189,6 +205,7 @@ class FFModel:
         name: Optional[str] = None,
         **kw,
     ) -> TensorSpec:
+        self._embedding_dtypes(kw)
         return self._add(
             MultiEmbedding(self._unique("embeddings", name), x, num_tables,
                            num_entries, out_dim, **kw)
@@ -205,6 +222,7 @@ class FFModel:
         """T different-vocab tables, row-concatenated and row-range
         sharded (heterogeneous table parallelism; reference:
         ``dlrm.cc:230-330`` + ``dlrm_strategy.cc:5-36``)."""
+        self._embedding_dtypes(kw)
         return self._add(
             HeteroEmbedding(self._unique("embeddings", name), x, vocab_sizes,
                             out_dim, **kw)
@@ -220,6 +238,7 @@ class FFModel:
     ) -> TensorSpec:
         """Token embedding (batch, seq) -> (batch, seq, dim) (reference:
         the NMT embed op, ``nmt/embed.cu``)."""
+        self._embedding_dtypes(kw)
         return self._add(
             WordEmbedding(self._unique("word_embedding", name), x, num_entries,
                           out_dim, **kw)

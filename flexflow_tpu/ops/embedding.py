@@ -91,6 +91,7 @@ class Embedding(Op):
         out_dim: int,
         aggr: str = "sum",
         dtype=jnp.float32,
+        out_dtype=None,
         kernel_initializer=None,
     ):
         super().__init__(name, [x])
@@ -98,14 +99,18 @@ class Embedding(Op):
         assert aggr in ("sum", "avg")
         self.attrs = dict(num_entries=num_entries, out_dim=out_dim, aggr=aggr)
         self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
-        self._make_output((x.shape[0], out_dim), dtype, ("n", "c"))
+        # ``dtype`` is the TABLE dtype; ``out_dtype`` (default: same)
+        # lets f32 tables — required by the row-sparse update kernels —
+        # emit activations in the model's compute dtype.
+        self.table_dtype = jnp.dtype(dtype)
+        self._make_output((x.shape[0], out_dim), out_dtype or dtype, ("n", "c"))
 
     def param_specs(self) -> Dict[str, ParamSpec]:
         a = self.attrs
         return {
             "table": ParamSpec(
                 (a["num_entries"], a["out_dim"]),
-                self.outputs[0].dtype,
+                self.table_dtype,
                 self.kernel_initializer,
                 (None, "c"),
             )
@@ -130,7 +135,7 @@ class Embedding(Op):
             y = jnp.sum(rows, axis=1)
         else:
             y = jnp.mean(rows, axis=1)
-        return [y], state
+        return [y.astype(self.outputs[0].dtype)], state
 
     def sparse_apply(self, params, xs, row_grads, lr):
         (idx,) = xs
@@ -163,6 +168,7 @@ class MultiEmbedding(Op):
         num_entries: int,
         out_dim: int,
         dtype=jnp.float32,
+        out_dtype=None,
         kernel_initializer=None,
     ):
         super().__init__(name, [x])
@@ -171,14 +177,16 @@ class MultiEmbedding(Op):
             num_tables=num_tables, num_entries=num_entries, out_dim=out_dim
         )
         self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
-        self._make_output((x.shape[0], num_tables, out_dim), dtype, ("n", "c", None))
+        self.table_dtype = jnp.dtype(dtype)
+        self._make_output((x.shape[0], num_tables, out_dim), out_dtype or dtype,
+                          ("n", "c", None))
 
     def param_specs(self) -> Dict[str, ParamSpec]:
         a = self.attrs
         return {
             "tables": ParamSpec(
                 (a["num_tables"], a["num_entries"], a["out_dim"]),
-                self.outputs[0].dtype,
+                self.table_dtype,
                 self.kernel_initializer,
                 ("c", None, None),
             )
@@ -191,7 +199,7 @@ class MultiEmbedding(Op):
         (idx,) = xs  # (batch, T)
         tables = params["tables"]  # (T, vocab, dim)
         t_range = jnp.arange(tables.shape[0])[None, :]  # (1, T)
-        return [tables[t_range, idx]], state
+        return [tables[t_range, idx].astype(self.outputs[0].dtype)], state
 
     def sparse_keys(self):
         return ("tables",)
@@ -210,7 +218,7 @@ class MultiEmbedding(Op):
         )
 
     def sparse_forward(self, rows, xs, state, training):
-        return [rows], state
+        return [rows.astype(self.outputs[0].dtype)], state
 
     def sparse_apply(self, params, xs, row_grads, lr):
         (idx,) = xs  # (batch, T)
@@ -259,6 +267,7 @@ class HeteroEmbedding(Op):
         vocab_sizes,
         out_dim: int,
         dtype=jnp.float32,
+        out_dtype=None,
         pad_to: int = 128,
     ):
         super().__init__(name, [x])
@@ -277,8 +286,10 @@ class HeteroEmbedding(Op):
             vocab_sizes=vocab_sizes, out_dim=out_dim, rows=rows,
             offsets=tuple(offsets),
         )
+        self.table_dtype = jnp.dtype(dtype)
         self._make_output(
-            (x.shape[0], len(vocab_sizes), out_dim), dtype, ("n", None, None)
+            (x.shape[0], len(vocab_sizes), out_dim), out_dtype or dtype,
+            ("n", None, None)
         )
 
     def _init_table(self, key, shape, dtype):
@@ -298,7 +309,7 @@ class HeteroEmbedding(Op):
         return {
             "table": ParamSpec(
                 (a["rows"], a["out_dim"]),
-                self.outputs[0].dtype,
+                self.table_dtype,
                 self._init_table,
                 ("c", None),
             )
@@ -327,7 +338,7 @@ class HeteroEmbedding(Op):
         return _gather_dispatch(self, params["table"], idx + offsets[None, :])
 
     def sparse_forward(self, rows, xs, state, training):
-        return [rows], state
+        return [rows.astype(self.outputs[0].dtype)], state
 
     def sparse_apply(self, params, xs, row_grads, lr):
         (idx,) = xs
@@ -351,9 +362,10 @@ class HeteroEmbedding(Op):
         offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
         flat = idx + offsets[None, :]  # global row ids
 
+        out_dtype = self.outputs[0].dtype
         plan = getattr(self, "_plan", None)
         if not self._shards_rows(plan, getattr(self, "_pc", None)):
-            return [jnp.take(table, flat, axis=0)], state
+            return [jnp.take(table, flat, axis=0).astype(out_dtype)], state
         (n_axes, n_deg), (c_axes, c_deg) = plan.local_degrees(
             self._pc, "n", "c"
         )
@@ -374,18 +386,17 @@ class HeteroEmbedding(Op):
             return jax.lax.psum(got, c_axes)
 
         n_entry = n_axes if n_axes else None
-        return [
-            jax.shard_map(
-                local_fn,
-                mesh=plan.mesh,
-                in_specs=(
-                    PartitionSpec(c_axes, None),
-                    PartitionSpec(n_entry, None),
-                ),
-                out_specs=PartitionSpec(n_entry, None, None),
-                check_vma=False,
-            )(table, flat)
-        ], state
+        gathered = jax.shard_map(
+            local_fn,
+            mesh=plan.mesh,
+            in_specs=(
+                PartitionSpec(c_axes, None),
+                PartitionSpec(n_entry, None),
+            ),
+            out_specs=PartitionSpec(n_entry, None, None),
+            check_vma=False,
+        )(table, flat)
+        return [gathered.astype(out_dtype)], state
 
 
 class WordEmbedding(Op):
@@ -404,27 +415,31 @@ class WordEmbedding(Op):
         num_entries: int,
         out_dim: int,
         dtype=jnp.float32,
+        out_dtype=None,
         kernel_initializer=None,
     ):
         super().__init__(name, [x])
         assert x.ndim == 2, f"word embedding input must be (batch, seq), got {x.shape}"
         self.attrs = dict(num_entries=num_entries, out_dim=out_dim)
         self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
-        self._make_output((x.shape[0], x.shape[1], out_dim), dtype, ("n", "s", None))
+        self.table_dtype = jnp.dtype(dtype)
+        self._make_output((x.shape[0], x.shape[1], out_dim), out_dtype or dtype,
+                          ("n", "s", None))
 
     def param_specs(self) -> Dict[str, ParamSpec]:
         a = self.attrs
         return {
             "table": ParamSpec(
                 (a["num_entries"], a["out_dim"]),
-                self.outputs[0].dtype,
+                self.table_dtype,
                 self.kernel_initializer,
             )
         }
 
     def forward(self, params, xs, state, training):
         (idx,) = xs
-        return [jnp.take(params["table"], idx, axis=0)], state
+        rows = jnp.take(params["table"], idx, axis=0)
+        return [rows.astype(self.outputs[0].dtype)], state
 
     def sparse_keys(self):
         return ("table",)
@@ -434,7 +449,7 @@ class WordEmbedding(Op):
         return _gather_dispatch(self, params["table"], idx)
 
     def sparse_forward(self, rows, xs, state, training):
-        return [rows], state
+        return [rows.astype(self.outputs[0].dtype)], state
 
     def sparse_apply(self, params, xs, row_grads, lr):
         (idx,) = xs

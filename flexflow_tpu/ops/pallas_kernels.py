@@ -29,8 +29,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
-# Keep resident K/V (+ per-step blocks) comfortably inside ~16 MB VMEM.
-_VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 # Per-query scalars (lse, delta) carry this many broadcast lanes so
 # their pallas blocks meet the TPU tiling constraints.
 LSE_LANES = 8
@@ -74,29 +72,37 @@ def _pick_block(t: int, target: int = _BLOCK_TARGET) -> int:
 
 
 def _vmem_block_cap(t: int, hd: int, itemsize: int) -> int:
-    """Largest block edge whose kernel VMEM footprint fits the budget.
+    """Largest block edge whose kernels fit the 16 MB scoped-VMEM
+    limit, from a v5e compile matrix (round 4) keyed on the size of
+    one resident (t, hd) operand, ``u = t*hd*itemsize``:
 
-    The worst kernel (dkv) holds three full (t, hd) operands resident
-    (q, do blocked-as-full plus k/v row blocks elsewhere — modeled as
-    3 full arrays) and per-block f32 scratch: the (block, block)
-    score/prob matrices (x3 with the exp intermediate) plus ~8
-    (block, hd) row buffers (q/o/do/dq/dk/dv/acc + corrections).
-    Blocks beyond this cap compile-fail in Mosaic with a scoped-VMEM
-    OOM (v5e round-4 sweep: 1024 at t=2048/hd=64 bf16 is over)."""
-    budget = _VMEM_BUDGET_BYTES - 3 * t * hd * itemsize
+        u <= 512K (bf16 t<=4096 / f32 t<=2048 at hd=64): block 512 ok;
+          1024 OOMs (15.7M+ scoped) and is 2.2x slower per the sweep.
+        u <= 1M (bf16 t=8192 / f32 t=4096): 512 OOMs (16.2-21M),
+          256 compiles.
+        u = 2M (bf16 t=16384, f32 t=8192): every block OOMs (16.5-24M;
+          scoped use GROWS as blocks shrink — the pipeline's resident
+          copies dominate, not block scratch) -> unsupported; such
+          shapes belong on ring attention (sequence-sharded chunks),
+          not a single kernel launch.
 
-    def fits(b: int) -> bool:
-        return 3 * b * b * 4 + 8 * b * hd * 4 <= budget
+    Analytic models (resident operands x double-buffering + block
+    scratch) under-predicted the measured scoped sizes by 2-3x, so
+    this is deliberately a measured table, not a formula.  The matrix
+    was measured at hd=64; per-block scratch scales with hd, so the
+    caps shrink proportionally for larger head dims (conservative —
+    unmeasured territory must fail toward smaller blocks, not Mosaic
+    compile errors)."""
+    u = t * hd * itemsize
 
-    # Whole-dim blocks are legal at any alignment (the _pick_block
-    # rule): a short unaligned t (e.g. 100) runs single-block.
-    if t <= _BLOCK_TARGET and fits(t):
-        return t
-    b = min(_BLOCK_TARGET, t - t % 8)
-    while b >= 8:
-        if fits(b):
-            return b
-        b -= 8
+    def scaled(cap: int) -> int:
+        b = max(8, (cap * 64 // max(hd, 64)) // 8 * 8)
+        return min(_BLOCK_TARGET, b)
+
+    if u <= 512 * 1024:
+        return scaled(512)  # 512 = measured ceiling at hd=64
+    if u <= 1024 * 1024:
+        return scaled(256)
     return 0
 
 
@@ -634,6 +640,13 @@ def rows_supported(
     matrix VMEM."""
     itemsize = jnp.dtype(dtype).itemsize
     if n_ids < 1 or dim < 1:
+        return False
+    if itemsize != 4:
+        # Mosaic packs sub-32-bit dtypes 2/4-per-sublane in VMEM and
+        # then cannot statically prove dynamic one-row slices aligned
+        # ("index in dimension 0 is a multiple of 4", v5e round-4
+        # probe on bf16).  The row kernels are f32-only; smaller
+        # dtypes take the dense XLA path.
         return False
     if kind == "gather" or dim % 128 == 0:
         upd_lanes = max(dim, 1)

@@ -121,13 +121,18 @@ def full_activation_allgathers(ex, hlo_text: str = None) -> List[Collective]:
     no full-activation materialization in the compiled step.
 
     Matching is by element count (XLA reshapes/merges dims freely in
-    optimized HLO, so shape strings don't survive); counts that are
-    also parameter/state global sizes are excluded — a weight gathered
-    in full is legitimate and would otherwise alias an activation of
-    coincidentally equal size."""
+    optimized HLO, so shape strings don't survive).  Under ZeRO-1 the
+    step legitimately re-gathers full parameters, so counts that are
+    also parameter/state global sizes are excluded THERE — but only
+    there: unconditionally subtracting them would mask a real
+    activation all-gather whenever an activation count collides with a
+    parameter count (e.g. b*s*d == vocab*d exactly when b*s == vocab,
+    the flagship bench shape)."""
     if hlo_text is None:
         hlo_text = ex.lower_train_step().compile().as_text()
-    sizes = set(sharded_activation_sizes(ex).values()) - _param_sizes(ex)
+    sizes = set(sharded_activation_sizes(ex).values())
+    if getattr(getattr(ex, "config", None), "zero_sharded_optimizer", False):
+        sizes -= _param_sizes(ex)
     return [
         c for c in collective_stats(hlo_text)
         if c.opcode == "all-gather" and c.elements in sizes

@@ -129,6 +129,10 @@ def op_cost(op: Op) -> OpCost:
         bytes_ += pbytes
         if moe:
             continue  # only capacity-many tokens contract each expert
+        if isinstance(op, (MultiHeadAttention, LSTM)):
+            continue  # explicit formulas below: their outputs carry no
+            # 'c' tag, so the generic non_c rule would multiply in the
+            # feature dim and overcount by ~d (bench round-4 MFU audit)
         if len(spec.shape) >= 2:
             flops += 2.0 * non_c * psize
     moe_ep_bytes = 0.0
@@ -150,11 +154,14 @@ def op_cost(op: Op) -> OpCost:
         moe_ep_bytes = 2.0 * 2.0 * e * cap * d * esize
     if isinstance(op, MultiHeadAttention):
         b, s, d = op.inputs[0].shape
+        flops += 8.0 * b * s * float(d) ** 2  # q/k/v/o projections
         flops += 4.0 * b * float(s) ** 2 * d  # QK^T and PV
     if isinstance(op, LSTM):
+        # Gate matmuls over the scan: 2*b*(in+h)*4h per step.
         # Sequential scan: MXU utilization is poor for the per-step
         # small GEMMs; charge 4x.
-        flops *= 4.0
+        b, s, h = op.outputs[0].shape
+        flops += 4.0 * (2.0 * b * s * 4.0 * h * (op.in_dim + h))
     for t in op.inputs:
         bytes_ += float(np.prod(t.shape)) * _dtype_size(t.dtype)
     for t in op.outputs:
