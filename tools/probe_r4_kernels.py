@@ -6,9 +6,6 @@ shapes onto Mosaic (CPU interpret-mode tests cannot catch Mosaic
 rejects).  Run with PYTHONPATH=/root/.axon_site:/root/repo.
 """
 
-import sys
-import traceback
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -36,24 +36,50 @@ key = jax.random.PRNGKey(0)
 q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape, jnp.bfloat16)
            for i in range(3))
 
-fwd = jax.jit(lambda q, k, v: pk.flash_attention(q, k, v, True))
-
 def loss(q, k, v):
     return jnp.sum(pk.flash_attention(q, k, v, True).astype(jnp.float32))
 
-bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+# All three cotangents summed into a q-shaped carry, so the chain keeps
+# BOTH backward kernels (dq and dkv) alive — grad wrt q alone would let
+# XLA dead-code-eliminate the dkv pallas_call.
+grad_all = jax.grad(loss, argnums=(0, 1, 2))
 
-def timeit(fn, reps=10):
-    out = fn(q, k, v)
-    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])  # compile+warm fence
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(q, k, v)
-    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
-    return (time.perf_counter() - t0) / reps * 1e3
+def bwd_step(x):
+    dq, dk, dv = grad_all(x, k, v)
+    return (dq + dk + dv).astype(x.dtype)
 
-fwd_ms = timeit(fwd)
-bwd_ms = timeit(bwd)
+# Two-point jitted-chain timing (the relay's per-dispatch cost is of
+# the same magnitude as the kernel itself, so single calls sit on a
+# dispatch floor): one jit'd dependent chain x = f(x) of length N is
+# ONE dispatch, and the (N2 - N1) slope isolates per-iteration cost.
+# Chains stay short and fenced — a 30-long pallas chain once wedged
+# the relay (CLAUDE.md).
+def timeit(step, pallas_per_step=1):
+    # Cap the dependent pallas-call chain at 24: a 30-long chain once
+    # wedged the relay for ~70 min (CLAUDE.md).  bwd_step carries ~3
+    # pallas calls (fwd recompute + dq + dkv), so its chain lengths
+    # shrink to (2, 8).
+    n2 = max(4, min(16, 24 // pallas_per_step))
+    n1 = max(1, n2 // 4)
+    def chain(n):
+        # Min of 3: relay delays are additive one-sided noise (several
+        # ms per dispatch), so the min estimates the compute time.
+        @jax.jit
+        def run(x):
+            return jax.lax.fori_loop(0, n, lambda _, x: step(x), x)
+        y = run(q)
+        jax.device_get(y.ravel()[:1])  # compile+warm fence
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            y = run(q)
+            jax.device_get(y.ravel()[:1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+    return (chain(n2) - chain(n1)) / (n2 - n1) * 1e3
+
+fwd_ms = timeit(lambda x: pk.flash_attention(x, k, v, True).astype(x.dtype))
+bwd_ms = timeit(bwd_step, pallas_per_step=3)
 flops = 4.0 * b * h * t * t * hd / 2  # causal fwd
 print(f"block {os.environ.get('FF_FLASH_BLOCK', '128'):>4s}: "
       f"fwd {fwd_ms:7.2f} ms ({flops / (fwd_ms * 1e-3) / 1.97e14 * 100:4.1f}% "
