@@ -34,17 +34,9 @@ from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
 _NEG_INF = -1e30
 
 
-def _merge_lse(o1, lse1, o2, lse2):
-    """Combine two flash partial results (o_i, lse_i) -> (o, lse).
-
-    o_i: (b, h, t, hd) f32; lse_i: (b, h, t) f32 (may be -inf where a
-    chunk contributed nothing).  The standard streaming-softmax merge
-    used between ring steps.
-    """
-    lse = jnp.logaddexp(lse1, lse2)
-    w1 = jnp.exp(lse1 - lse)[..., None]
-    w2 = jnp.exp(lse2 - lse)[..., None]
-    return o1 * w1 + o2 * w2, lse
+# Streaming-softmax merge of flash partials, shared with the chunked
+# single-device decomposition (pallas_kernels.merge_lse).
+_merge_lse = pallas_kernels.merge_lse
 
 
 class LayerNorm(Op):
@@ -242,11 +234,23 @@ class MultiHeadAttention(Op):
         XLA would all-gather q/k/v onto every device.
         """
         causal = self.attrs["causal"]
+
+        def kernel_for(shape, dtype):
+            # Single launch when the shape fits the VMEM cap; the
+            # chunked decomposition (per-chunk launches + lse merges)
+            # for longer sequences; None -> einsum fallback.
+            if pallas_kernels.flash_supported(shape, dtype):
+                return lambda ql, kl, vl: pallas_kernels.flash_attention(
+                    ql, kl, vl, causal)
+            if pallas_kernels.flash_chunked_supported(shape, dtype):
+                return lambda ql, kl, vl: pallas_kernels.flash_attention_lse_chunked(
+                    ql, kl, vl, causal)[0]
+            return None
+
         plan = getattr(self, "_plan", None)
         if plan is None or plan.num_devices == 1:
-            if pallas_kernels.flash_supported(q.shape, q.dtype):
-                return pallas_kernels.flash_attention(q, k, v, causal)
-            return None
+            fn = kernel_for(q.shape, q.dtype)
+            return fn(q, k, v) if fn is not None else None
         (n_entry, n_deg), (c_entry, c_deg) = plan.local_degrees(
             self._pc, "n", "c"
         )
@@ -254,11 +258,12 @@ class MultiHeadAttention(Op):
         if b % n_deg or h % c_deg:
             return None
         local_shape = (b // n_deg, h // c_deg, t, hd)
-        if not pallas_kernels.flash_supported(local_shape, q.dtype):
+        fn = kernel_for(local_shape, q.dtype)
+        if fn is None:
             return None
         spec = PartitionSpec(n_entry, c_entry, None, None)
         return jax.shard_map(
-            lambda ql, kl, vl: pallas_kernels.flash_attention(ql, kl, vl, causal),
+            fn,
             mesh=plan.mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -281,7 +286,9 @@ class MultiHeadAttention(Op):
             qh = self._split_heads(q)
             kh = self._split_heads(k)
             vh = self._split_heads(v)
-            use_flash = pallas_kernels.flash_supported(qh.shape, qh.dtype)
+            use_flash = pallas_kernels.flash_supported(
+                qh.shape, qh.dtype
+            ) or pallas_kernels.flash_chunked_supported(qh.shape, qh.dtype)
             if use_flash:
                 return self._ring_flash(qh, kh, vh, s_idx, S, s_entry, dtype)
             qh, kh, vh = (x.astype(jnp.float32) for x in (qh, kh, vh))
@@ -331,7 +338,7 @@ class MultiHeadAttention(Op):
         """
         causal = self.attrs["causal"]
         ring = [(i, (i + 1) % S) for i in range(S)]
-        o, lse = pallas_kernels.flash_attention_lse(qh, kh, vh, causal)
+        o, lse = pallas_kernels.flash_attention_lse_auto(qh, kh, vh, causal)
         o = o.astype(jnp.float32)
         k_cur, v_cur = kh, vh
         for j in range(1, S):
@@ -339,7 +346,7 @@ class MultiHeadAttention(Op):
             v_cur = lax.ppermute(v_cur, tuple(s_entry), ring)
 
             def attend(kc=k_cur, vc=v_cur):
-                o_j, lse_j = pallas_kernels.flash_attention_lse(qh, kc, vc, False)
+                o_j, lse_j = pallas_kernels.flash_attention_lse_auto(qh, kc, vc, False)
                 return o_j.astype(jnp.float32), lse_j
 
             if causal:

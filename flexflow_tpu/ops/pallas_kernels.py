@@ -455,6 +455,103 @@ def flash_attention(q, k, v, causal: bool = True,
 
 
 # ---------------------------------------------------------------------------
+# chunked flash: sequences past the single-kernel VMEM cap
+# ---------------------------------------------------------------------------
+
+
+def merge_lse(o1, lse1, o2, lse2):
+    """Combine two flash partials (o_i, lse_i) -> (o, lse).
+
+    The streaming-softmax merge used between ring steps and sequence
+    chunks: o_i (..., t, hd) f32, lse_i (..., t) f32 (-inf marks an
+    empty contribution).
+    """
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return o1 * w1 + o2 * w2, lse
+
+
+def _chunk_len(t: int, hd: int, itemsize: int) -> int:
+    """Largest divisor of ``t`` that the single-launch kernel supports
+    (VMEM-capped), or 0.  Chunks below 512 are pure overhead — such
+    sequences either fit a single launch or are not worth chunking."""
+    c = t
+    while c >= 512:
+        if t % c == 0 and _flash_block(c, hd, itemsize) >= 8:
+            return c
+        c //= 2
+    return 0
+
+
+def flash_chunked_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
+    """Whether ``flash_attention_lse_chunked`` applies: the shape is
+    beyond the single-kernel cap but decomposes into supported
+    sequence chunks."""
+    if len(shape) != 4:
+        return False
+    _, _, t, hd = shape
+    if hd < 8 or flash_supported(shape, dtype):
+        return False
+    return _chunk_len(t, hd, jnp.dtype(dtype).itemsize) > 0
+
+
+def flash_attention_lse_auto(q, k, v, causal: bool = True,
+                             interpret: Optional[bool] = None):
+    """``flash_attention_lse`` when the shape fits one launch, the
+    chunked decomposition when it only fits per-chunk.  Callers gate on
+    ``flash_supported(...) or flash_chunked_supported(...)``."""
+    if flash_supported(q.shape, q.dtype):
+        return flash_attention_lse(q, k, v, causal, interpret)
+    return flash_attention_lse_chunked(q, k, v, causal, interpret)
+
+
+def flash_attention_lse_chunked(q, k, v, causal: bool = True,
+                                interpret: Optional[bool] = None):
+    """Flash attention for sequences past the single-launch VMEM cap
+    (``_vmem_block_cap`` marks e.g. bf16 t=16384/hd=64 unsupported —
+    the pipeline's resident copies alone exceed scoped VMEM).
+
+    The sequence is split into the largest kernel-supported chunk
+    size; each (q-chunk, k-chunk) pair runs one flash launch and the
+    partials merge with the streaming-softmax combine — the same
+    decomposition ring attention does across devices
+    (``ops/attention.py``), applied on-device.  Fully differentiable:
+    composition of the custom-VJP kernel and jnp merges.  Memory stays
+    O(t·hd): only per-chunk (o, lse) partials materialize, never a
+    score matrix.
+    """
+    b, h, t, hd = q.shape
+    c = _chunk_len(t, hd, q.dtype.itemsize)
+    if c == 0 or c == t:
+        raise ValueError(
+            f"flash_attention_lse_chunked: no supported chunking for "
+            f"t={t}, hd={hd}; gate on flash_chunked_supported()."
+        )
+    nq = t // c
+    sl = lambda x, i: lax.slice_in_dim(x, i * c, (i + 1) * c, axis=2)
+    outs, lses = [], []
+    for i in range(nq):
+        qi = sl(q, i)
+        # Diagonal chunk: in-kernel causal mask (or plain for non-causal).
+        o, lse = flash_attention_lse(qi, sl(k, i), sl(v, i), causal, interpret)
+        o = o.astype(jnp.float32)
+        # Off-diagonal chunks: fully visible under causal masking only
+        # for j < i; non-causal sees every chunk.
+        for j in range(nq) if not causal else range(i):
+            if j == i:
+                continue
+            o_j, lse_j = flash_attention_lse(
+                qi, sl(k, j), sl(v, j), False, interpret
+            )
+            o, lse = merge_lse(o, lse, o_j.astype(jnp.float32), lse_j)
+        outs.append(o)
+        lses.append(lse)
+    out = jnp.concatenate(outs, axis=2).astype(q.dtype)
+    return out, jnp.concatenate(lses, axis=2)
+
+
+# ---------------------------------------------------------------------------
 # fused softmax + cross-entropy (the reference's fused softmax/loss op,
 # src/ops/softmax.cu:91-160, rebuilt as a vocab-blocked streaming kernel)
 # ---------------------------------------------------------------------------

@@ -203,3 +203,54 @@ def test_xent_supported_gating():
     assert not pk.xent_supported(128, 512)    # vocab too small to stream
     assert not pk.xent_supported(128, 1000)   # not tiled by block_v
     assert not pk.xent_supported(4, 2048)     # too few rows
+
+
+# -- chunked flash (sequences past the single-launch VMEM cap) --------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_chunked_matches_naive(rng, causal, monkeypatch):
+    # Force chunking at a small shape by shrinking the chunk picker
+    # (real chunking triggers at bf16 t=16384, too big for CPU tests).
+    monkeypatch.setattr(pk, "_chunk_len", lambda t, hd, it: 16)
+    q, k, v = make_qkv(rng, t=64, hd=16)
+    out, lse = pk.flash_attention_lse_chunked(q, k, v, causal)
+    ref = naive_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((64, 64), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_chunked_grads(rng, causal, monkeypatch):
+    monkeypatch.setattr(pk, "_chunk_len", lambda t, hd, it: 16)
+    q, k, v = make_qkv(rng, t=48, hd=16)
+    cot = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    def loss_chunked(q, k, v):
+        return jnp.sum(pk.flash_attention_lse_chunked(q, k, v, causal)[0] * cot)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal) * cot)
+
+    gc = jax.grad(loss_chunked, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gc, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_flash_chunked_supported_gating():
+    # bf16 t=16384/hd=64 is past the single-launch VMEM cap but
+    # decomposes into supported 8192-chunks.
+    shape = (1, 2, 16384, 64)
+    assert not pk.flash_supported(shape, jnp.bfloat16)
+    assert pk.flash_chunked_supported(shape, jnp.bfloat16)
+    # Single-launch shapes do NOT take the chunked path.
+    assert not pk.flash_chunked_supported((1, 2, 2048, 64), jnp.bfloat16)
+    # Tiny sequences never chunk.
+    assert not pk.flash_chunked_supported((1, 2, 64, 4), jnp.float32)

@@ -246,12 +246,24 @@ def variants(t, hd, block_q, block_k, dtype):
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    b, h, t, hd = (int(x) for x in args) if len(args) == 4 else (16, 8, 2048, 64)
     blocks = [256, 512]
-    for a in sys.argv[1:]:
+    rest = []
+    argv = sys.argv[1:]
+    i = 0
+    while i < len(argv):
+        a = argv[i]
         if a.startswith("--blocks"):
-            blocks = [int(x) for x in a.split("=")[1].split(",")]
+            # Accept both "--blocks=256,512" and "--blocks 256,512".
+            if "=" in a:
+                val = a.split("=", 1)[1]
+            else:
+                i += 1
+                val = argv[i]
+            blocks = [int(x) for x in val.split(",")]
+        else:
+            rest.append(a)
+        i += 1
+    b, h, t, hd = (int(x) for x in rest) if len(rest) == 4 else (16, 8, 2048, 64)
 
     import numpy as np
     key = jax.random.PRNGKey(0)
@@ -281,9 +293,9 @@ def main():
                 # single calls sit on a dispatch floor.  One jit'd
                 # dependent chain x = f(x) of length N is ONE dispatch;
                 # the (N2 - N1) slope cancels both dispatch and the
-                # fixed in-chain overheads.  Chains stay short (<=12)
-                # and fenced — a 30-long pallas chain once wedged the
-                # relay (CLAUDE.md).
+                # fixed in-chain overheads.  Chains stay <= 16 fwd
+                # pallas calls, under the ~30-call dependent chain
+                # that once wedged the relay (CLAUDE.md).
                 def chain(n):
                     # Min of 3: relay delays are additive one-sided
                     # noise (several ms per dispatch), so the min is
@@ -304,7 +316,14 @@ def main():
                     return best
 
                 n1, n2 = 4, 16
-                ms = (chain(n2) - chain(n1)) / (n2 - n1) * 1e3
+                # Non-positive slope = relay noise swamped the signal;
+                # retry once, then emit NaN rather than a garbage row.
+                for _ in range(2):
+                    ms = (chain(n2) - chain(n1)) / (n2 - n1) * 1e3
+                    if ms > 0:
+                        break
+                else:
+                    ms = float("nan")
                 print(f"block {block:4d} {name:10s}: {ms:7.2f} ms "
                       f"({flops / (ms * 1e-3) / 1.97e14 * 100:4.1f}% peak) "
                       f"maxerr {err:.3g}", flush=True)

@@ -66,6 +66,26 @@ def flash_8k(dtype, b):
     jax.device_get(g[0].ravel()[:1])
 
 
+def flash_16k_chunked():
+    # bf16 t=16384 exceeds the single-launch VMEM cap; the chunked
+    # decomposition (8192-chunks + lse merges) must compile and train.
+    from flexflow_tpu.ops import pallas_kernels as pk
+
+    shape = (1, 4, 16384, 64)
+    assert pk.flash_chunked_supported(shape, jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape,
+                                 jnp.bfloat16) for i in range(3))
+
+    def loss(q, k, v):
+        out, _ = pk.flash_attention_lse_chunked(q, k, v, True)
+        return jnp.sum(out.astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    val = jax.device_get(g[0].ravel()[:1])
+    assert np.isfinite(val).all()
+
+
 def flash_f32_8k_gated():
     # Measured outcome, kept as a regression probe: f32 at t=8192
     # (u = 2 MB per operand) OOMs scoped VMEM at EVERY block size
@@ -83,6 +103,7 @@ def main():
     probe("scatter/gather rows f32 d=64", rows_f32)
     probe("flash fwd+bwd bf16 t=8192", lambda: flash_8k(jnp.bfloat16, 4))
     probe("flash f32 t=8192 gated off", flash_f32_8k_gated)
+    probe("flash chunked bf16 t=16384", flash_16k_chunked)
 
 
 if __name__ == "__main__":
