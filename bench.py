@@ -273,8 +273,14 @@ def bench_op_parallel_speedup(n_devices: int = 4):
     }
     for name, build in (("vgg16", build_vgg16), ("inception", build_inception_v3)):
         try:
-            r = search_strategy(
-                build(batch_size=64), num_devices=n_devices, iters=20_000
+            # Best of 3 seeds at 100k iters (the reference runs 250k,
+            # simulator.cc:1444): VGG is converged by 20k; Inception's
+            # branch-heavy space still wiggles ~1% between seeds.
+            ff_m = build(batch_size=64)
+            r = max(
+                (search_strategy(ff_m, num_devices=n_devices,
+                                 iters=100_000, seed=s) for s in (0, 1, 2)),
+                key=lambda r: r.speedup,
             )
             out[f"{name}_speedup_sim"] = round(r.speedup, 3)
         except Exception as e:  # a catalog model must not sink the metric

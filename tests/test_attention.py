@@ -201,3 +201,26 @@ def test_dense_flash_sharded_matches_single_device(rng):
         np.asarray(outs1["attn:out"]), np.asarray(outs8["attn:out"]),
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_dense_flash_chunked_sharded_matches_single_device(rng, monkeypatch):
+    """The chunked-flash dispatch (sequence past the single-launch VMEM
+    cap) must compose with the shard_map dense path and match the
+    single-device result.  Chunking is forced at test scale by gating
+    off the single-launch kernel."""
+    from flexflow_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "flash_supported", lambda shape, dtype=None: False)
+    monkeypatch.setattr(pk, "_chunk_len",
+                        lambda t, hd, it: 16 if t % 16 == 0 else 0)
+    ff = _mha_model(batch=2, seq=64, d=16, heads=2, causal=True)
+    ex1 = Executor(ff, devices=jax.devices()[:1])
+    params, _, state = ex1.init(seed=0)
+    batch = _batch(rng, batch=2, seq=64, d=16)
+    _, outs1 = ex1.forward_step(params, state, batch)
+    ex8 = Executor(ff, strategy=StrategyStore(8, {"attn": ParallelConfig(n=2, c=2)}))
+    _, outs8 = ex8.forward_step(jax.tree.map(np.asarray, params), state, batch)
+    np.testing.assert_allclose(
+        np.asarray(outs1["attn:out"]), np.asarray(outs8["attn:out"]),
+        rtol=2e-5, atol=2e-5,
+    )

@@ -256,10 +256,14 @@ def main():
             # Accept both "--blocks=256,512" and "--blocks 256,512".
             if "=" in a:
                 val = a.split("=", 1)[1]
-            else:
+            elif i + 1 < len(argv):
                 i += 1
                 val = argv[i]
+            else:
+                sys.exit("--blocks expects a comma-separated list")
             blocks = [int(x) for x in val.split(",")]
+        elif a.startswith("--"):
+            pass  # ignore unknown flags; keep positional dims intact
         else:
             rest.append(a)
         i += 1

@@ -1,0 +1,67 @@
+"""Decompose the transformer-LM step time on the live chip.
+
+The bench headline (round 2: 113k tokens/s, MFU 0.166 at b16/t2048/L6)
+leaves ~45% of the step unexplained by the analytic flop budget at
+plausible kernel efficiencies.  This tool measures, in fresh
+subprocesses (relay-safe):
+
+  L=1 vs L=6 at b16   -> per-transformer-block ms (slope) and the
+                         embed+head+xent+optimizer intercept
+  b32 + --remat at L6 -> whether rematerialization unlocks the larger
+                         batch (round-2 sweep: b32 OOM'd) and what it
+                         yields in tokens/s
+
+Usage: python tools/profile_lm_decomp.py
+"""
+
+import os
+import subprocess
+import sys
+
+BODY = r"""
+import sys, time
+import jax
+layers, batch, remat = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.transformer import build_transformer_lm
+from flexflow_tpu.optim import AdamOptimizer
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.trainer import Trainer
+
+import os
+smoke = os.environ.get("FF_DECOMP_SMOKE") == "1"
+seq, vocab, d, iters = ((128, 512, 64, 3) if smoke
+                        else (2048, 32768, 512, 12))
+cfg = FFConfig(batch_size=batch, compute_dtype="bfloat16", remat=bool(remat))
+ff = build_transformer_lm(batch_size=batch, seq_len=seq, vocab_size=vocab,
+                          d_model=d, num_heads=8, num_layers=layers,
+                          config=cfg)
+ex = Executor(ff, optimizer=AdamOptimizer(lr=1e-4),
+              devices=jax.devices()[:1])
+stats = Trainer(ex).fit(iterations=iters, warmup=1 if smoke else 3)
+ms = 1e3 / (stats["samples_per_s"] / batch)
+print(f"RESULT L={layers} b={batch} remat={remat}: "
+      f"{ms:8.1f} ms/step  {stats['samples_per_s'] * seq:,.0f} tokens/s",
+      flush=True)
+"""
+
+
+def main():
+    os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for layers, batch, remat in ((1, 16, 0), (6, 16, 0), (6, 32, 1)):
+        r = subprocess.run(
+            [sys.executable, "-c", BODY, str(layers), str(batch), str(remat)],
+            text=True, capture_output=True,
+        )
+        for line in (r.stdout + r.stderr).splitlines():
+            if line.startswith("RESULT") or "rror" in line[:60]:
+                print(line, flush=True)
+        if r.returncode != 0 and "RESULT" not in r.stdout:
+            tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+            print(f"FAIL L={layers} b={batch} remat={remat}: "
+                  + " | ".join(tail), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,8 +82,10 @@ def timeit(step, pallas_per_step=1):
         slope = (chain(n2) - chain(n1)) / (n2 - n1) * 1e3
         if slope > 0:
             return slope
+    # stdout, not stderr: the parent sweep drops child stderr whenever
+    # stdout is non-empty, and this flag must reach the user.
     print(f"WARNING: non-positive slope {slope:.2f} ms (relay noise); "
-          f"treat this row as unreliable", file=sys.stderr)
+          f"treat this row as unreliable", flush=True)
     return float("nan")
 
 fwd_ms = timeit(lambda x: pk.flash_attention(x, k, v, True).astype(x.dtype))
