@@ -793,26 +793,66 @@ def gather_rows(table, flat_idx, interpret: Optional[bool] = None):
     return out.reshape(n, d)
 
 
-def _scatter_add_kernel(idx_ref, table_ref, upd_ref, out_ref, row_vmem,
-                        sem_in, sem_out, *, n):
-    # out_ref aliases table_ref (same HBM buffer): sequential RMW over
-    # the touched rows only; duplicates accumulate correctly.
-    def body(j, carry):
-        r = idx_ref[j]
-        cp_in = pltpu.make_async_copy(
-            out_ref.at[pl.ds(r, 1), :], row_vmem, sem_in
+def _scatter_add_kernel(meta_ref, table_ref, upd_ref, out_ref, row_vmem,
+                        sem_in, sem_out):
+    # out_ref aliases table_ref (same HBM buffer): RMW over the touched
+    # rows with double-buffered row DMAs.  The caller has collapsed
+    # duplicate-id RUNS (``_collapse_runs``): meta_ref holds
+    # [num_runs, row_0, row_1, ...] where adjacent rows always differ
+    # and upd_ref[k] is the pre-combined update for run k.
+    #
+    # Pipeline: load(k+1) overlaps store(k).  Safety argument:
+    #   - load(k+1) vs store(k): adjacent runs -> different rows.
+    #   - load(k+1) vs any store(j<=k-1): store(k-1) is waited in
+    #     iteration k before load(k+1) starts, and inductively every
+    #     earlier store was waited in its own successor iteration — so
+    #     all stores <= k-1 are complete.  Duplicate rows at ANY
+    #     distance are therefore ordered.
+    # Each semaphore is started/waited exactly once per run: load(k)
+    # waits in iteration k; store(k) waits in iteration k+1 (the final
+    # store in the epilogue).  The serial form this replaces exposed
+    # two full HBM round-trips of latency per row.
+    nr = meta_ref[0]
+
+    def load(k, buf):
+        return pltpu.make_async_copy(
+            out_ref.at[pl.ds(meta_ref[1 + k], 1), :],
+            row_vmem.at[buf], sem_in.at[buf],
         )
-        cp_in.start()
-        cp_in.wait()
-        row_vmem[...] = row_vmem[...] + upd_ref[pl.ds(j, 1), :]
-        cp_out = pltpu.make_async_copy(
-            row_vmem, out_ref.at[pl.ds(r, 1), :], sem_out
+
+    def store(k, buf):
+        return pltpu.make_async_copy(
+            row_vmem.at[buf],
+            out_ref.at[pl.ds(meta_ref[1 + k], 1), :], sem_out.at[buf],
         )
-        cp_out.start()
-        cp_out.wait()
+
+    load(0, 0).start()
+
+    def body(k, carry):
+        buf = lax.rem(k, 2)
+        nxt = 1 - buf
+        load(k, buf).wait()
+        row_vmem[buf] = row_vmem[buf] + upd_ref[pl.ds(k, 1), :]
+        store(k, buf).start()
+
+        @pl.when(k + 1 < nr)
+        def _():
+            @pl.when(k >= 1)
+            def _():
+                store(k - 1, nxt).wait()
+
+            load(k + 1, nxt).start()
+
         return carry
 
-    lax.fori_loop(0, n, body, 0)
+    lax.fori_loop(0, nr, body, 0)
+    # Drain: the last iteration skips the store(k-1) wait (no next
+    # load), so both trailing stores are waited here.
+    @pl.when(nr >= 2)
+    def _():
+        store(nr - 2, lax.rem(nr, 2)).wait()
+
+    store(nr - 1, lax.rem(nr - 1, 2)).wait()
 
 
 def scatter_add_rows(table, flat_idx, updates,
@@ -827,10 +867,14 @@ def scatter_add_rows(table, flat_idx, updates,
     packs ``128/d`` logical rows per physical row, lane-placing each
     update by one-hot expansion (exact: one-hot multiply adds zeros).
     Duplicate physical rows — duplicate ids OR distinct logical rows
-    sharing a packed row — stay correct because the kernel's RMW loop
-    is sequential.  The same reduction runs under ``interpret`` so CPU
-    tests cover it; dims fitting neither case (e.g. 96) are interpret-
-    only and raise on TPU (``rows_supported`` gates them off)."""
+    sharing a packed row — stay correct because ``_collapse_runs``
+    folds adjacent duplicates into single runs (so the pipelined
+    kernel's overlapping load/store never touch the same row) and the
+    kernel orders non-adjacent runs via its store-wait protocol; the
+    kernel must ONLY be fed run-collapsed indices.  The same reduction
+    runs under ``interpret`` so CPU tests cover it; dims fitting
+    neither case (e.g. 96) are interpret-only and raise on TPU
+    (``rows_supported`` gates them off)."""
     if interpret is None:
         interpret = _interpret_default()
     n = flat_idx.shape[0]
@@ -862,28 +906,50 @@ def scatter_add_rows(table, flat_idx, updates,
 
 
 def _scatter_rows_128(table, flat_idx, updates, interpret):
-    """The raw sequential-RMW kernel; on hardware ``table`` must be
+    """The raw RMW kernel driver; on hardware ``table`` must be
     (P, 128) (interpret mode accepts any width)."""
     n = flat_idx.shape[0]
     d = table.shape[1]
+    meta, upd_runs = _collapse_runs(flat_idx, updates.astype(table.dtype))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(1,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),      # table (HBM)
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # updates
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # per-run updates
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((1, d), table.dtype),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, 1, d), table.dtype),     # double-buffered row
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_scatter_add_kernel, n=n),
+        _scatter_add_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
         input_output_aliases={1: 0},  # inputs incl. scalar prefetch
         interpret=interpret,
-    )(flat_idx.astype(jnp.int32), table, updates.astype(table.dtype))
+    )(meta, table, upd_runs)
+
+
+def _collapse_runs(flat_idx, updates):
+    """Collapse adjacent duplicate ids into runs for the scatter
+    kernel: returns ``meta = [num_runs, row_0, row_1, ...]`` (i32,
+    n+1) and per-run summed updates (n, d).  Adjacent meta rows always
+    differ, which is what makes the kernel's load/store overlap safe;
+    non-adjacent duplicates become separate runs whose ordering the
+    kernel enforces.  Cost: one cumsum + one segment-sum over the
+    update matrix — trivial next to the row DMAs it unblocks."""
+    n = flat_idx.shape[0]
+    idx = flat_idx.astype(jnp.int32)
+    new = jnp.concatenate(
+        [jnp.ones((1,), bool), idx[1:] != idx[:-1]]
+    )
+    run_id = jnp.cumsum(new.astype(jnp.int32)) - 1
+    num_runs = run_id[-1] + 1
+    run_row = jnp.zeros((n,), jnp.int32).at[run_id].set(idx)
+    upd_runs = jax.ops.segment_sum(updates, run_id, num_segments=n)
+    meta = jnp.concatenate([num_runs[None], run_row])
+    return meta, upd_runs

@@ -254,3 +254,15 @@ def test_flash_chunked_supported_gating():
     assert not pk.flash_chunked_supported((1, 2, 2048, 64), jnp.bfloat16)
     # Tiny sequences never chunk.
     assert not pk.flash_chunked_supported((1, 2, 64, 4), jnp.float32)
+
+
+def test_scatter_add_rows_duplicate_distances(rng):
+    """The double-buffered scatter must order duplicate rows at every
+    pipeline distance (adjacent, distance-2, far), including runs."""
+    table = jnp.zeros((64, 128), jnp.float32)
+    idx = jnp.asarray([3, 3, 3, 7, 3, 9, 3, 11, 12, 3], jnp.int32)
+    upd = jnp.asarray(rng.standard_normal((10, 128)), jnp.float32)
+    out = pk.scatter_add_rows(table, idx, upd)
+    ref = np.zeros((64, 128), np.float32)
+    np.add.at(ref, np.asarray(idx), np.asarray(upd))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6, atol=1e-6)

@@ -263,10 +263,12 @@ def main():
                 sys.exit("--blocks expects a comma-separated list")
             blocks = [int(x) for x in val.split(",")]
         elif a.startswith("--"):
-            pass  # ignore unknown flags; keep positional dims intact
+            sys.exit(f"unknown flag {a!r} (only --blocks is supported)")
         else:
             rest.append(a)
         i += 1
+    if rest and len(rest) != 4:
+        sys.exit(f"expected 4 positional dims (b h t hd), got {rest}")
     b, h, t, hd = (int(x) for x in rest) if len(rest) == 4 else (16, 8, 2048, 64)
 
     import numpy as np

@@ -48,6 +48,14 @@ def rows_f32():
     np.testing.assert_allclose(got, want)
     g = pk.gather_rows(out, idx)
     np.testing.assert_allclose(jax.device_get(g[0]), got[0])
+    # Duplicate rows at every pipeline distance (the double-buffered
+    # scatter's hazard classes: adjacent run, distance-2, far).
+    idx2 = jnp.array([3, 3, 3, 7, 3, 9, 3, 11, 12, 3], jnp.int32)
+    upd2 = jnp.arange(10 * 64, dtype=jnp.float32).reshape(10, 64)
+    out2 = pk.scatter_add_rows(jnp.zeros((64, 64), jnp.float32), idx2, upd2)
+    ref2 = np.zeros((64, 64), np.float32)
+    np.add.at(ref2, np.asarray(idx2), np.asarray(upd2))
+    np.testing.assert_allclose(jax.device_get(out2), ref2)
 
 
 def flash_8k(dtype, b):

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Round-4 live-TPU measurement sequence.  Each step is gated by a
+# fresh tunnel probe (a wedged relay hangs every new backend init, so
+# continuing blind would just queue more hung processes), runs to
+# completion (NEVER timeout-killed), and logs into MEASURED_r4/.
+#
+# Usage: bash tools/run_r4_measurements.sh [from_step]
+set -u
+cd "$(dirname "$0")/.."
+OUT=MEASURED_r4
+mkdir -p "$OUT"
+FROM="${1:-1}"
+
+probe() {
+  python tools/probe_tpu.py --timeout 120 || {
+    echo "tunnel DOWN before step $1 — stopping sequence" | tee -a "$OUT/sequence.log"
+    exit 1
+  }
+}
+
+step() {  # step <n> <name> <cmd...>
+  local n="$1" name="$2"; shift 2
+  [ "$n" -lt "$FROM" ] && return 0
+  probe "$n"
+  echo "=== step $n: $name ($(date -u +%FT%TZ))" | tee -a "$OUT/sequence.log"
+  "$@" > "$OUT/$name.log" 2>&1
+  echo "rc=$? $(date -u +%FT%TZ)" >> "$OUT/$name.log"
+  tail -3 "$OUT/$name.log" | sed 's/^/    /'
+}
+
+# 1. Mosaic correctness probes (incl. the new 16k chunked flash).
+step 1 probe_kernels python tools/probe_r4_kernels.py
+
+# 2. Flash fwd variants race (chain-timed).
+step 2 flash_variants python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
+
+# 3. Block sweep with the chain-timed protocol (fwd and fwd+bwd).
+step 3 sweep_flash python tools/sweep_flash.py
+
+# 4. Transformer step decomposition (layer slope + b32 remat leg).
+step 4 lm_decomp python tools/profile_lm_decomp.py
+
+# 5. XProf device-plane op breakdown of the fused train step.
+step 5 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
+
+# 6. Full headline bench (writes the one-line JSON to its log).
+step 6 bench python bench.py
+
+# 7. Measured-mode strategy search artifact (reference cnn.h:204+ mode).
+step 7 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
+  --devices 4 --measured -o "$OUT/alexnet_strategy_measured.json"
+
+echo "sequence complete" | tee -a "$OUT/sequence.log"
