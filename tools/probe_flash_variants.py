@@ -242,7 +242,25 @@ def variants(t, hd, block_q, block_k, dtype):
             functools.partial(_v4_kernel, causal=True, scale=scale),
             q, k, v, block_q)
 
-    return {"v1_base": v1, "v2_lanes": v2, "v3_twopass": v3, "v4_fullrow": v4}
+    def v5(q, k, v):
+        # The production chunked decomposition forced to chunk=block:
+        # per (q-chunk, k-chunk) rectangles at full kernel efficiency
+        # (diagonal chunks in-kernel causal, off-diagonals unmasked),
+        # merged by XLA-level logaddexp — zero wasted masked flops.
+        from flexflow_tpu.ops import pallas_kernels as pk
+        bh, tt, dd = q.shape
+        unfold = lambda x: x.reshape(1, bh, tt, dd)
+        saved = pk._chunk_len
+        pk._chunk_len = lambda t_, hd_, it_: block_q if t_ % block_q == 0 else 0
+        try:
+            out, _ = pk.flash_attention_lse_chunked(
+                unfold(q), unfold(k), unfold(v), True)
+        finally:
+            pk._chunk_len = saved
+        return out.reshape(bh, tt, dd)
+
+    return {"v1_base": v1, "v2_lanes": v2, "v3_twopass": v3,
+            "v4_fullrow": v4, "v5_chunked": v5}
 
 
 def main():
@@ -284,6 +302,8 @@ def main():
         for name, fn in variants(t, hd, block, block, jnp.bfloat16).items():
             if name == "v4_fullrow" and block != blocks[0]:
                 continue  # block-size independent
+            if name == "v2_lanes" and block < LANES:
+                continue  # the lane-tile trick needs >= 128-wide blocks
             try:
                 jfn = jax.jit(fn)
                 out = jfn(q, k, v)

@@ -21,7 +21,7 @@ import sys
 BODY = r"""
 import sys, time
 import jax
-layers, batch, remat = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+layers, batch, remat, seq_arg = (int(x) for x in sys.argv[1:5])
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.transformer import build_transformer_lm
@@ -33,6 +33,9 @@ import os
 smoke = os.environ.get("FF_DECOMP_SMOKE") == "1"
 seq, vocab, d, iters = ((128, 512, 64, 3) if smoke
                         else (2048, 32768, 512, 12))
+if seq_arg and not smoke:
+    seq = seq_arg
+    iters = max(3, iters // max(1, seq // 2048))
 cfg = FFConfig(batch_size=batch, compute_dtype="bfloat16", remat=bool(remat))
 ff = build_transformer_lm(batch_size=batch, seq_len=seq, vocab_size=vocab,
                           d_model=d, num_heads=8, num_layers=layers,
@@ -41,7 +44,7 @@ ex = Executor(ff, optimizer=AdamOptimizer(lr=1e-4),
               devices=jax.devices()[:1])
 stats = Trainer(ex).fit(iterations=iters, warmup=1 if smoke else 3)
 ms = 1e3 / (stats["samples_per_s"] / batch)
-print(f"RESULT L={layers} b={batch} remat={remat}: "
+print(f"RESULT L={layers} b={batch} seq={seq} remat={remat}: "
       f"{ms:8.1f} ms/step  {stats['samples_per_s'] * seq:,.0f} tokens/s",
       flush=True)
 """
@@ -49,9 +52,15 @@ print(f"RESULT L={layers} b={batch} remat={remat}: "
 
 def main():
     os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    for layers, batch, remat in ((1, 16, 0), (6, 16, 0), (6, 32, 1)):
+    # (layers, batch, remat, seq): seq=0 keeps the default 2048.  The
+    # last row drives the 16k single-chip leg through the CHUNKED
+    # flash decomposition (past the single-launch VMEM cap).
+    for layers, batch, remat, seq in (
+        (1, 16, 0, 0), (6, 16, 0, 0), (6, 32, 1, 0), (6, 1, 0, 16384),
+    ):
         r = subprocess.run(
-            [sys.executable, "-c", BODY, str(layers), str(batch), str(remat)],
+            [sys.executable, "-c", BODY,
+             str(layers), str(batch), str(remat), str(seq)],
             text=True, capture_output=True,
         )
         for line in (r.stdout + r.stderr).splitlines():
@@ -59,7 +68,7 @@ def main():
                 print(line, flush=True)
         if r.returncode != 0 and "RESULT" not in r.stdout:
             tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
-            print(f"FAIL L={layers} b={batch} remat={remat}: "
+            print(f"FAIL L={layers} b={batch} remat={remat} seq={seq}: "
                   + " | ".join(tail), flush=True)
 
 
