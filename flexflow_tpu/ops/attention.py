@@ -238,12 +238,12 @@ class MultiHeadAttention(Op):
         def kernel_for(shape, dtype):
             # Single launch when the shape fits the VMEM cap; the
             # chunked decomposition (per-chunk launches + lse merges)
-            # for longer sequences; None -> einsum fallback.
-            if pallas_kernels.flash_supported(shape, dtype):
-                return lambda ql, kl, vl: pallas_kernels.flash_attention(
-                    ql, kl, vl, causal)
-            if pallas_kernels.flash_chunked_supported(shape, dtype):
-                return lambda ql, kl, vl: pallas_kernels.flash_attention_lse_chunked(
+            # for longer sequences (or when FF_FLASH_FORCE_CHUNK pins
+            # it); None -> einsum fallback.
+            if pallas_kernels.flash_supported(
+                shape, dtype
+            ) or pallas_kernels.flash_chunked_supported(shape, dtype):
+                return lambda ql, kl, vl: pallas_kernels.flash_attention_lse_auto(
                     ql, kl, vl, causal)[0]
             return None
 

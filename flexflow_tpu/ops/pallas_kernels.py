@@ -496,18 +496,35 @@ def flash_chunked_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
     return _chunk_len(t, hd, jnp.dtype(dtype).itemsize) > 0
 
 
+#: FF_FLASH_FORCE_CHUNK=<len>: route single-launch-capable shapes
+#: through the chunked decomposition at the given chunk length — the
+#: tuning knob for racing the two formulations at the fused-train-step
+#: level (tools/profile_lm_decomp.py), where measurement through the
+#: relay is trustworthy.  0 = off (normal dispatch).
+_FORCE_CHUNK = int(os.environ.get("FF_FLASH_FORCE_CHUNK", "0") or 0)
+
+
 def flash_attention_lse_auto(q, k, v, causal: bool = True,
                              interpret: Optional[bool] = None):
     """``flash_attention_lse`` when the shape fits one launch, the
     chunked decomposition when it only fits per-chunk.  Callers gate on
     ``flash_supported(...) or flash_chunked_supported(...)``."""
+    b, h, t, hd = q.shape
+    if (_FORCE_CHUNK and t > _FORCE_CHUNK and t % _FORCE_CHUNK == 0
+            and flash_supported((b, h, _FORCE_CHUNK, hd), q.dtype)):
+        # A stale/oversized env value falls through to normal dispatch
+        # rather than raising from inside the jitted forward.
+        return flash_attention_lse_chunked(
+            q, k, v, causal, interpret, chunk=_FORCE_CHUNK
+        )
     if flash_supported(q.shape, q.dtype):
         return flash_attention_lse(q, k, v, causal, interpret)
     return flash_attention_lse_chunked(q, k, v, causal, interpret)
 
 
 def flash_attention_lse_chunked(q, k, v, causal: bool = True,
-                                interpret: Optional[bool] = None):
+                                interpret: Optional[bool] = None,
+                                chunk: Optional[int] = None):
     """Flash attention for sequences past the single-launch VMEM cap
     (``_vmem_block_cap`` marks e.g. bf16 t=16384/hd=64 unsupported —
     the pipeline's resident copies alone exceed scoped VMEM).
@@ -522,11 +539,12 @@ def flash_attention_lse_chunked(q, k, v, causal: bool = True,
     score matrix.
     """
     b, h, t, hd = q.shape
-    c = _chunk_len(t, hd, q.dtype.itemsize)
-    if c == 0 or c == t:
+    c = chunk or _chunk_len(t, hd, q.dtype.itemsize)
+    if c == 0 or c == t or t % c:
         raise ValueError(
             f"flash_attention_lse_chunked: no supported chunking for "
-            f"t={t}, hd={hd}; gate on flash_chunked_supported()."
+            f"t={t}, hd={hd} (chunk={chunk}); an explicit chunk must "
+            f"divide t, and auto callers gate on flash_chunked_supported()."
         )
     nq = t // c
     sl = lambda x, i: lax.slice_in_dim(x, i * c, (i + 1) * c, axis=2)

@@ -242,25 +242,14 @@ def variants(t, hd, block_q, block_k, dtype):
             functools.partial(_v4_kernel, causal=True, scale=scale),
             q, k, v, block_q)
 
-    def v5(q, k, v):
-        # The production chunked decomposition forced to chunk=block:
-        # per (q-chunk, k-chunk) rectangles at full kernel efficiency
-        # (diagonal chunks in-kernel causal, off-diagonals unmasked),
-        # merged by XLA-level logaddexp — zero wasted masked flops.
-        from flexflow_tpu.ops import pallas_kernels as pk
-        bh, tt, dd = q.shape
-        unfold = lambda x: x.reshape(1, bh, tt, dd)
-        saved = pk._chunk_len
-        pk._chunk_len = lambda t_, hd_, it_: block_q if t_ % block_q == 0 else 0
-        try:
-            out, _ = pk.flash_attention_lse_chunked(
-                unfold(q), unfold(k), unfold(v), True)
-        finally:
-            pk._chunk_len = saved
-        return out.reshape(bh, tt, dd)
-
+    # NOTE: the chunked-decomposition candidate is deliberately NOT in
+    # this race: at chunk=256/t=2048 it issues 36 dependent pallas
+    # launches per call, so even a short two-point chain would exceed
+    # the <=24-call relay-safety cap (MEASURED_r4/README.md).  It races
+    # at the fused-train-step level instead, via FF_FLASH_FORCE_CHUNK
+    # in tools/profile_lm_decomp.py.
     return {"v1_base": v1, "v2_lanes": v2, "v3_twopass": v3,
-            "v4_fullrow": v4, "v5_chunked": v5}
+            "v4_fullrow": v4}
 
 
 def main():

@@ -44,7 +44,8 @@ ex = Executor(ff, optimizer=AdamOptimizer(lr=1e-4),
               devices=jax.devices()[:1])
 stats = Trainer(ex).fit(iterations=iters, warmup=1 if smoke else 3)
 ms = 1e3 / (stats["samples_per_s"] / batch)
-print(f"RESULT L={layers} b={batch} seq={seq} remat={remat}: "
+chunk = os.environ.get("FF_FLASH_FORCE_CHUNK", "0")
+print(f"RESULT L={layers} b={batch} seq={seq} remat={remat} chunk={chunk}: "
       f"{ms:8.1f} ms/step  {stats['samples_per_s'] * seq:,.0f} tokens/s",
       flush=True)
 """
@@ -52,16 +53,24 @@ print(f"RESULT L={layers} b={batch} seq={seq} remat={remat}: "
 
 def main():
     os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    # (layers, batch, remat, seq): seq=0 keeps the default 2048.  The
-    # last row drives the 16k single-chip leg through the CHUNKED
-    # flash decomposition (past the single-launch VMEM cap).
-    for layers, batch, remat, seq in (
-        (1, 16, 0, 0), (6, 16, 0, 0), (6, 32, 1, 0), (6, 1, 0, 16384),
+    # (layers, batch, remat, seq, chunk): seq=0 keeps the default 2048;
+    # chunk>0 exports FF_FLASH_FORCE_CHUNK, racing the chunked flash
+    # decomposition against the monolithic kernel INSIDE the fused
+    # train step (the relay-safe way — a pallas-chain microbench of the
+    # 36-launch chunked call would blow the <=24-call cap).  The
+    # seq=16384 row drives the chunked path at its real scale (past the
+    # single-launch VMEM cap).
+    for layers, batch, remat, seq, chunk in (
+        (1, 16, 0, 0, 0), (6, 16, 0, 0, 0), (6, 16, 0, 0, 512),
+        (6, 16, 0, 0, 1024), (6, 32, 1, 0, 0), (6, 1, 0, 16384, 0),
     ):
+        env = dict(os.environ)
+        if chunk:
+            env["FF_FLASH_FORCE_CHUNK"] = str(chunk)
         r = subprocess.run(
             [sys.executable, "-c", BODY,
              str(layers), str(batch), str(remat), str(seq)],
-            text=True, capture_output=True,
+            text=True, capture_output=True, env=env,
         )
         for line in (r.stdout + r.stderr).splitlines():
             if line.startswith("RESULT") or "rror" in line[:60]:
