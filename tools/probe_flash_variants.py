@@ -253,30 +253,9 @@ def variants(t, hd, block_q, block_k, dtype):
 
 
 def main():
-    blocks = [256, 512]
-    rest = []
-    argv = sys.argv[1:]
-    i = 0
-    while i < len(argv):
-        a = argv[i]
-        if a.startswith("--blocks"):
-            # Accept both "--blocks=256,512" and "--blocks 256,512".
-            if "=" in a:
-                val = a.split("=", 1)[1]
-            elif i + 1 < len(argv):
-                i += 1
-                val = argv[i]
-            else:
-                sys.exit("--blocks expects a comma-separated list")
-            blocks = [int(x) for x in val.split(",")]
-        elif a.startswith("--"):
-            sys.exit(f"unknown flag {a!r} (only --blocks is supported)")
-        else:
-            rest.append(a)
-        i += 1
-    if rest and len(rest) != 4:
-        sys.exit(f"expected 4 positional dims (b h t hd), got {rest}")
-    b, h, t, hd = (int(x) for x in rest) if len(rest) == 4 else (16, 8, 2048, 64)
+    from probe_common import chain_slope_ms, parse_dims_blocks
+
+    (b, h, t, hd), blocks = parse_dims_blocks(sys.argv[1:])
 
     import numpy as np
     key = jax.random.PRNGKey(0)
@@ -311,34 +290,15 @@ def main():
                 # fixed in-chain overheads.  Chains stay <= 16 fwd
                 # pallas calls, under the ~30-call dependent chain
                 # that once wedged the relay (CLAUDE.md).
-                def chain(n):
-                    # Min of 3: relay delays are additive one-sided
-                    # noise (several ms per dispatch), so the min is
-                    # the honest estimator of the compute time.
+                def make_run(n, fn=fn):
                     @jax.jit
                     def run(x):
                         def body(_, x):
                             return fn(x, k, v).astype(x.dtype)
                         return lax.fori_loop(0, n, body, x)
-                    y = run(q)
-                    jax.device_get(y.ravel()[:1])  # compile+warm
-                    best = float("inf")
-                    for _ in range(3):
-                        t0 = time.perf_counter()
-                        y = run(q)
-                        jax.device_get(y.ravel()[:1])
-                        best = min(best, time.perf_counter() - t0)
-                    return best
+                    return run
 
-                n1, n2 = 4, 16
-                # Non-positive slope = relay noise swamped the signal;
-                # retry once, then emit NaN rather than a garbage row.
-                for _ in range(2):
-                    ms = (chain(n2) - chain(n1)) / (n2 - n1) * 1e3
-                    if ms > 0:
-                        break
-                else:
-                    ms = float("nan")
+                ms = chain_slope_ms(make_run, q, 4, 16)
                 print(f"block {block:4d} {name:10s}: {ms:7.2f} ms "
                       f"({flops / (ms * 1e-3) / 1.97e14 * 100:4.1f}% peak) "
                       f"maxerr {err:.3g}", flush=True)

@@ -34,20 +34,23 @@ step 1 probe_kernels python tools/probe_r4_kernels.py
 # 2. Flash fwd variants race (chain-timed).
 step 2 flash_variants python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
 
-# 3. Block sweep with the chain-timed protocol (fwd and fwd+bwd).
-step 3 sweep_flash python tools/sweep_flash.py
+# 3. Flash bwd variants race (production vs 128-lane lse/delta).
+step 3 flash_bwd_variants python tools/probe_flash_bwd_variants.py 16 8 2048 64 --blocks=256,512
 
-# 4. Transformer step decomposition (layer slope + b32 remat leg).
-step 4 lm_decomp python tools/profile_lm_decomp.py
+# 4. Block sweep with the chain-timed protocol (fwd and fwd+bwd).
+step 4 sweep_flash python tools/sweep_flash.py
 
-# 5. XProf device-plane op breakdown of the fused train step.
-step 5 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
+# 5. Transformer step decomposition (layer slope + b32 remat + chunk race).
+step 5 lm_decomp python tools/profile_lm_decomp.py
 
-# 6. Full headline bench (writes the one-line JSON to its log).
-step 6 bench python bench.py
+# 6. XProf device-plane op breakdown of the fused train step.
+step 6 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
 
-# 7. Measured-mode strategy search artifact (reference cnn.h:204+ mode).
-step 7 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
+# 7. Full headline bench (writes the one-line JSON to its log).
+step 7 bench python bench.py
+
+# 8. Measured-mode strategy search artifact (reference cnn.h:204+ mode).
+step 8 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
   --devices 4 --measured -o "$OUT/alexnet_strategy_measured.json"
 
 echo "sequence complete" | tee -a "$OUT/sequence.log"
