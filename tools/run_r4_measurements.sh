@@ -28,26 +28,28 @@ step() {  # step <n> <name> <cmd...>
   tail -3 "$OUT/$name.log" | sed 's/^/    /'
 }
 
-# 1. Mosaic correctness probes (incl. the new 16k chunked flash).
+# 1. Mosaic correctness probes (incl. the new 16k chunked flash and
+# the double-buffered scatter's duplicate-distance stress).
 step 1 probe_kernels python tools/probe_r4_kernels.py
 
-# 2. Flash fwd variants race (chain-timed).
-step 2 flash_variants python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
+# 2. Full headline bench EARLY: if the tunnel dies mid-sequence the
+# round still has its primary artifact (writes one-line JSON to log).
+step 2 bench python bench.py
 
-# 3. Flash bwd variants race (production vs 128-lane lse/delta).
-step 3 flash_bwd_variants python tools/probe_flash_bwd_variants.py 16 8 2048 64 --blocks=256,512
+# 3. Flash fwd variants race (chain-timed).
+step 3 flash_variants python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
 
-# 4. Block sweep with the chain-timed protocol (fwd and fwd+bwd).
-step 4 sweep_flash python tools/sweep_flash.py
+# 4. Flash bwd variants race (production vs 128-lane lse/delta).
+step 4 flash_bwd_variants python tools/probe_flash_bwd_variants.py 16 8 2048 64 --blocks=256,512
 
-# 5. Transformer step decomposition (layer slope + b32 remat + chunk race).
-step 5 lm_decomp python tools/profile_lm_decomp.py
+# 5. Block sweep with the chain-timed protocol (fwd and fwd+bwd).
+step 5 sweep_flash python tools/sweep_flash.py
 
-# 6. XProf device-plane op breakdown of the fused train step.
-step 6 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
+# 6. Transformer step decomposition (layer slope + b32 remat + chunk race).
+step 6 lm_decomp python tools/profile_lm_decomp.py
 
-# 7. Full headline bench (writes the one-line JSON to its log).
-step 7 bench python bench.py
+# 7. XProf device-plane op breakdown of the fused train step.
+step 7 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
 
 # 8. Measured-mode strategy search artifact (reference cnn.h:204+ mode).
 step 8 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
