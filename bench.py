@@ -41,6 +41,72 @@ PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 # down for hours) and risks crowding the driver's bench timeout.
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
 
+#: Wedge-proofing (VERDICT r4 item 1): every successful real-TPU run
+#: persists its full result here (with timestamp + git SHA); when a
+#: later run falls back to CPU because the tunnel is down, the stored
+#: record rides along in the JSON under ``last_good_tpu`` so the round
+#: artifact still carries the chip numbers the round actually achieved.
+MEASURED_DIR = os.environ.get("FF_MEASURED_DIR", "MEASURED_r5")
+LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    MEASURED_DIR, "last_good_tpu_bench.json",
+)
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _count_errors(result: dict) -> int:
+    return sum(1 for k in result.get("extra", {}) if k.endswith("_error"))
+
+
+def _persist_last_good(result: dict) -> None:
+    """Atomically persist a real-TPU result, never degrading the record:
+    a flaky-tunnel run where sub-benchmarks errored must not clobber an
+    earlier complete record (write = temp + ``os.replace`` so a kill
+    mid-dump can't truncate the file either)."""
+    existing = _load_last_good()
+    if existing is not None and _count_errors(result) > _count_errors(
+        existing.get("result", {})
+    ):
+        print(
+            "not persisting degraded TPU bench "
+            f"({_count_errors(result)} errors vs existing "
+            f"{_count_errors(existing.get('result', {}))})",
+            file=sys.stderr,
+        )
+        return
+    record = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git_sha(),
+        "result": result,
+    }
+    try:
+        os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
+        tmp = LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+        os.replace(tmp, LAST_GOOD_PATH)
+    except OSError as e:
+        print(f"could not persist last-good TPU bench: {e}", file=sys.stderr)
+
+
+def _load_last_good():
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
 
 def probe_backend():
     """Decide the platform WITHOUT touching the backend in-process.
@@ -354,6 +420,10 @@ def main():
         else:  # shrunken CPU fallback: label honestly
             extra["nmt_time_s"] = round(nmt_s, 4)
             extra["nmt_iters"] = nmt_iters
+            extra["nmt_protocol_deviation"] = (
+                f"reference protocol is 10 iterations (nmt.cc:72-83); "
+                f"this CPU fallback ran {nmt_iters} on shrunken shapes"
+            )
     except Exception as e:
         extra["nmt_error"] = f"{type(e).__name__}: {e}"
     try:
@@ -386,17 +456,29 @@ def main():
             if k in extra:
                 extra[k] = None
 
-    print(
-        json.dumps(
-            {
-                "metric": "alexnet_imgs_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "images/s/chip",
-                "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
-                "extra": extra,
+    result = {
+        "metric": "alexnet_imgs_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+        "extra": extra,
+    }
+    if extra["platform"] != "cpu":
+        _persist_last_good(result)
+    elif probe_err is not None or "platform_mismatch" in extra:
+        # Genuine fallback only: a deliberate JAX_PLATFORMS=cpu run is
+        # not a tunnel-down event and must not carry the TPU record.
+        last_good = _load_last_good()
+        if last_good is not None:
+            extra["last_good_tpu"] = {
+                "note": (
+                    "this run fell back to CPU (tunnel down); the record "
+                    "below is the last successful real-TPU bench of this "
+                    "round, persisted by bench.py at measurement time"
+                ),
+                **last_good,
             }
-        )
-    )
+    print(json.dumps(result))
     return 0
 
 

@@ -3,7 +3,7 @@
 The axon relay wedges for hours at a time (every ``jax.devices()`` in
 a fresh process hangs); the only safe check is a subprocess under a
 hard timeout.  Each probe appends one line to
-``MEASURED_r4/probe_log.txt`` so the round's artifact trail shows
+``MEASURED_r5/probe_log.txt`` so the round's artifact trail shows
 exactly when the tunnel was up — or that it never was (VERDICT r3
 item 1: the evidence that measurement couldn't happen is itself the
 artifact).
@@ -19,7 +19,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "MEASURED_r4", "probe_log.txt")
+LOG = os.path.join(
+    REPO, os.environ.get("FF_MEASURED_DIR", "MEASURED_r5"), "probe_log.txt"
+)
 
 
 def probe(timeout_s: float) -> tuple:

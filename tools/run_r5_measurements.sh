@@ -1,0 +1,79 @@
+#!/usr/bin/env bash
+# Round-5 live-TPU measurement sequence.  Same discipline as round 4:
+# every step is gated by a fresh tunnel probe (a wedged relay hangs
+# every new backend init), runs to completion (NEVER timeout-killed),
+# and logs into MEASURED_r5/.
+#
+# Round-5 ordering rationale (VERDICT r4):
+#   - headline bench FIRST (item 1: the round artifact must not depend
+#     on the tunnel surviving to the end; bench.py now persists its
+#     last good TPU result to MEASURED_r5/last_good_tpu_bench.json),
+#   - then Mosaic correctness probes (ADVICE r4: the r4 scatter and
+#     chunked-flash rewrites have no committed hardware evidence),
+#   - then THREE independent guarded flash races (item 2 / weak 1: the
+#     "~2 ms fwd" claim needs >=3 independent chain-timed runs),
+#   - then backward-kernel chain timing, sweep, LM decomposition,
+#   - then ffsim calibration + prefetch A/B (items 3-4).
+#
+# Usage: bash tools/run_r5_measurements.sh [from_step]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${FF_MEASURED_DIR:-MEASURED_r5}"
+mkdir -p "$OUT"
+FROM="${1:-1}"
+
+probe() {
+  python tools/probe_tpu.py --timeout 120 || {
+    echo "tunnel DOWN before step $1 — stopping sequence" | tee -a "$OUT/sequence.log"
+    exit 1
+  }
+}
+
+step() {  # step <n> <name> <cmd...>
+  local n="$1" name="$2"; shift 2
+  [ "$n" -lt "$FROM" ] && return 0
+  probe "$n"
+  echo "=== step $n: $name ($(date -u +%FT%TZ))" | tee -a "$OUT/sequence.log"
+  "$@" > "$OUT/$name.log" 2>&1
+  echo "rc=$? $(date -u +%FT%TZ)" >> "$OUT/$name.log"
+  tail -3 "$OUT/$name.log" | sed 's/^/    /'
+}
+
+# 1. Full headline bench FIRST: the primary round artifact.  bench.py
+# persists the TPU result so a later wedge cannot erase it.
+step 1 bench python bench.py
+
+# 2. Mosaic correctness probes (r4 scatter/chunked-flash kernels that
+# shipped without hardware evidence + any r5 kernel work).
+step 2 probe_kernels python tools/probe_r4_kernels.py
+
+# 3-5. Flash fwd variant races, guarded protocol, three INDEPENDENT
+# runs (separate processes, separate compilations).
+step 3 flash_variants_a python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
+step 4 flash_variants_b python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
+step 5 flash_variants_c python tools/probe_flash_variants.py 16 8 2048 64 --blocks=256,512
+
+# 6. Flash bwd kernel chain timing (never individually timed on chip).
+step 6 flash_bwd_variants python tools/probe_flash_bwd_variants.py 16 8 2048 64 --blocks=256,512
+
+# 7. Block sweep with the chain-timed protocol (fwd and fwd+bwd).
+step 7 sweep_flash python tools/sweep_flash.py
+
+# 8. Transformer step decomposition (layer slope + remat + chunk race).
+step 8 lm_decomp python tools/profile_lm_decomp.py
+
+# 9. ffsim calibration: measured fused-step vs simulated makespan
+# (VERDICT item 3 — anchors the *_speedup_sim numbers).
+step 9 calibrate bash -c 'if [ -f tools/calibrate_ffsim.py ]; then python tools/calibrate_ffsim.py; else echo "calibrate_ffsim.py not present yet"; fi'
+
+# 10. Input-prefetch A/B (VERDICT item 4 — host-decode overlap).
+step 10 prefetch_ab bash -c 'if [ -f tools/measure_prefetch.py ]; then python tools/measure_prefetch.py; else echo "measure_prefetch.py not present yet"; fi'
+
+# 11. XProf device-plane op breakdown of the fused train step.
+step 11 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
+
+# 12. Measured-mode strategy search artifact.
+step 12 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
+  --devices 4 --measured -o "$OUT/alexnet_strategy_measured.json"
+
+echo "sequence complete $(date -u +%FT%TZ)" | tee -a "$OUT/sequence.log"

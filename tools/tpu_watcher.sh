@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Probe the axon tunnel until it recovers, then run the round-5
+# measurement sequence once and exit.  Runs detached in the
+# background; exit (success or sequence abort) is the signal that
+# either measurements landed or the tunnel dropped mid-sequence.
+#
+# The probe itself is the sanctioned safe check (subprocess under a
+# hard timeout, tools/probe_tpu.py); the sequence steps are never
+# timeout-killed.
+#
+# Usage: bash tools/tpu_watcher.sh [interval_s]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${FF_MEASURED_DIR:-MEASURED_r5}"
+mkdir -p "$OUT"
+INTERVAL="${1:-600}"
+
+while true; do
+  if python tools/probe_tpu.py --timeout 120 >> "$OUT/watcher.log" 2>&1; then
+    echo "tunnel UP at $(date -u +%FT%TZ) — starting r5 sequence" | tee -a "$OUT/watcher.log"
+    bash tools/run_r5_measurements.sh >> "$OUT/watcher.log" 2>&1
+    rc=$?
+    echo "sequence exited rc=$rc at $(date -u +%FT%TZ)" | tee -a "$OUT/watcher.log"
+    exit "$rc"
+  fi
+  sleep "$INTERVAL"
+done
