@@ -65,23 +65,33 @@ def _git_sha() -> str:
         return "unknown"
 
 
-def _count_errors(result: dict) -> int:
-    return sum(1 for k in result.get("extra", {}) if k.endswith("_error"))
+def _quality(result: dict) -> tuple:
+    """Orderable richness of a bench record: fewer hard sub-benchmark
+    failures first, then more metrics present.  ``dlrm_sparse_error``
+    is a partial-degradation marker (the dense measurement still
+    landed), not a missing metric, so it doesn't count as hard."""
+    extra = result.get("extra", {})
+    hard = sum(
+        1 for k in extra
+        if k.endswith("_error") and k != "dlrm_sparse_error"
+    )
+    metrics = sum(1 for k in extra if not k.endswith("_error"))
+    return (-hard, metrics)
 
 
 def _persist_last_good(result: dict) -> None:
     """Atomically persist a real-TPU result, never degrading the record:
     a flaky-tunnel run where sub-benchmarks errored must not clobber an
-    earlier complete record (write = temp + ``os.replace`` so a kill
+    earlier richer record (write = temp + ``os.replace`` so a kill
     mid-dump can't truncate the file either)."""
     existing = _load_last_good()
-    if existing is not None and _count_errors(result) > _count_errors(
+    if existing is not None and _quality(result) < _quality(
         existing.get("result", {})
     ):
         print(
             "not persisting degraded TPU bench "
-            f"({_count_errors(result)} errors vs existing "
-            f"{_count_errors(existing.get('result', {}))})",
+            f"(quality {_quality(result)} vs existing "
+            f"{_quality(existing.get('result', {}))})",
             file=sys.stderr,
         )
         return
@@ -134,8 +144,17 @@ def probe_backend():
             )
             if out.returncode == 0 and "PLATFORM=" in out.stdout:
                 fields = out.stdout.split("PLATFORM=")[1].split()
-                return fields[0], int(fields[1]), None
-            last_err = f"probe rc={out.returncode}: {out.stderr.strip()[-500:]}"
+                if fields[0] != "cpu":
+                    return fields[0], int(fields[1]), None
+                # jax initialized but silently fell back to CPU: that is
+                # a tunnel-down event (same as probe_tpu.py's DOWN), not
+                # a deliberate CPU run — record the error so the
+                # last-good TPU record still rides along.
+                last_err = "probe fell back to cpu (tunnel down?)"
+            else:
+                last_err = (
+                    f"probe rc={out.returncode}: {out.stderr.strip()[-500:]}"
+                )
         except subprocess.TimeoutExpired:
             last_err = f"probe timed out after {PROBE_TIMEOUT_S}s (backend hang)"
         if attempt < PROBE_RETRIES - 1:

@@ -39,6 +39,23 @@ _NEG_INF = -1e30
 _merge_lse = pallas_kernels.merge_lse
 
 
+def _einsum_attention(q, k, v, causal: bool):
+    """Dense reference attention on (b, h, t, hd) heads, f32 scores;
+    returns the input dtype.  The fallback when no flash formulation
+    applies — including inside a ``shard_map``ped local shard, where it
+    is numerically identical to the flash kernel it replaces."""
+    dtype = q.dtype
+    q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = scores.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    attn = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", attn, v).astype(dtype)
+
+
 class LayerNorm(Op):
     """Layer normalization over the last (feature) dim."""
 
@@ -208,19 +225,9 @@ class MultiHeadAttention(Op):
 
     def _attend_dense(self, q, k, v, dtype):
         q, k, v = map(self._split_heads, (q, k, v))
-        causal = self.attrs["causal"]
         out = self._flash_dense(q, k, v)
-        if out is not None:
-            return self._merge_heads(out, dtype)
-        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        if causal:
-            t = scores.shape[-1]
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        attn = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        if out is None:
+            out = _einsum_attention(q, k, v, self.attrs["causal"])
         return self._merge_heads(out, dtype)
 
     def _flash_dense(self, q, k, v):
@@ -240,12 +247,21 @@ class MultiHeadAttention(Op):
             # chunked decomposition (per-chunk launches + lse merges)
             # for longer sequences (or when FF_FLASH_FORCE_CHUNK pins
             # it); None -> einsum fallback.
-            if pallas_kernels.flash_supported(
-                shape, dtype
-            ) or pallas_kernels.flash_chunked_supported(shape, dtype):
-                return lambda ql, kl, vl: pallas_kernels.flash_attention_lse_auto(
-                    ql, kl, vl, causal)[0]
-            return None
+            if not (pallas_kernels.flash_supported(shape, dtype)
+                    or pallas_kernels.flash_chunked_supported(shape, dtype)):
+                return None
+
+            def fn(ql, kl, vl):
+                res = pallas_kernels.flash_attention_lse_auto(ql, kl, vl, causal)
+                if res is None:
+                    # Support gates said yes but the dispatcher
+                    # declined — only reachable if the two ever drift;
+                    # the local einsum keeps the jitted forward alive
+                    # (and is exact) even under the shard_map wrapper.
+                    return _einsum_attention(ql, kl, vl, causal)
+                return res[0]
+
+            return fn
 
         plan = getattr(self, "_plan", None)
         if plan is None or plan.num_devices == 1:
@@ -338,7 +354,11 @@ class MultiHeadAttention(Op):
         """
         causal = self.attrs["causal"]
         ring = [(i, (i + 1) % S) for i in range(S)]
-        o, lse = pallas_kernels.flash_attention_lse_auto(qh, kh, vh, causal)
+        # _attend_ring's use_flash gate mirrors the dispatcher's own
+        # support checks, so auto cannot return its None fallback here.
+        res = pallas_kernels.flash_attention_lse_auto(qh, kh, vh, causal)
+        assert res is not None, "gated caller: flash must be supported"
+        o, lse = res
         o = o.astype(jnp.float32)
         k_cur, v_cur = kh, vh
         for j in range(1, S):
@@ -346,7 +366,9 @@ class MultiHeadAttention(Op):
             v_cur = lax.ppermute(v_cur, tuple(s_entry), ring)
 
             def attend(kc=k_cur, vc=v_cur):
-                o_j, lse_j = pallas_kernels.flash_attention_lse_auto(qh, kc, vc, False)
+                r = pallas_kernels.flash_attention_lse_auto(qh, kc, vc, False)
+                assert r is not None, "gated caller: flash must be supported"
+                o_j, lse_j = r
                 return o_j.astype(jnp.float32), lse_j
 
             if causal:

@@ -507,8 +507,11 @@ _FORCE_CHUNK = int(os.environ.get("FF_FLASH_FORCE_CHUNK", "0") or 0)
 def flash_attention_lse_auto(q, k, v, causal: bool = True,
                              interpret: Optional[bool] = None):
     """``flash_attention_lse`` when the shape fits one launch, the
-    chunked decomposition when it only fits per-chunk.  Callers gate on
-    ``flash_supported(...) or flash_chunked_supported(...)``."""
+    chunked decomposition when it only fits per-chunk, ``None`` when no
+    flash formulation supports the shape — callers take None as the
+    fall-back-to-dense signal instead of catching a trace-time raise
+    (keeps the einsum path reachable if the support gates and this
+    dispatcher ever diverge)."""
     b, h, t, hd = q.shape
     if (_FORCE_CHUNK and t > _FORCE_CHUNK and t % _FORCE_CHUNK == 0
             and flash_supported((b, h, _FORCE_CHUNK, hd), q.dtype)):
@@ -519,7 +522,9 @@ def flash_attention_lse_auto(q, k, v, causal: bool = True,
         )
     if flash_supported(q.shape, q.dtype):
         return flash_attention_lse(q, k, v, causal, interpret)
-    return flash_attention_lse_chunked(q, k, v, causal, interpret)
+    if flash_chunked_supported(q.shape, q.dtype):
+        return flash_attention_lse_chunked(q, k, v, causal, interpret)
+    return None
 
 
 def flash_attention_lse_chunked(q, k, v, causal: bool = True,
@@ -897,6 +902,13 @@ def scatter_add_rows(table, flat_idx, updates,
         interpret = _interpret_default()
     n = flat_idx.shape[0]
     num_rows, d = table.shape
+    if n == 0:
+        # Degenerate batch: the pipelined kernel unconditionally starts
+        # load(0) and waits the drain store(nr-1), both invalid at
+        # nr=0, and _collapse_runs' run_id[-1] traces an IndexError.
+        # Static shape, so a Python-level no-op preserves the old
+        # sequential kernel's behavior.
+        return table
     if d != 128:
         if d % 128 == 0:
             c = d // 128

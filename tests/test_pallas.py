@@ -266,3 +266,27 @@ def test_scatter_add_rows_duplicate_distances(rng):
     ref = np.zeros((64, 128), np.float32)
     np.add.at(ref, np.asarray(idx), np.asarray(upd))
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6, atol=1e-6)
+
+
+def test_scatter_add_rows_empty_batch():
+    """n=0 must no-op (ADVICE r4: the pipelined kernel's load(0)/
+    drain-wait are invalid at zero runs; a Python-level guard returns
+    the table unchanged)."""
+    table = jnp.asarray(np.arange(64 * 128, dtype=np.float32).reshape(64, 128))
+    idx = jnp.zeros((0,), jnp.int32)
+    upd = jnp.zeros((0, 128), jnp.float32)
+    out = pk.scatter_add_rows(table, idx, upd)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(table))
+    # And under jit, where the trace-time IndexError used to surface.
+    out_j = jax.jit(pk.scatter_add_rows)(table, idx, upd)
+    np.testing.assert_array_equal(np.asarray(out_j), np.asarray(table))
+
+
+def test_flash_auto_unsupported_returns_none():
+    """The dispatcher signals fallback with None instead of raising
+    from inside a jitted forward (ADVICE r4)."""
+    shape = (1, 2, 8, 4)  # too short for any flash formulation
+    assert not pk.flash_supported(shape, jnp.float32)
+    assert not pk.flash_chunked_supported(shape, jnp.float32)
+    q = jnp.zeros(shape, jnp.float32)
+    assert pk.flash_attention_lse_auto(q, q, q) is None
