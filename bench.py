@@ -76,7 +76,11 @@ def _quality(result: dict) -> tuple:
         if k.endswith("_error") and k != "dlrm_sparse_error"
     )
     metrics = sum(1 for k in extra if not k.endswith("_error"))
-    return (-hard, metrics)
+    # Soft markers break ties: a clean record beats a sparse-fallback
+    # record with the same metric set (its dlrm number is the 3.6x
+    # slower dense path).
+    soft = sum(1 for k in extra if k == "dlrm_sparse_error")
+    return (-hard, metrics, -soft)
 
 
 def _persist_last_good(result: dict) -> None:

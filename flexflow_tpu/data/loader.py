@@ -112,7 +112,9 @@ class PrefetchLoader:
         self._source = iter(source)
         self._place = place_fn
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="ff-prefetch"
+        )
         self._thread.start()
 
     def _worker(self):

@@ -53,9 +53,18 @@ class Trainer:
         save_every: int = 0,
         resume: bool = True,
         accum_steps: int = 1,
+        prefetch: int = 2,
     ) -> Dict[str, float]:
         """Run ``iterations`` steps; returns throughput stats computed
         with the reference formula.
+
+        User-supplied ``batches`` are double-buffered by default: a
+        background thread runs the host path (decode/gather) and the
+        H2D ``shard_batch`` for batch i+1 while step i executes on
+        device — the reference's zero-copy staging + in-trace gather
+        overlap (``dlrm.cu:20-50``, ``dlrm.cc:151-156``).  ``prefetch``
+        sets the queue depth (0 restores the synchronous path; a
+        ``PrefetchLoader`` passed in is used as-is, caller-owned).
 
         With ``checkpoint`` (a ``CheckpointManager``) the run resumes
         from the latest saved step when ``resume`` and saves every
@@ -77,93 +86,113 @@ class Trainer:
                     templates=(params, opt_state, state)
                 )
                 print(f"resumed from step {start_step}")
+        from flexflow_tpu.data.loader import PrefetchLoader
+
+        owned_prefetch = None
         if batches is None:
             fixed = self.synthetic_batch()
             batches = iter(lambda: fixed, None)  # infinite
+        elif isinstance(batches, PrefetchLoader):
+            pass  # caller-owned prefetch; already device-placing
+        elif prefetch > 0:
+            # Bounded to exactly the batches this run consumes, so the
+            # worker never pulls ahead past the run and a caller-reused
+            # iterator loses nothing (the synchronous path's contract).
+            import itertools
+
+            owned_prefetch = PrefetchLoader(
+                itertools.islice(iter(batches), warmup + iterations),
+                ex.shard_batch, depth=prefetch,
+            )
+            batches = owned_prefetch
         else:
             raw = iter(batches)
             # Place each host batch in its consumers' shardings (no-op
             # for already-placed arrays) — the ZC-memory gather path.
             batches = (ex.shard_batch(b) for b in raw)
 
-        # Warmup (compile) outside the timed region — the reference's
-        # init_layers()+first-iteration cuDNN algo search equivalent.
-        # Warmup steps are REAL optimizer updates (train_step donates its
-        # inputs, so they can't be discarded); count them in the step
-        # numbering so checkpoint steps always equal applied updates.
-        m = None
-        for _ in range(warmup):
-            batch = next(batches)
-            params, opt_state, state, m = step_fn(params, opt_state, state, batch)
-        start_step += warmup
-        if m is not None:
-            jax.device_get(m)  # host readback: the only reliable fence on the relay
-
-        assert iterations > 0, "fit() needs at least one iteration"
-        trace_ctx = contextlib.nullcontext()
-        if ex.config.trace_dir:
-            # --trace DIR: XProf capture of the timed loop (the fused
-            # step as XLA runs it — the observability the reference's
-            # per-task cudaEvent prints could not give).
-            from flexflow_tpu.runtime.profiler import trace
-
-            trace_ctx = trace(ex.config.trace_dir)
-        ckpt_s = 0.0  # checkpoint I/O time, excluded from throughput
-        with trace_ctx:
-            # Both timestamps live INSIDE the trace context so neither
-            # start_trace spin-up nor stop_trace serialization is
-            # billed to the timed loop.
-            start = time.perf_counter()
-            for it in range(iterations):
+        try:
+            # Warmup (compile) outside the timed region — the reference's
+            # init_layers()+first-iteration cuDNN algo search equivalent.
+            # Warmup steps are REAL optimizer updates (train_step donates its
+            # inputs, so they can't be discarded); count them in the step
+            # numbering so checkpoint steps always equal applied updates.
+            m = None
+            for _ in range(warmup):
                 batch = next(batches)
-                params, opt_state, state, m = step_fn(
-                    params, opt_state, state, batch
-                )
-                if log_every and (it + 1) % log_every == 0:
-                    self.metrics.update(jax.device_get(m))
-                    print(f"iter {it+1}: {self.metrics.report()}")
-                if checkpoint is not None and save_every and (it + 1) % save_every == 0:
-                    jax.device_get(m)  # fence: don't bill queued compute to I/O
-                    t0 = time.perf_counter()
-                    checkpoint.save(start_step + it + 1, params, opt_state, state)
-                    ckpt_s += time.perf_counter() - t0
-            # The execution fence (dlrm.cc:159-162): a host readback of
-            # the final step's metrics; the step chain serializes
-            # through params.  elapsed is taken here, INSIDE the trace
-            # context, so stop_trace's xplane serialization is not
-            # billed to the timed loop.
-            final_m = jax.device_get(m)
-            elapsed = time.perf_counter() - start - ckpt_s
+                params, opt_state, state, m = step_fn(params, opt_state, state, batch)
+            start_step += warmup
+            if m is not None:
+                jax.device_get(m)  # host readback: the only reliable fence on the relay
 
-        self.metrics.update(final_m)
-        if checkpoint is not None:
-            checkpoint.save(start_step + iterations, params, opt_state, state)
-        if ex.config.profiling:
-            # --profiling: per-op breakdown, the reference's per-task
-            # cudaEvent timings (conv_2d.cu:515-546).
-            if isinstance(ex, Executor):
-                from flexflow_tpu.runtime.profiler import profile_ops, report
+            assert iterations > 0, "fit() needs at least one iteration"
+            trace_ctx = contextlib.nullcontext()
+            if ex.config.trace_dir:
+                # --trace DIR: XProf capture of the timed loop (the fused
+                # step as XLA runs it — the observability the reference's
+                # per-task cudaEvent prints could not give).
+                from flexflow_tpu.runtime.profiler import trace
 
-                print(report(profile_ops(ex, params, state, batch)))
-            else:
-                print("profiling: per-op breakdown unavailable for "
-                      "pipeline executors")
-        batch_size = ex.model.input_tensors[0].shape[0]
-        throughput = iterations * batch_size / elapsed
-        # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).
-        print(f"time = {elapsed:.4f}s")
-        print(f"tp = {throughput:.2f} samples/s")
-        #: Public contract: the trained (params, opt_state, state) of
-        #: the run that just finished — for post-training evaluation
-        #: or manual checkpointing.
-        self.final = (params, opt_state, state)
-        return {
-            "elapsed_s": elapsed,
-            "samples_per_s": throughput,
-            "iterations": iterations,
-            "batch_size": batch_size,
-            "loss": float(self.metrics.avg_loss),
-        }
+                trace_ctx = trace(ex.config.trace_dir)
+            ckpt_s = 0.0  # checkpoint I/O time, excluded from throughput
+            with trace_ctx:
+                # Both timestamps live INSIDE the trace context so neither
+                # start_trace spin-up nor stop_trace serialization is
+                # billed to the timed loop.
+                start = time.perf_counter()
+                for it in range(iterations):
+                    batch = next(batches)
+                    params, opt_state, state, m = step_fn(
+                        params, opt_state, state, batch
+                    )
+                    if log_every and (it + 1) % log_every == 0:
+                        self.metrics.update(jax.device_get(m))
+                        print(f"iter {it+1}: {self.metrics.report()}")
+                    if checkpoint is not None and save_every and (it + 1) % save_every == 0:
+                        jax.device_get(m)  # fence: don't bill queued compute to I/O
+                        t0 = time.perf_counter()
+                        checkpoint.save(start_step + it + 1, params, opt_state, state)
+                        ckpt_s += time.perf_counter() - t0
+                # The execution fence (dlrm.cc:159-162): a host readback of
+                # the final step's metrics; the step chain serializes
+                # through params.  elapsed is taken here, INSIDE the trace
+                # context, so stop_trace's xplane serialization is not
+                # billed to the timed loop.
+                final_m = jax.device_get(m)
+                elapsed = time.perf_counter() - start - ckpt_s
+
+            self.metrics.update(final_m)
+            if checkpoint is not None:
+                checkpoint.save(start_step + iterations, params, opt_state, state)
+            if ex.config.profiling:
+                # --profiling: per-op breakdown, the reference's per-task
+                # cudaEvent timings (conv_2d.cu:515-546).
+                if isinstance(ex, Executor):
+                    from flexflow_tpu.runtime.profiler import profile_ops, report
+
+                    print(report(profile_ops(ex, params, state, batch)))
+                else:
+                    print("profiling: per-op breakdown unavailable for "
+                          "pipeline executors")
+            batch_size = ex.model.input_tensors[0].shape[0]
+            throughput = iterations * batch_size / elapsed
+            # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).
+            print(f"time = {elapsed:.4f}s")
+            print(f"tp = {throughput:.2f} samples/s")
+            #: Public contract: the trained (params, opt_state, state) of
+            #: the run that just finished — for post-training evaluation
+            #: or manual checkpointing.
+            self.final = (params, opt_state, state)
+            return {
+                "elapsed_s": elapsed,
+                "samples_per_s": throughput,
+                "iterations": iterations,
+                "batch_size": batch_size,
+                "loss": float(self.metrics.avg_loss),
+            }
+        finally:
+            if owned_prefetch is not None:
+                owned_prefetch.close()
 
     def evaluate(
         self,

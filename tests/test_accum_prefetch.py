@@ -167,3 +167,64 @@ def test_accum_rejects_sum_reduction(rng):
     ex = Executor(ff, optimizer=SGDOptimizer(lr=0.1), devices=jax.devices()[:1])
     with pytest.raises(ValueError, match="mean-reduction"):
         ex.accum_train_step(2)
+
+
+def _fit_fixture(rng):
+    ex = Executor(_model(8), optimizer=SGDOptimizer(lr=0.1),
+                  devices=jax.devices()[:1])
+    arrays = {
+        "x": rng.standard_normal((64, 16)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(64,)).astype(np.int32),
+    }
+    return ex, arrays
+
+
+def test_fit_owns_prefetch_and_closes(rng):
+    """Trainer.fit wraps plain host batches in a PrefetchLoader by
+    default (VERDICT r4 item 4) and stops the worker on return."""
+    import threading
+    import time
+
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    def prefetch_workers():
+        return [t for t in threading.enumerate()
+                if t.name == "ff-prefetch" and t.is_alive()]
+
+    ex, arrays = _fit_fixture(rng)
+    loader = ArrayDataLoader(arrays, 8, shuffle=False)
+    stats = Trainer(ex).fit(iterations=4, batches=iter(loader), warmup=1)
+    assert stats["samples_per_s"] > 0
+    # The owned worker must be closed (give the daemon a beat to exit).
+    deadline = time.time() + 5.0
+    while prefetch_workers() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not prefetch_workers()
+
+
+def test_fit_prefetch_zero_matches_sync(rng):
+    """prefetch=0 restores the synchronous path with identical numerics
+    (same source order, same seed => same final loss)."""
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    ex, arrays = _fit_fixture(rng)
+
+    def run(depth):
+        loader = ArrayDataLoader(arrays, 8, shuffle=False)
+        return Trainer(ex).fit(iterations=4, batches=iter(loader),
+                               warmup=1, prefetch=depth)["loss"]
+
+    assert run(0) == pytest.approx(run(2), rel=1e-5)
+
+
+def test_fit_prefetch_consumes_exactly(rng):
+    """The owned prefetcher must pull exactly warmup+iterations batches
+    from a caller-supplied iterator — reuse after fit() sees the rest."""
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    ex, arrays = _fit_fixture(rng)
+    loader = ArrayDataLoader(arrays, 8, shuffle=False)
+    src = itertools.islice(iter(loader), 8)  # one epoch, 8 batches
+    Trainer(ex).fit(iterations=4, batches=src, warmup=1)  # consumes 5
+    leftovers = sum(1 for _ in src)
+    assert leftovers == 3, f"prefetch over-consumed: {leftovers} left of 3"
