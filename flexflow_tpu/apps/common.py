@@ -49,7 +49,8 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --lr-schedule constant|cosine|step  --warmup N  --decay-steps N
   --min-lr F  --lr-gamma F (adam only)
   --profiling   --dry-run   --remat   --trace DIR   --ones-init
-  --accum-steps N   --microbatches N   --granules N   --zero-opt
+  --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
+  --granules N   --zero-opt
   --eval-iters N (held-out eval after training)   --clip-norm F
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)"""
@@ -237,6 +238,7 @@ def run_training(
         optimizer=make_optimizer(cfg),
         mesh_plan=mesh_plan,
         microbatches=cfg.microbatches,
+        schedule=cfg.pipeline_schedule,
     )
     if isinstance(ex, PipelineExecutor):
         if cfg.accum_steps > 1:

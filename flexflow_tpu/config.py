@@ -79,6 +79,9 @@ class FFConfig:
     granules: int = 0
     # Pipeline microbatches for device-subset (layer-wise) strategies.
     microbatches: int = 1
+    # --pipeline-schedule 1f1b|gpipe: stage-program dispatch order
+    # (1f1b bounds live activations per stage; gpipe = fill then drain).
+    pipeline_schedule: str = "1f1b"
     # Compute-free graph/shape validation (the reference's
     # DISABLE_COMPUTATION build, ``ops.h:19``): trace the full train
     # step under jax.eval_shape and print the op/param table, running
@@ -201,6 +204,13 @@ class FFConfig:
                 cfg.granules = int(_next())
             elif a == "--microbatches":
                 cfg.microbatches = int(_next())
+            elif a == "--pipeline-schedule":
+                cfg.pipeline_schedule = _next()
+                if cfg.pipeline_schedule not in ("1f1b", "gpipe"):
+                    raise SystemExit(
+                        f"--pipeline-schedule must be 1f1b or gpipe, "
+                        f"got {cfg.pipeline_schedule!r}"
+                    )
             elif a == "--search":
                 cfg.search_iters = cfg.search_iters or 20_000
             elif a == "--search-iters":

@@ -208,6 +208,7 @@ class PipelineExecutor:
         optimizer: Optional[SGDOptimizer] = None,
         devices: Optional[Sequence[jax.Device]] = None,
         microbatches: int = 1,
+        schedule: str = "1f1b",
     ):
         self.model = model
         self.config = config or model.config
@@ -225,6 +226,15 @@ class PipelineExecutor:
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
         self.microbatches = microbatches
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = schedule
+        #: dispatch-order event trace of the last train_step — a list of
+        #: ("F"|"B", stage, microbatch); tests and the dry run verify
+        #: the schedule by EVENT ORDER, not wall clock (the virtual
+        #: mesh multiplexes one core, PIPELINE_OVERHEAD.md).
+        self.last_schedule: List[Tuple[str, int, int]] = []
+        self._zero_douts: Dict[Tuple, jax.Array] = {}
         all_devices = list(devices) if devices is not None else jax.devices()
         self.stages = derive_stages(model, strategy)
 
@@ -384,6 +394,21 @@ class PipelineExecutor:
         spec = self._spec_of[name]
         return jax.device_put(x, ex.input_sharding(spec))
 
+    @functools.cached_property
+    def _in_shardings(self) -> List[Dict[str, Any]]:
+        """Per-stage input shardings, precomputed so a stage's whole
+        input set moves in ONE ``jax.device_put`` call (host dispatch is
+        the pipeline's measured bottleneck, PIPELINE_OVERHEAD.md)."""
+        return [
+            {n: self.stage_ex[si].input_sharding(self._spec_of[n])
+             for n in st.in_names}
+            for si, st in enumerate(self.stages)
+        ]
+
+    def _put_stage_many(self, si: int, values: Dict[str, Any]) -> Dict[str, Any]:
+        sh = self._in_shardings[si]
+        return jax.device_put(values, {n: sh[n] for n in values})
+
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         """Graph inputs land on the stage that consumes them."""
         out = dict(batch)
@@ -409,28 +434,116 @@ class PipelineExecutor:
             outs.append(piece)
         return outs
 
+    def build_schedule(self, S: int, m: int) -> List[Tuple[str, int, int]]:
+        """Dispatch-order event list ``("F"|"B", stage, microbatch)``.
+
+        ``gpipe``: all forwards (fill), then all backwards (drain) —
+        every microbatch's activations live simultaneously.
+
+        ``1f1b``: each stage runs ``min(m, S-1-si)`` warmup forwards,
+        then alternates one-backward-one-forward, then drains — at most
+        ``S-si`` activations live per stage, and backwards start before
+        the fill completes (Megatron-LM's non-interleaved schedule; the
+        reference gets the equivalent overlap from Legion dataflow,
+        ``rnn.cu:519-557``).  Per-stage sequences are merged by a
+        discrete-slot simulation: an op dispatches in the first slot
+        after its dependency (F on F of the previous stage, B on B of
+        the next stage, same microbatch) so the emitted order is a
+        valid async-dispatch order for the per-device program queues.
+        """
+        if self.schedule == "gpipe":
+            return (
+                [("F", si, mi) for mi in range(m) for si in range(S)]
+                + [("B", si, mi) for mi in range(m)
+                   for si in range(S - 1, -1, -1)]
+            )
+        seqs: List[List[Tuple[str, int]]] = []
+        for si in range(S):
+            w = min(m, S - 1 - si)
+            seq = [("F", j) for j in range(w)]
+            for j in range(m - w):
+                seq.append(("F", j + w))
+                seq.append(("B", j))
+            seq += [("B", j) for j in range(m - w, m)]
+            seqs.append(seq)
+        done: set = set()
+        ptr = [0] * S
+        events: List[Tuple[str, int, int]] = []
+        while any(ptr[si] < len(seqs[si]) for si in range(S)):
+            fired: List[Tuple[str, int, int]] = []
+            for si in range(S):
+                if ptr[si] >= len(seqs[si]):
+                    continue
+                kind, mi = seqs[si][ptr[si]]
+                dep = (
+                    None if (kind == "F" and si == 0)
+                    or (kind == "B" and si == S - 1)
+                    else (kind, si - 1 if kind == "F" else si + 1, mi)
+                )
+                if dep is None or dep in done:
+                    fired.append((kind, si, mi))
+                    ptr[si] += 1
+            if not fired:  # cannot happen for well-formed sequences
+                raise RuntimeError("pipeline schedule deadlock")
+            events.extend(fired)
+            done.update(fired)
+        return events
+
+    def _zero_dout(self, si: int, name: str, y):
+        """Cached zero cotangent for an output with no downstream
+        gradient — identical every microbatch and step, so one device
+        buffer serves all of them (never donated)."""
+        key = (si, name, tuple(y.shape), str(y.dtype))
+        z = self._zero_douts.get(key)
+        if z is None:
+            z = self._zero_douts[key] = jax.device_put(
+                jnp.zeros(y.shape, y.dtype),
+                self.stage_ex[si].output_sharding(
+                    self._producer[name], self._spec_of[name]
+                ),
+            )
+        return z
+
     def train_step(self, params, opt_state, state, batch):
         """One optimizer step: microbatched pipelined fwd+bwd, grads
-        meaned over microbatches, per-stage optimizer updates."""
+        meaned over microbatches, per-stage optimizer updates.  Stage
+        programs dispatch in ``build_schedule`` order (1F1B by
+        default); numerics are schedule-invariant — per-stage gradient
+        accumulation still runs in microbatch order."""
         m = self.microbatches
         S = len(self.stages)
         micros = self._split_micro(batch, m)
         graph_inputs = {t.name for t in self.model.input_tensors}
 
-        # Forward (fill): per microbatch, stage by stage.  Stage state
-        # threads sequentially through microbatches (BN running stats).
+        # Stage state threads sequentially through microbatches (BN
+        # running stats) — both schedules fire a stage's forwards in
+        # microbatch order, so the threading is schedule-invariant.
         stage_state = dict(state)
         stage_inputs: List[List[Dict[str, Any]]] = [[None] * S for _ in range(m)]
         fwd_state: List[List[Any]] = [[None] * S for _ in range(m)]
         boundary: List[Dict[str, Any]] = [dict() for _ in range(m)]
-        for mi, micro in enumerate(micros):
-            for si, st in enumerate(self.stages):
-                inputs = {}
-                for n in st.in_names:
-                    if n in graph_inputs:
-                        inputs[n] = self._put_stage(si, n, micro[n])
-                    else:
-                        inputs[n] = self._put_stage(si, n, boundary[mi][n])
+        dloss_seed = jnp.float32(1.0 / m)
+        grads = {si: None for si in range(S)}
+        metrics_acc: Dict[str, jax.Array] = {}
+        # name -> list of cotangent contributions per microbatch (one
+        # per consumer stage; a skip connection consumed by several
+        # later stages contributes several — they SUM, on the
+        # producer's mesh).
+        dout_back: List[Dict[str, List[Any]]] = [dict() for _ in range(m)]
+
+        events = self.build_schedule(S, m)
+        self.last_schedule = events
+        for kind, si, mi in events:
+            st = self.stages[si]
+            if kind == "F":
+                vals = {
+                    n: (micros[mi][n] if n in graph_inputs
+                        else boundary[mi][n])
+                    for n in st.in_names
+                }
+                # One device_put moves the whole input set (dispatch
+                # cost is per call, not per array).
+                inputs = self._put_stage_many(si, vals)
                 stage_inputs[mi][si] = inputs
                 fwd_state[mi][si] = stage_state[si]
                 outs, _, _, new_state = self._fwd_fns[si](
@@ -438,53 +551,51 @@ class PipelineExecutor:
                 )
                 stage_state[si] = new_state
                 boundary[mi].update(outs)
-
-        # Backward (drain): reverse stage order; douts flow back across
-        # submeshes; grads accumulate per stage.
-        dloss_seed = jnp.float32(1.0 / m)
-        grads = {si: None for si in range(S)}
-        metrics_acc: Dict[str, jax.Array] = {}
-        for mi in range(m):
-            # name -> list of cotangent contributions (one per consumer
-            # stage; a skip connection consumed by several later stages
-            # contributes several — they SUM, on the producer's mesh).
-            dout_back: Dict[str, List[Any]] = {}
-            for si in range(S - 1, -1, -1):
-                st = self.stages[si]
-                ex = self.stage_ex[si]
-                douts = {}
-                for n in st.out_names:
-                    if n in dout_back:
-                        sh = ex.output_sharding(
-                            self._producer[n], self._spec_of[n]
-                        )
-                        parts = [
-                            jax.device_put(g, sh) for g in dout_back[n]
-                        ]
-                        total = parts[0]
-                        for p in parts[1:]:
-                            total = total + p
-                        douts[n] = total
-                    else:
-                        # Output unused downstream-gradient-wise; shape
-                        # from the actual microbatch value, not the
-                        # declared (full-batch) spec.
-                        y = boundary[mi][n]
-                        douts[n] = jnp.zeros(y.shape, y.dtype)
-                dparams, dxs, mets, _ = self._bwd_fns[si](
-                    params[si], fwd_state[mi][si], stage_inputs[mi][si],
-                    douts, dloss_seed,
-                )
-                if grads[si] is None:
-                    grads[si] = dparams
+                continue
+            ex = self.stage_ex[si]
+            douts = {}
+            for n in st.out_names:
+                # Consumed here: every later stage's backward (the only
+                # writers) already fired, so drop the cotangent list
+                # and this output's activation — without this, peak
+                # memory scales with m and the 1F1B bound is fiction.
+                contribs = dout_back[mi].pop(n, None)
+                if contribs:
+                    sh = ex.output_sharding(
+                        self._producer[n], self._spec_of[n]
+                    )
+                    parts = [jax.device_put(g, sh) for g in contribs]
+                    total = parts[0]
+                    for p in parts[1:]:
+                        total = total + p
+                    douts[n] = total
                 else:
-                    grads[si] = jax.tree.map(jnp.add, grads[si], dparams)
-                for n, g in dxs.items():
-                    dout_back.setdefault(n, []).append(g)
-                if si == S - 1:
-                    metrics_acc = _merge_metrics(metrics_acc, {
-                        k: v for k, v in mets.items()
-                    })
+                    # Output unused downstream-gradient-wise; shape
+                    # from the actual microbatch value, not the
+                    # declared (full-batch) spec.
+                    douts[n] = self._zero_dout(si, n, boundary[mi][n])
+                # All of microbatch mi's forwards precede its first
+                # backward (F(sj,mi) < B(sj,mi) <= B(si,mi)), so no
+                # later event reads this activation.
+                boundary[mi].pop(n, None)
+            dparams, dxs, mets, _ = self._bwd_fns[si](
+                params[si], fwd_state[mi][si], stage_inputs[mi][si],
+                douts, dloss_seed,
+            )
+            # Release the remat inputs/state the backward just consumed
+            # (1F1B's memory win depends on it).
+            stage_inputs[mi][si] = None
+            fwd_state[mi][si] = None
+            if grads[si] is None:
+                grads[si] = dparams
+            else:
+                grads[si] = jax.tree.map(jnp.add, grads[si], dparams)
+            for n, g in dxs.items():
+                dout_back[mi].setdefault(n, []).append(g)
+            if si == S - 1:
+                metrics_acc = _merge_metrics(metrics_acc, {
+                    k: v for k, v in mets.items()
+                })
 
         # --clip-norm: the global L2 norm spans ALL stages' gradients;
         # per-stage squared norms combine on the host (the pipeline
@@ -586,10 +697,10 @@ class PipelineExecutor:
         losses: List[Any] = []
         mets_list: List[Dict[str, Any]] = []
         for si, st in enumerate(self.stages):
-            inputs = {}
-            for n in st.in_names:
-                src = batch[n] if n in graph_inputs else boundary[n]
-                inputs[n] = self._put_stage(si, n, src)
+            inputs = self._put_stage_many(si, {
+                n: (batch[n] if n in graph_inputs else boundary[n])
+                for n in st.in_names
+            })
             loss, mets, _, env = self._eval_fns[si](
                 params[si], state[si], inputs
             )
@@ -642,13 +753,15 @@ def make_executor(
         }
         if any(len(set(ids)) < nd for ids in subsets):
             mb = kwargs.pop("microbatches", 1)
+            sched = kwargs.pop("schedule", "1f1b")
             kwargs.pop("mesh_plan", None)
             return PipelineExecutor(
-                model, strategy, microbatches=mb, **kwargs
+                model, strategy, microbatches=mb, schedule=sched, **kwargs
             )
         _log.warning(
             "strategy device_ids span the full mesh; explicit ordering is "
             "realized by mesh coordinates (placement-equivalent)"
         )
     kwargs.pop("microbatches", None)
+    kwargs.pop("schedule", None)
     return Executor(model, strategy=strategy, **kwargs)

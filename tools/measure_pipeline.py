@@ -1,0 +1,110 @@
+"""Pipeline dispatch-overhead measurement (PIPELINE_OVERHEAD.md rows).
+
+VERDICT r4 item 5 acceptance: S=4 mb=4 <= plain-Executor step time at
+the b512 x w1024 config.  Reruns the round-3 table configs on the
+8-device virtual CPU mesh with the current runtime (1F1B schedule,
+batched stage-input device_put, cached zero cotangents) so the before
+(round-3 table) / after (this) delta is attributable to the round-5
+work.  The virtual mesh multiplexes ONE core, so these numbers isolate
+host dispatch + boundary transfer cost, exactly as in round 3.
+
+Usage: python tools/measure_pipeline.py [--width 1024 --batch 512]
+"""
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def build(batch, width, depth=8, classes=32):
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    import jax.numpy as jnp
+
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, width), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    t = x
+    for i in range(depth):
+        t = ff.dense(t, width, activation="relu", name=f"fc{i}")
+    t = ff.dense(t, classes, name="head")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+def time_step(ex, batch, iters=30, warmup=5):
+    import jax
+
+    params, opt_state, state = ex.init(seed=0)
+    placed = ex.shard_batch(batch)
+    for _ in range(warmup):
+        params, opt_state, state, m = ex.train_step(
+            params, opt_state, state, placed)
+    jax.device_get(m)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, state, m = ex.train_step(
+            params, opt_state, state, placed)
+    jax.device_get(m)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.pipeline import PipelineExecutor
+
+    nd = len(jax.devices())
+    assert nd == 8, f"expected 8 virtual devices, got {nd}"
+    ff = build(args.batch, args.width)
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.standard_normal((args.batch, args.width)).astype(np.float32),
+        "label": rng.integers(0, 32, size=(args.batch,)).astype(np.int32),
+    }
+    opt = lambda: SGDOptimizer(lr=0.01, momentum=0.9)
+
+    plain = Executor(ff, strategy=StrategyStore.data_parallel(nd),
+                     optimizer=opt())
+    t_plain = time_step(plain, batch, args.iters)
+    print(f"plain DP x{nd}: {t_plain:.1f} ms", flush=True)
+
+    def pipe_store(S):
+        store = StrategyStore(nd)
+        per = nd // S
+        ops = [f"fc{i}" for i in range(8)] + ["head", "softmax"]
+        for i, name in enumerate(ops):
+            si = min(i * S // len(ops), S - 1)
+            ids = tuple(range(si * per, (si + 1) * per))
+            store.set(name, ParallelConfig(n=per, device_ids=ids))
+        return store
+
+    for S in (2, 4):
+        for mb in (1, 4, 8):
+            for sched in ("gpipe", "1f1b"):
+                pipe = PipelineExecutor(
+                    ff, pipe_store(S), optimizer=opt(),
+                    microbatches=mb, schedule=sched,
+                )
+                t = time_step(pipe, batch, args.iters)
+                flag = " <= plain" if t <= t_plain else ""
+                print(f"pipeline S={S} mb={mb} {sched}: {t:.1f} ms{flag}",
+                      flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
