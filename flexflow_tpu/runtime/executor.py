@@ -318,39 +318,44 @@ class Executor:
         new_state: Dict[str, Dict[str, jax.Array]] = {}
         for op in self.model.layers:
             op.bind_mesh(self.plan, self._pc(op))
-            xs = [
-                self._reshard_input(env[t.name], env_spec.get(t.name), t, op)
-                for t in op.inputs
-            ]
-            p = params.get(op.name, {})
-            s = state.get(op.name, {})
-            if rows_override is not None and op.name in rows_override:
-                result, s_new = op.sparse_forward(
-                    rows_override[op.name], xs, s, training
-                )
-            elif self.config.remat and training and (
-                not op.is_loss or op.allow_remat
-            ):
-                # Per-layer rematerialization: drop this op's
-                # activations after forward and recompute them in the
-                # backward pass (jax.checkpoint) — HBM for FLOPs.
-                fwd = jax.checkpoint(
-                    lambda p, xs, s, _op=op: _op.forward(p, xs, s, training)
-                )
-                result, s_new = fwd(p, xs, s)
-            else:
-                result, s_new = op.forward(p, xs, s, training)
-            if op.is_loss:
-                loss, m, ys = result
-                total_loss = total_loss + loss
-                metrics = _merge_metrics(metrics, m)
-            else:
-                ys = result
-            for t, y in zip(op.outputs, ys):
-                sh = self.output_sharding(op, t)
-                y = jax.lax.with_sharding_constraint(y, sh)
-                env[t.name] = y
-                env_spec[t.name] = sh.spec
+            # The named scope lands in HLO instruction metadata
+            # (op_name="…/opname/…"), which is what lets the post-SPMD
+            # audit attribute collectives — and their bytes — to model
+            # ops (runtime/audit.py collective_bytes_by_op).
+            with jax.named_scope(op.name):
+                xs = [
+                    self._reshard_input(env[t.name], env_spec.get(t.name), t, op)
+                    for t in op.inputs
+                ]
+                p = params.get(op.name, {})
+                s = state.get(op.name, {})
+                if rows_override is not None and op.name in rows_override:
+                    result, s_new = op.sparse_forward(
+                        rows_override[op.name], xs, s, training
+                    )
+                elif self.config.remat and training and (
+                    not op.is_loss or op.allow_remat
+                ):
+                    # Per-layer rematerialization: drop this op's
+                    # activations after forward and recompute them in the
+                    # backward pass (jax.checkpoint) — HBM for FLOPs.
+                    fwd = jax.checkpoint(
+                        lambda p, xs, s, _op=op: _op.forward(p, xs, s, training)
+                    )
+                    result, s_new = fwd(p, xs, s)
+                else:
+                    result, s_new = op.forward(p, xs, s, training)
+                if op.is_loss:
+                    loss, m, ys = result
+                    total_loss = total_loss + loss
+                    metrics = _merge_metrics(metrics, m)
+                else:
+                    ys = result
+                for t, y in zip(op.outputs, ys):
+                    sh = self.output_sharding(op, t)
+                    y = jax.lax.with_sharding_constraint(y, sh)
+                    env[t.name] = y
+                    env_spec[t.name] = sh.spec
             if s_new is not s and s_new:
                 new_state[op.name] = s_new
             elif s:

@@ -61,6 +61,13 @@ def main(argv=None):
         help="replace roofline compute costs with live per-op "
              "microbenchmarks on the current backend (the reference's "
              "measured simulator mode, scripts/cnn.h:204+)")
+    ap.add_argument(
+        "--audit-bytes", action="store_true",
+        help="after the search, compile the train step under the found "
+             "strategy on this host's devices and print the bytes each "
+             "op's collectives move (runtime/audit.py ledger — catches "
+             "legal-but-chatty strategies whose halos lower to full "
+             "gathers)")
     ap.add_argument("-o", "--output", default="strategy.json")
     args = ap.parse_args(argv)
 
@@ -108,6 +115,36 @@ def main(argv=None):
     for name, pc in res.assignment.items():
         degs = {a: pc.degree(a) for a in "nchws" if pc.degree(a) > 1}
         print(f"  {name:24s} {degs or 'replicated'}")
+    if args.audit_bytes:
+        import jax
+
+        from flexflow_tpu.runtime.audit import (
+            collective_bytes_by_op,
+            format_bytes_report,
+            pipeline_collective_bytes,
+        )
+        from flexflow_tpu.runtime.pipeline import (
+            PipelineExecutor,
+            make_executor,
+        )
+
+        if len(jax.devices()) < args.devices:
+            # A searched strategy is meaningless on fewer devices than
+            # it was searched for — don't crash after an hours-long
+            # search, and don't audit a different strategy silently.
+            print(f"--audit-bytes: host has {len(jax.devices())} devices "
+                  f"< --devices {args.devices}; skipping the audit "
+                  f"(re-run on a host with {args.devices}, e.g. "
+                  f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                  f"{args.devices} JAX_PLATFORMS=cpu)")
+        else:
+            ex = make_executor(model, res.store,
+                               devices=jax.devices()[:args.devices])
+            print("per-op collective bytes (per device, one train step):")
+            if isinstance(ex, PipelineExecutor):
+                print(format_bytes_report(pipeline_collective_bytes(ex)))
+            else:
+                print(format_bytes_report(collective_bytes_by_op(ex)))
     print(f"wrote {args.output}")
 
 

@@ -145,3 +145,122 @@ class TestCompiledStep:
         counts = count_collectives(hlo)
         assert sum(counts.values()) >= 2, counts
         assert full_activation_allgathers(ex, hlo) == []
+
+
+class TestByteAccounting:
+    def test_bytes_dtype_and_metadata(self):
+        hlo = (
+          '  %cp = bf16[8,128]{1,0} collective-permute(%x), '
+          'metadata={op_name="jit(step)/conv1/halo" source_file="f.py"}\n'
+          '  %ar = (f32[64]{0}, s32[2,2]{1,0}) all-reduce(%a, %b), '
+          'metadata={op_name="jit(step)/transpose(fc1)/dot"}\n'
+        )
+        stats = collective_stats(hlo)
+        assert stats[0].bytes == 8 * 128 * 2          # bf16
+        assert stats[0].op_name == "jit(step)/conv1/halo"
+        assert stats[1].bytes == 64 * 4 + 4 * 4       # tuple members SUM
+        assert stats[1].op_name == "jit(step)/transpose(fc1)/dot"
+
+    def test_attribution_by_op(self):
+        from flexflow_tpu.runtime.audit import _attribute
+
+        ops = ["fc1", "fc10", "conv2"]
+        assert _attribute("jit(f)/fc10/dot", ops) == "fc10"
+        assert _attribute("jit(f)/transpose(fc1)/dot", ops) == "fc1"
+        # Autodiff nests scopes; the LAST component wins.
+        assert _attribute("jit(f)/fc1/conv2/x", ops) == "conv2"
+        assert _attribute("jit(f)/relu", ops) == "<unattributed>"
+
+    def test_spatial_halo_within_optimal_bound(self):
+        """VERDICT r4 item 6 acceptance: the spatial conv's halo
+        exchange in the compiled step moves no more bytes than the
+        exact-rectangle optimum (reference: ``conv_2d.cu:177-209``).
+        Gradient all-reduce is param sync, not halo traffic."""
+        from tests.test_reshard import _boundary_model
+
+        from flexflow_tpu.runtime.audit import (
+            collective_bytes_by_op,
+            spatial_halo_optimal_bytes,
+        )
+
+        ff, store = _boundary_model()
+        ex, hlo = _audit(ff, store)
+        by_op = collective_bytes_by_op(ex, hlo)
+        conv1 = next(op for op in ff.layers if op.name == "conv1")
+        bound = spatial_halo_optimal_bytes(conv1, store.find("conv1"))
+        moved = sum(
+            b for opcode, b in by_op.get("conv1", {}).items()
+            if opcode != "all-reduce"
+        )
+        assert 0 < moved <= bound, (moved, bound)
+
+    def test_chatty_spatial_split_detected(self):
+        """A spatial split whose extents don't divide (dropped to
+        replicated) makes the consumer re-gather the full activation —
+        the ledger must show it blowing past the halo-optimal bound
+        instead of passing silently (VERDICT r4 'legal-but-chatty')."""
+        import jax.numpy as jnp
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+        from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+        from flexflow_tpu.runtime.audit import (
+            collective_bytes_by_op,
+            spatial_halo_optimal_bytes,
+        )
+
+        b = 8
+        ff = FFModel(FFConfig(batch_size=b))
+        img = ff.create_tensor((b, 32, 32, 4), name="image")
+        lbl = ff.create_tensor((b,), dtype=jnp.int32, name="label")
+        t = ff.conv2d(img, 8, 3, 3, 1, 1, 1, 1, name="conv1")
+        # 31x31 extent: h=2 cannot divide -> factor drops to
+        # replicated, so the downstream conv's input is re-gathered in
+        # full.  XLA bills that gather at the PRODUCER (pool1's scope),
+        # so the assertion covers the spatial group, not one op.
+        t = ff.pool2d(t, 2, 2, 1, 1, 0, 0, name="pool1")  # 32->31
+        t = ff.conv2d(t, 8, 3, 3, 1, 1, 1, 1, name="conv2")
+        t = ff.flat(t, name="flat")
+        t = ff.dense(t, 4, name="fc")
+        ff.softmax(t, lbl, name="softmax")
+        store = StrategyStore(8)
+        store.set("conv1", ParallelConfig(n=2, h=2, w=2))
+        store.set("pool1", ParallelConfig(n=2, h=2, w=2))
+        store.set("conv2", ParallelConfig(n=2, h=2, w=2))
+        ex, hlo = _audit(ff, store)
+        by_op = collective_bytes_by_op(ex, hlo)
+        group = ("pool1", "conv2")
+        bound = sum(
+            spatial_halo_optimal_bytes(
+                next(op for op in ff.layers if op.name == n),
+                store.find(n),
+            )
+            for n in group
+        )
+        moved = sum(
+            v
+            for n in group
+            for opcode, v in by_op.get(n, {}).items()
+            if opcode != "all-reduce"
+        )
+        assert moved > bound, (
+            f"chatty gather not visible: moved={moved} bound={bound}"
+        )
+
+    def test_pipeline_stage_audit_not_vacuous(self):
+        """Per-stage audit must lower the REAL stage fwd/bwd programs:
+        a non-final stage's lower_train_step has constant-zero loss and
+        DCEs every collective, hiding chatty placements."""
+        from tests.test_pipeline import _strategy_two_stage, _two_stage_model
+
+        from flexflow_tpu.runtime.audit import pipeline_collective_bytes
+        from flexflow_tpu.runtime.pipeline import PipelineExecutor
+
+        pipe = PipelineExecutor(_two_stage_model(), _strategy_two_stage())
+        by_op = pipeline_collective_bytes(pipe)
+        stage0_ops = {op.name for op in pipe.stages[0].ops}
+        stage0_bytes = sum(
+            v for name in stage0_ops for v in by_op.get(name, {}).values()
+        )
+        # enc stage is DP n=4: its backward all-reduces gradients.
+        assert stage0_bytes > 0, by_op
