@@ -292,6 +292,19 @@ def bench_transformer_longctx(on_tpu: bool):
     return _bench_lm(batch=1, seq=256, layers=2, iters=2)
 
 
+def bench_transformer_32k(on_tpu: bool):
+    """t=32768 single-chip (VERDICT r4 item 7): past the single-launch
+    VMEM cap AND past the 16k ceiling rounds 2-4 stopped at — the
+    chunked decomposition runs 4x8192 kernel chunks per layer
+    (``flash_attention_lse_chunked``; gate pinned by
+    ``tests/test_pallas.py::test_chunked_gates_32k_and_beyond``).
+    b=1 keeps the 32768x32768 bf16 logits block (2 GB) plus its
+    cotangent inside HBM.  Returns (tokens/s, mfu)."""
+    if on_tpu:
+        return _bench_lm(batch=1, seq=32768, layers=6, iters=3)
+    return _bench_lm(batch=1, seq=512, layers=2, iters=2)
+
+
 def bench_nmt(n_chips: int, on_tpu: bool):
     """The fourth BASELINE config: NMT seq2seq LSTM step time
     (``nmt.cc:34-44,71-83`` defaults: bs 64 PER WORKER, 2 layers,
@@ -431,6 +444,13 @@ def main():
         extra["transformer_8k_error"] = f"{type(e).__name__}: {e}"
     try:
         with contextlib.redirect_stdout(sys.stderr):
+            lc32_tps, lc32_mfu = bench_transformer_32k(on_tpu)
+        extra["transformer_32k_tokens_per_s"] = round(lc32_tps, 1)
+        extra["transformer_32k_mfu"] = round(lc32_mfu, 4)
+    except Exception as e:
+        extra["transformer_32k_error"] = f"{type(e).__name__}: {e}"
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
             extra["candle_samples_per_s"] = round(bench_candle(on_tpu), 2)
     except Exception as e:
         extra["candle_error"] = f"{type(e).__name__}: {e}"
@@ -475,7 +495,7 @@ def main():
         n_chips = extra["n_chips"] = actual_n
         # MFU fields are computed against the TPU roofline.
         for k in ("alexnet_mfu", "dlrm_mfu", "transformer_mfu",
-                  "transformer_8k_mfu"):
+                  "transformer_8k_mfu", "transformer_32k_mfu"):
             if k in extra:
                 extra[k] = None
 

@@ -247,8 +247,7 @@ class MultiHeadAttention(Op):
             # chunked decomposition (per-chunk launches + lse merges)
             # for longer sequences (or when FF_FLASH_FORCE_CHUNK pins
             # it); None -> einsum fallback.
-            if not (pallas_kernels.flash_supported(shape, dtype)
-                    or pallas_kernels.flash_chunked_supported(shape, dtype)):
+            if not pallas_kernels.flash_any_supported(shape, dtype):
                 return None
 
             def fn(ql, kl, vl):
@@ -302,9 +301,7 @@ class MultiHeadAttention(Op):
             qh = self._split_heads(q)
             kh = self._split_heads(k)
             vh = self._split_heads(v)
-            use_flash = pallas_kernels.flash_supported(
-                qh.shape, qh.dtype
-            ) or pallas_kernels.flash_chunked_supported(qh.shape, qh.dtype)
+            use_flash = pallas_kernels.flash_any_supported(qh.shape, qh.dtype)
             if use_flash:
                 return self._ring_flash(qh, kh, vh, s_idx, S, s_entry, dtype)
             qh, kh, vh = (x.astype(jnp.float32) for x in (qh, kh, vh))

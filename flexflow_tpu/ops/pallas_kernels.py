@@ -496,6 +496,90 @@ def flash_chunked_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
     return _chunk_len(t, hd, jnp.dtype(dtype).itemsize) > 0
 
 
+#: Sequences past this length whose t the kernel paths cannot
+#: decompose (non-power-of-two tails) stream through the jnp blocked
+#: formulation instead of materializing a t x t score matrix.
+_BLOCKED_MIN_T = 4096
+
+
+def attention_lse_blocked(q, k, v, causal: bool = True,
+                          block_q: int = 512, block_k: int = 512):
+    """Pure-jnp streaming (flash-style) attention: (o, lse) like the
+    Pallas kernels, O(t·block) memory, ANY sequence length (tails are
+    padded and masked).  The long-context safety net for shapes no
+    kernel formulation decomposes — q blocks ride ``lax.scan`` (one
+    compiled body, not t/block unrolled copies), k/v stream through a
+    ``fori_loop`` whose upper bound stops at the causal diagonal.
+    Fully differentiable through XLA; the VJP re-streams the same
+    blocks.  Reference lineage: the SP chunking this generalizes,
+    ``rnn.h:21-23``."""
+    b, h, t, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    nq = -(-t // block_q)
+    nk = -(-t // block_k)
+    tq_pad, tk_pad = nq * block_q, nk * block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, tq_pad - t), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, tk_pad - t), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, tk_pad - t), (0, 0)))
+    # (nq, b, h, block_q, hd) for scan.
+    qb = jnp.moveaxis(
+        qp.reshape(b, h, nq, block_q, hd), 2, 0
+    )
+
+    def q_block(_, inp):
+        qi, qidx = inp
+        q_pos = qidx * block_q + jnp.arange(block_q)
+        m0 = jnp.full((b, h, block_q, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, block_q, 1), jnp.float32)
+        a0 = jnp.zeros((b, h, block_q, hd), jnp.float32)
+
+        def body(j, mla):
+            m, l, acc = mla
+            kj = lax.dynamic_slice_in_dim(kp, j * block_k, block_k, 2)
+            vj = lax.dynamic_slice_in_dim(vp, j * block_k, block_k, 2)
+            s = jnp.einsum(
+                "bhqd,bhkd->bhqk", qi, kj,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            k_pos = j * block_k + jnp.arange(block_k)
+            valid = (k_pos < t)[None, :]
+            if causal:
+                valid = valid & (k_pos[None, :] <= q_pos[:, None])
+            s = jnp.where(valid[None, None], s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            # _NEG_INF is a finite -1e30, so rows with no valid key yet
+            # run the plain update: exp(-1e30 - m_new) underflows to 0
+            # (same convention as the Pallas kernels above).
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            acc = acc * corr + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(vj.dtype), vj,
+                preferred_element_type=jnp.float32,
+            )
+            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            return m_new, l, acc
+
+        # Static bound: a dynamic (diagonal-capped) stop would break
+        # reverse-mode AD through the loop; blocks past the causal
+        # diagonal are fully masked and contribute nothing (the
+        # formulation trades ~2x flops for differentiability — it is
+        # the safety net, not the fast path).
+        m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, a0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o = (acc / l_safe).astype(q.dtype)
+        lse = jnp.where(
+            jnp.isfinite(m), m + jnp.log(l_safe), _NEG_INF
+        )[..., 0]
+        return None, (o, lse)
+
+    _, (o_blocks, lse_blocks) = lax.scan(
+        q_block, None, (qb, jnp.arange(nq))
+    )
+    o = jnp.moveaxis(o_blocks, 0, 2).reshape(b, h, tq_pad, hd)[:, :, :t]
+    lse = jnp.moveaxis(lse_blocks, 0, 2).reshape(b, h, tq_pad)[:, :, :t]
+    return o, lse
+
+
 #: FF_FLASH_FORCE_CHUNK=<len>: route single-launch-capable shapes
 #: through the chunked decomposition at the given chunk length — the
 #: tuning knob for racing the two formulations at the fused-train-step
@@ -524,7 +608,33 @@ def flash_attention_lse_auto(q, k, v, causal: bool = True,
         return flash_attention_lse(q, k, v, causal, interpret)
     if flash_chunked_supported(q.shape, q.dtype):
         return flash_attention_lse_chunked(q, k, v, causal, interpret)
+    if blocked_attention_applies(q.shape):
+        # No kernel decomposition (e.g. a non-power-of-two tail) but
+        # far too long for a t x t einsum: stream it in jnp blocks.
+        return attention_lse_blocked(q, k, v, causal)
     return None
+
+
+def blocked_attention_applies(shape: Tuple[int, ...]) -> bool:
+    """Long-context shapes the jnp blocked formulation should absorb
+    when no Pallas path decomposes them (the einsum fallback would
+    materialize a t x t score matrix)."""
+    if len(shape) != 4:
+        return False
+    _, _, t, hd = shape
+    return t >= _BLOCKED_MIN_T and hd >= 8
+
+
+def flash_any_supported(shape: Tuple[int, ...], dtype=jnp.float32) -> bool:
+    """Whether ``flash_attention_lse_auto`` returns a streaming
+    formulation for this shape (single-launch kernel, chunked kernels,
+    or the jnp blocked fallback) — the gate dense/ring dispatchers use;
+    False means the einsum path is the right call (small shapes)."""
+    return (
+        flash_supported(shape, dtype)
+        or flash_chunked_supported(shape, dtype)
+        or blocked_attention_applies(shape)
+    )
 
 
 def flash_attention_lse_chunked(q, k, v, causal: bool = True,

@@ -290,3 +290,78 @@ def test_flash_auto_unsupported_returns_none():
     assert not pk.flash_chunked_supported(shape, jnp.float32)
     q = jnp.zeros(shape, jnp.float32)
     assert pk.flash_attention_lse_auto(q, q, q) is None
+
+
+def _ref_attention_lse(q, k, v, causal):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) / np.sqrt(q.shape[-1])
+    if causal:
+        t = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s, -1e30)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vf) / l[..., None]
+    return o.astype(q.dtype), m + jnp.log(l)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [96, 100])  # divisible and ragged tails
+def test_blocked_attention_matches_reference(rng, causal, t):
+    """The jnp blocked streaming formulation (the any-t long-context
+    safety net, VERDICT r4 item 7) matches dense attention, including
+    ragged tails that no kernel chunking decomposes."""
+    q = jnp.asarray(rng.standard_normal((2, 2, t, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 2, t, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 2, t, 16)), jnp.float32)
+    o, lse = pk.attention_lse_blocked(q, k, v, causal,
+                                      block_q=32, block_k=32)
+    o_ref, lse_ref = _ref_attention_lse(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_blocked_attention_grads_match(rng):
+    q = jnp.asarray(rng.standard_normal((1, 2, 100, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 100, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 100, 16)), jnp.float32)
+    cot = jnp.asarray(rng.standard_normal((1, 2, 100, 16)), jnp.float32)
+
+    def loss_blocked(q, k, v):
+        return jnp.sum(pk.attention_lse_blocked(
+            q, k, v, True, block_q=32, block_k=32)[0] * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention_lse(q, k, v, True)[0] * cot)
+
+    gb = jax.grad(loss_blocked, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gb, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_auto_dispatch_long_ragged_uses_blocked():
+    """A long non-decomposable t must stream, not return None (the
+    einsum fallback would materialize t^2 scores)."""
+    # 8200 = 2^3 * 5^2 * 41: past the bf16/hd64 single-launch VMEM
+    # cap, and no halving >= 512 is 8-block-divisible.
+    t = 8200
+    shape = (1, 1, t, 64)
+    assert not pk.flash_supported(shape, jnp.bfloat16)
+    assert not pk.flash_chunked_supported(shape, jnp.bfloat16)
+    assert pk.flash_any_supported(shape, jnp.bfloat16)
+    q = jnp.zeros(shape, jnp.bfloat16)
+    res = pk.flash_attention_lse_auto(q, q, q)
+    assert res is not None and res[0].shape == shape
+
+
+def test_chunked_gates_32k_and_beyond():
+    """VERDICT r4 item 7: bf16 t=32768+ decomposes into kernel chunks
+    (the transformer_32k bench leg's dispatch path)."""
+    for t in (32768, 65536):
+        shape = (1, 8, t, 64)
+        assert pk.flash_chunked_supported(shape, jnp.bfloat16), t
+        assert pk._chunk_len(t, 64, 2) == 8192
