@@ -285,10 +285,11 @@ class FFModel:
         name: Optional[str] = None,
         **kw,
     ) -> TensorSpec:
-        """Switch-style mixture-of-experts FFN; a 'c' strategy degree
-        shards experts across the mesh (the reference's per-table
-        expert placement, ``dlrm_strategy.cc:5-36``, generalized — see
-        ``ops/moe.py``)."""
+        """Mixture-of-experts FFN (``top_k=1`` switch routing, the
+        default; ``top_k=2`` GShard top-2 with renormalized gates); a
+        'c' strategy degree shards experts across the mesh (the
+        reference's per-table expert placement, ``dlrm_strategy.cc:5-36``,
+        generalized — see ``ops/moe.py``)."""
         return self._add(
             MixtureOfExperts(self._unique("moe", name), x, num_experts,
                              ffn_dim, capacity_factor=capacity_factor, **kw)

@@ -64,6 +64,7 @@ class MixtureOfExperts(Op):
         capacity_factor: float = 1.25,
         activation: str = "gelu",
         aux_loss_weight: float = 1e-2,
+        top_k: int = 1,
         kernel_initializer=None,
     ):
         super().__init__(name, [x])
@@ -72,6 +73,9 @@ class MixtureOfExperts(Op):
         b, t, d = x.shape
         tokens = b * t
         assert num_experts >= 2, "moe needs >= 2 experts"
+        assert 1 <= top_k <= num_experts, (
+            f"top_k={top_k} must be in [1, num_experts={num_experts}]"
+        )
         self.attrs = dict(
             num_experts=num_experts,
             ffn_dim=ffn_dim,
@@ -80,9 +84,15 @@ class MixtureOfExperts(Op):
             # from the runtime token count so microbatched execution —
             # accum scan, pipeline microbatches — drops tokens at the
             # same per-token rate as the full batch).
-            capacity=self.capacity_for(tokens, capacity_factor, num_experts),
+            capacity=self.capacity_for(
+                tokens * top_k, capacity_factor, num_experts
+            ),
             activation=activation,
             aux_loss_weight=aux_loss_weight,
+            # k routed experts per token (1 = switch; 2 = GShard top-2
+            # with gates renormalized over the chosen k).  Static
+            # shapes: k one-hot dispatch slots, no dynamic scatter.
+            top_k=top_k,
         )
         self.d_model = d
         self.kernel_initializer = kernel_initializer or GlorotUniform()
@@ -96,8 +106,12 @@ class MixtureOfExperts(Op):
         return max(8, -(-cap // 8) * 8)
 
     def capacity(self, tokens: int) -> int:
+        """Per-expert slots for ``tokens`` routed tokens; top-k routing
+        places k assignments per token, so demand (and capacity) scale
+        by k — the GShard sizing convention."""
         return self.capacity_for(
-            tokens, self.attrs["capacity_factor"], self.attrs["num_experts"]
+            tokens * self.attrs.get("top_k", 1),
+            self.attrs["capacity_factor"], self.attrs["num_experts"],
         )
 
     def param_specs(self) -> Dict[str, ParamSpec]:
@@ -130,21 +144,42 @@ class MixtureOfExperts(Op):
         xf = x.reshape(s, d)
 
         # -- routing (f32) --------------------------------------------
+        k = self.attrs.get("top_k", 1)
         logits = (xf.astype(jnp.float32) @ params["gate"].astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)                  # (S, E)
-        expert = jnp.argmax(probs, axis=-1)                      # (S,)
-        gate_w = jnp.max(probs, axis=-1)                         # (S,)
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)    # (S, E)
-        # Position of each token in its expert's queue; capacity drop.
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (S, E)
-        pos_tok = jnp.sum(pos, axis=-1).astype(jnp.int32)        # (S,)
-        keep = (pos_tok < cap).astype(jnp.float32)
-        dispatch = (
-            onehot[:, :, None]
-            * keep[:, None, None]
-            * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[:, None, :]
-        )                                                        # (S, E, C)
-        combine = dispatch * gate_w[:, None, None]               # (S, E, C)
+        topk_p, topk_e = jax.lax.top_k(probs, k)                 # (S, K)
+        if k == 1:
+            gates = topk_p                                       # raw prob
+        else:
+            # GShard convention: renormalize over the chosen k so the
+            # combine weights sum to 1 per token.
+            gates = topk_p / jnp.sum(topk_p, axis=-1, keepdims=True)
+        # Slot-major queueing: ALL first choices claim capacity before
+        # any second choice (GShard's priority rule), each slot in
+        # token order; a token past capacity loses that slot only.
+        counts = jnp.zeros((e,), jnp.float32)  # slots consumed so far
+        dispatch = jnp.zeros((s, e, cap), jnp.float32)           # (S, E, C)
+        combine = jnp.zeros((s, e, cap), jnp.float32)
+        keep_total = jnp.float32(0.0)
+        first_mask = None
+        for j in range(k):
+            mask = jax.nn.one_hot(topk_e[:, j], e, dtype=jnp.float32)
+            if j == 0:
+                first_mask = mask
+            pos = ((jnp.cumsum(mask, axis=0) - 1.0) + counts[None, :]) * mask
+            pos_tok = jnp.sum(pos, axis=-1).astype(jnp.int32)    # (S,)
+            keep = (pos_tok < cap).astype(jnp.float32)
+            d_j = (
+                mask[:, :, None]
+                * keep[:, None, None]
+                * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[:, None, :]
+            )
+            dispatch = dispatch + d_j
+            combine = combine + d_j * gates[:, j][:, None, None]
+            keep_total = keep_total + jnp.sum(keep)
+            # Overflowed tokens still consume their queue slot (cumsum
+            # semantics, same as the k=1 path).
+            counts = counts + jnp.sum(mask, axis=0)
 
         # -- expert compute (MXU; all-to-all inserted by GSPMD) -------
         cd = x.dtype
@@ -156,14 +191,17 @@ class MixtureOfExperts(Op):
         y_e = y_e + params["b2"][:, None, :]
         y = jnp.einsum("sec,ecd->sd", combine.astype(cd), y_e)
 
-        # -- aux load-balance loss (Switch eq. 4) ---------------------
-        load = jnp.mean(onehot, axis=0)                          # (E,)
+        # -- aux load-balance loss (Switch eq. 4; first-choice load,
+        # which reduces to the k=1 formula when k == 1) ---------------
+        load = jnp.mean(first_mask, axis=0)                      # (E,)
         importance = jnp.mean(probs, axis=0)                     # (E,)
         aux = e * jnp.sum(load * importance)
         w = self.attrs["aux_loss_weight"]
         loss = (w * aux).astype(jnp.float32) if training else jnp.float32(0.0)
         metrics = {
             f"{self.name}_aux_loss": aux.astype(jnp.float32),
-            f"{self.name}_dropped": jnp.float32(s) - jnp.sum(keep),
+            # Dropped ASSIGNMENTS (a top-2 token losing one slot counts
+            # once; it still flows through its surviving slot).
+            f"{self.name}_dropped": jnp.float32(s * k) - keep_total,
         }
         return (loss, metrics, [y.reshape(b, t, d)]), state

@@ -567,9 +567,9 @@ def attention_lse_blocked(q, k, v, causal: bool = True,
         m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, a0))
         l_safe = jnp.maximum(l, 1e-30)
         o = (acc / l_safe).astype(q.dtype)
-        lse = jnp.where(
-            jnp.isfinite(m), m + jnp.log(l_safe), _NEG_INF
-        )[..., 0]
+        # Fully-masked rows exist only in the padded tail (sliced off
+        # below); their lse lands near _NEG_INF via the plain formula.
+        lse = (m + jnp.log(l_safe))[..., 0]
         return None, (o, lse)
 
     _, (o_blocks, lse_blocks) = lax.scan(
