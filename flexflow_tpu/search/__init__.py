@@ -120,13 +120,17 @@ def simulate_strategy(
     store: StrategyStore,
     num_devices: Optional[int] = None,
     device_model: Optional[DeviceModel] = None,
+    measured_costs: Optional[dict] = None,
 ) -> float:
     """Simulated step time (us) of an explicit strategy table — the
     what-if query the reference's VERBOSE simulator mode answers
-    (``simulator.cc:1012-1031``)."""
+    (``simulator.cc:1012-1031``).  ``measured_costs`` as in
+    ``search_strategy`` (the calibration path prices ops from live
+    microbenchmarks instead of the roofline)."""
     nd = num_devices or store.num_devices
     plan = build_virtual_plan(nd)
-    prob = build_problem(model, plan, device_model)
+    prob = build_problem(model, plan, device_model,
+                         measured_costs=measured_costs)
     from flexflow_tpu.parallel.strategy import AXES
     from flexflow_tpu.search.problem import shard_devices
 

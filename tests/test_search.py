@@ -198,6 +198,18 @@ class TestEndToEndSearch:
         dp_t = simulate_strategy(alexnet, StrategyStore.data_parallel(8), 8)
         assert dp_t == pytest.approx(res.dp_time_us, rel=1e-6)
 
+    def test_simulate_strategy_measured_costs(self, alexnet):
+        """simulate_strategy prices ops from a measured table when
+        given one (the ffsim-calibration path, tools/calibrate_ffsim)."""
+        flat = {op.name: 1000.0 for op in alexnet.layers}
+        store = StrategyStore.data_parallel(8)
+        t_meas = simulate_strategy(alexnet, store, 8, measured_costs=flat)
+        t_roof = simulate_strategy(alexnet, store, 8)
+        assert t_meas != pytest.approx(t_roof)
+        # 1000 us/op fwd (x the fwd+bwd factor) across a sequential
+        # graph: the makespan must scale with op count.
+        assert t_meas > 1000.0 * len(alexnet.layers) / 8
+
     def test_measured_costs_override_roofline(self, alexnet):
         """Per-op measured times (runtime.profiler.measured_cost_table
         format) replace the roofline estimate and change the simulated
