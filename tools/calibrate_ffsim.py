@@ -9,7 +9,8 @@ so the ``*_speedup_sim`` numbers were internally consistent yet
 externally unanchored.
 
 This tool closes the loop on the one device we can reach: for
-alexnet / vgg16 / dlrm at their bench shapes it
+alexnet (bench.py's headline b=2048 config) / vgg16 (search shape,
+b=64 — it has no bench leg) / dlrm (run_random.sh shape) it
   1. measures the per-(op, degree=1) fwd+bwd table live,
   2. predicts the single-chip step via ffsim in BOTH pricing modes
      (measured table / analytic roofline),
@@ -24,8 +25,13 @@ constants — tune those (``search/cost_model.py DeviceModel``) until
 the roofline column lands <20%.  Results land in OP_PARALLEL.md.
 """
 import json
+import os
 import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def _models(on_tpu: bool):
@@ -35,11 +41,13 @@ def _models(on_tpu: bool):
     from flexflow_tpu.models.dlrm import (
         build_dlrm,
         dlrm_random_benchmark_config,
-        dlrm_strategy,
     )
 
     out = []
-    b = 256 if on_tpu else 16
+    # bench.py's headline alexnet config (BENCH_BATCH default 2048) —
+    # the calibration must anchor the shape the bench reports; vgg16
+    # has no bench leg, so it runs at its search shape (b=64).
+    b = 2048 if on_tpu else 16
     cfg = FFConfig(batch_size=b, compute_dtype="bfloat16")
     out.append(("alexnet", build_alexnet(
         batch_size=b, image_size=229 if on_tpu else 64,
@@ -58,7 +66,22 @@ def _models(on_tpu: bool):
 
 
 def main():
+    # Probe the tunnel in a timeout-bounded subprocess BEFORE any
+    # in-process backend touch (bench.py's relay-proofing: a wedged
+    # relay hangs jax init and must never be timeout-killed).
+    import bench
+
+    platform, _, probe_err = bench.probe_backend()
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if probe_err:
+            print(f"tunnel down ({probe_err}); calibrating plumbing on "
+                  f"CPU — numbers are NOT chip data", file=sys.stderr)
+
     import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     from flexflow_tpu.optim import SGDOptimizer
     from flexflow_tpu.parallel.strategy import StrategyStore
