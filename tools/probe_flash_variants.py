@@ -242,6 +242,21 @@ def variants(t, hd, block_q, block_k, dtype):
             functools.partial(_v4_kernel, causal=True, scale=scale),
             q, k, v, block_q)
 
+    def v5_stock(q, k, v):
+        # The yardstick (VERDICT r5 item 2): jax's own TPU pallas flash
+        # kernel at default block sizes.  TPU-only (no interpret path);
+        # the harness's per-variant try/except reports it as FAIL on
+        # CPU smoke runs.
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as stock_flash,
+        )
+
+        bh, tt, dd = q.shape
+        unfold = lambda x: x.reshape(1, bh, tt, dd)
+        return stock_flash(
+            unfold(q), unfold(k), unfold(v), causal=True, sm_scale=scale
+        ).reshape(bh, tt, dd)
+
     # NOTE: the chunked-decomposition candidate is deliberately NOT in
     # this race: at chunk=256/t=2048 it issues 36 dependent pallas
     # launches per call, so even a short two-point chain would exceed
@@ -249,7 +264,7 @@ def variants(t, hd, block_q, block_k, dtype):
     # at the fused-train-step level instead, via FF_FLASH_FORCE_CHUNK
     # in tools/profile_lm_decomp.py.
     return {"v1_base": v1, "v2_lanes": v2, "v3_twopass": v3,
-            "v4_fullrow": v4}
+            "v4_fullrow": v4, "v5_stock": v5_stock}
 
 
 def main():
@@ -268,8 +283,8 @@ def main():
     import time
     for block in blocks:
         for name, fn in variants(t, hd, block, block, jnp.bfloat16).items():
-            if name == "v4_fullrow" and block != blocks[0]:
-                continue  # block-size independent
+            if name in ("v4_fullrow", "v5_stock") and block != blocks[0]:
+                continue  # block-size independent (stock picks its own)
             if name == "v2_lanes" and block < LANES:
                 continue  # the lane-tile trick needs >= 128-wide blocks
             try:
