@@ -358,6 +358,122 @@ def _fwd_call(q, k, v, causal, interpret):
     )(q, k, v)
 
 
+def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr,
+                       *, block_q, block_k, causal, scale, num_kb):
+    """Streamed forward: 3D grid (bh, q-block, k-block).  K/V arrive
+    one block per grid step through pipelined BlockSpecs (Pallas
+    double-buffers the copies), so VMEM holds only the working blocks
+    — no resident full-K/V and therefore no ``_vmem_block_cap`` on t.
+    The softmax state (m, l, acc) persists in scratch across the
+    sequential k dimension; output writes at the last k step.  This is
+    the official TPU flash structure (cf. jax pallas ops
+    flash_attention) racing the resident-K/V production kernel
+    (``tools/probe_flash_variants.py`` v6_stream); it becomes the
+    default only after chip validation."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    def _step():
+        q = q_ref[0]                                    # (bq, hd)
+        k = k_ref[0]                                    # (bk, hd)
+        v = v_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # (bq, bk)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m = m_scr[:]
+        l = l_scr[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        l_scr[:] = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = m_new
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing —
+        # skip their MXU work (the fetch still happens; grid shapes
+        # are static).  Non-causal runs the body unconditionally
+        # (causal is a static Python bool; no runtime predicate).
+        pl.when(k_start <= q_start + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kb == num_kb - 1)
+    def _emit():
+        o_ref[0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:] + jnp.log(l_scr[:]), (block_q, LSE_LANES)
+        )
+
+
+def flash_attention_lse_streamed(q, k, v, causal: bool = True,
+                                 interpret: Optional[bool] = None,
+                                 block_q: int = 512, block_k: int = 512):
+    """Forward-only streamed flash on (b, h, t, hd): any t with
+    ``t % block == 0``, VMEM-bounded by the blocks alone.  Not yet the
+    production path (no custom VJP; chip-unvalidated) — raced as
+    v6_stream and used by tests to pin numerics in interpret mode."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, t, hd = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
+    bh = b * h
+    fold = lambda x: x.reshape(bh, t, hd)
+    num_kb = t // block_k
+    scale = 1.0 / math.sqrt(hd)
+    kernel = functools.partial(
+        _fwd_stream_kernel, block_q=block_q, block_k=block_k,
+        causal=causal, scale=scale, num_kb=num_kb,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(fold(q), fold(k), fold(v))
+    return (out.reshape(b, h, t, hd),
+            lse[:, :, 0].reshape(b, h, t))
+
+
 def _bwd_call(q, k, v, do, lse, delta, causal, interpret):
     bh, t, hd = q.shape
     block_q = _require_block(t, hd, q.dtype.itemsize)

@@ -365,3 +365,21 @@ def test_chunked_gates_32k_and_beyond():
         shape = (1, 8, t, 64)
         assert pk.flash_chunked_supported(shape, jnp.bfloat16), t
         assert pk._chunk_len(t, 64, 2) == 8192
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_streamed_flash_matches_production(rng, causal):
+    """The 3D-grid streamed forward (v6_stream race candidate, no
+    resident K/V) must match the production kernel exactly in
+    interpret mode, including its lse."""
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 2, 256, 64)),
+                           jnp.float32) for _ in range(3))
+    o_s, lse_s = pk.flash_attention_lse_streamed(
+        q, k, v, causal, block_q=64, block_k=64)
+    o_r, lse_r = pk.flash_attention_lse(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o_s), np.asarray(o_r),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(lse_s),
+        np.asarray(lse_r if lse_r.ndim == 3 else lse_r[..., 0]),
+        rtol=2e-5, atol=2e-5)

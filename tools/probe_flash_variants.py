@@ -257,6 +257,19 @@ def variants(t, hd, block_q, block_k, dtype):
             unfold(q), unfold(k), unfold(v), causal=True, sm_scale=scale
         ).reshape(bh, tt, dd)
 
+    def v6_stream(q, k, v):
+        # Streamed 3D-grid formulation (no resident K/V, no VMEM cap
+        # on t): K/V blocks arrive via pipelined BlockSpecs; softmax
+        # state persists in scratch across the sequential k dimension.
+        from flexflow_tpu.ops import pallas_kernels as pk
+
+        bh, tt, dd = q.shape
+        unfold = lambda x: x.reshape(1, bh, tt, dd)
+        return pk.flash_attention_lse_streamed(
+            unfold(q), unfold(k), unfold(v), True,
+            block_q=block_q, block_k=block_k,
+        )[0].reshape(bh, tt, dd)
+
     # NOTE: the chunked-decomposition candidate is deliberately NOT in
     # this race: at chunk=256/t=2048 it issues 36 dependent pallas
     # launches per call, so even a short two-point chain would exceed
@@ -264,7 +277,7 @@ def variants(t, hd, block_q, block_k, dtype):
     # at the fused-train-step level instead, via FF_FLASH_FORCE_CHUNK
     # in tools/profile_lm_decomp.py.
     return {"v1_base": v1, "v2_lanes": v2, "v3_twopass": v3,
-            "v4_fullrow": v4, "v5_stock": v5_stock}
+            "v4_fullrow": v4, "v5_stock": v5_stock, "v6_stream": v6_stream}
 
 
 def main():

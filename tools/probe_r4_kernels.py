@@ -147,6 +147,34 @@ def blocked_ragged_t():
     assert np.isfinite(val).all()
 
 
+def flash_streamed_16k():
+    # Round-5 candidate: the streamed 3D-grid forward at a t the
+    # resident-K/V kernel cannot launch (bf16 t=16384 single launch).
+    # Mosaic legality + numerics vs the chunked decomposition.
+    from flexflow_tpu.ops import pallas_kernels as pk
+
+    shape = (1, 2, 16384, 64)
+    assert not pk.flash_supported(shape, jnp.bfloat16)
+    key = jax.random.PRNGKey(3)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape,
+                                 jnp.bfloat16) for i in range(3))
+    o_s, _ = jax.jit(
+        lambda q, k, v: pk.flash_attention_lse_streamed(q, k, v, True)
+    )(q, k, v)
+    o_c, _ = jax.jit(
+        lambda q, k, v: pk.flash_attention_lse_chunked(q, k, v, True)
+    )(q, k, v)
+    # Tail rows: under causal masking they attend across ALL k-blocks,
+    # so this exercises the streamed kernel's cross-block softmax
+    # carry (head rows complete inside the first block and would pass
+    # even with a broken carry).
+    a = np.asarray(jax.device_get(o_s[:, :, -64:]), np.float32)
+    b = np.asarray(jax.device_get(o_c[:, :, -64:]), np.float32)
+    assert np.isfinite(a).all() and np.max(np.abs(a - b)) < 3e-2, (
+        np.max(np.abs(a - b))
+    )
+
+
 def flash_f32_8k_gated():
     # Measured outcome, kept as a regression probe: f32 at t=8192
     # (u = 2 MB per operand) OOMs scoped VMEM at EVERY block size
@@ -168,6 +196,7 @@ def main():
     probe("flash chunked bf16 t=32768", flash_32k_chunked)
     probe("scatter empty batch no-op", scatter_empty_batch)
     probe("blocked attention ragged t=8200", blocked_ragged_t)
+    probe("streamed flash bf16 t=16384", flash_streamed_16k)
 
 
 if __name__ == "__main__":
