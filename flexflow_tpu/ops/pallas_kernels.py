@@ -474,6 +474,177 @@ def flash_attention_lse_streamed(q, k, v, causal: bool = True,
             lse[:, :, 0].reshape(b, h, t))
 
 
+def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr,
+                      *, block_q, block_k, causal, scale, num_kb):
+    """Streamed dq: grid (bh, q-block, k-block); K/V blocks arrive via
+    pipelined BlockSpecs, dq accumulates in scratch across the
+    sequential k axis (same no-resident-K/V rationale as
+    ``_fwd_stream_kernel``)."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kb == num_kb - 1)
+    def _emit():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr,
+                       *, block_q, block_k, causal, scale, num_qb):
+    """Streamed dk/dv: grid (bh, k-block, q-block); q/do/lse/delta
+    blocks stream through the sequential q axis, dk/dv accumulate in
+    scratch."""
+    ki = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    k_start = ki * block_k
+    q_start = qb * block_q
+
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # (bq, bk)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Query blocks entirely above this K block see none of it.
+        pl.when(q_start + block_q - 1 >= k_start)(_step)
+    else:
+        _step()
+
+    @pl.when(qb == num_qb - 1)
+    def _emit():
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_stream_call(q, k, v, do, lse, delta, causal, interpret,
+                     block_q=512, block_k=512):
+    """Streamed backward on folded (bh, t, hd): any t % block == 0,
+    VMEM bounded by working blocks.  Race/probe surface until chip
+    validation; the production VJP keeps the resident-K/V kernels."""
+    bh, t, hd = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
+    scale = 1.0 / math.sqrt(hd)
+    nq, nk = t // block_q, t // block_k
+    qb = lambda i_ax: pl.BlockSpec((1, block_q, hd),
+                                   (lambda b, i, j: (b, i, 0)) if i_ax
+                                   else (lambda b, i, j: (b, j, 0)))
+    qr = lambda i_ax: pl.BlockSpec((1, block_q, LSE_LANES),
+                                   (lambda b, i, j: (b, i, 0)) if i_ax
+                                   else (lambda b, i, j: (b, j, 0)))
+    kb_ = lambda i_ax: pl.BlockSpec((1, block_k, hd),
+                                    (lambda b, i, j: (b, i, 0)) if i_ax
+                                    else (lambda b, i, j: (b, j, 0)))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_stream_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          num_kb=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qb(True), kb_(False), kb_(False), qb(True),
+                  qr(True), qr(True)],
+        out_specs=qb(True),
+        out_shape=jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_stream_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          num_qb=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qb(False), kb_(True), kb_(True), qb(False),
+                  qr(False), qr(False)],
+        out_specs=[kb_(True), kb_(True)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, hd), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _bwd_call(q, k, v, do, lse, delta, causal, interpret):
     bh, t, hd = q.shape
     block_q = _require_block(t, hd, q.dtype.itemsize)

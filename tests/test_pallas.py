@@ -383,3 +383,21 @@ def test_streamed_flash_matches_production(rng, causal):
         np.asarray(lse_s),
         np.asarray(lse_r if lse_r.ndim == 3 else lse_r[..., 0]),
         rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_streamed_backward_matches_production(rng, causal):
+    """The streamed dq/dkv kernels (3D grid, no resident K/V) must
+    match the production backward exactly in interpret mode."""
+    bh, t, hd = 2, 256, 64
+    q, k, v, do = (jnp.asarray(rng.standard_normal((bh, t, hd)),
+                               jnp.float32) for _ in range(4))
+    o, lse_l = pk._fwd_call(q, k, v, causal, True)
+    delta = jnp.sum(o.astype(jnp.float32) * do, axis=-1)
+    delta_l = jnp.broadcast_to(delta[:, :, None], (bh, t, pk.LSE_LANES))
+    ref = pk._bwd_call(q, k, v, do, lse_l, delta_l, causal, True)
+    got = pk._bwd_stream_call(q, k, v, do, lse_l, delta_l, causal, True,
+                              block_q=64, block_k=64)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)

@@ -215,6 +215,11 @@ def main():
         variants = {
             "b1_prod": lambda x: pk._bwd_call(
                 x, k, v, do, lse, delta, True, interpret),
+            # Streamed 3D-grid dq/dkv (no resident K/V — the backward
+            # half of the v6_stream formulation).
+            "b3_stream": lambda x: pk._bwd_stream_call(
+                x, k, v, do, lse, delta, True, interpret,
+                block_q=block, block_k=block),
         }
         if block >= LANES:  # the lane-tile trick needs >= 128-wide blocks
             variants["b2_lanes"] = lambda x: _bwd_call_lanes(

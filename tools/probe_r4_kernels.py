@@ -164,6 +164,17 @@ def flash_streamed_16k():
     o_c, _ = jax.jit(
         lambda q, k, v: pk.flash_attention_lse_chunked(q, k, v, True)
     )(q, k, v)
+    # Streamed backward at the same t (Mosaic legality; numerics are
+    # interpret-pinned in tests/test_pallas.py).
+    bh = shape[0] * shape[1]
+    fold = lambda x: x.reshape(bh, shape[2], shape[3])
+    lse_l = jnp.zeros((bh, shape[2], pk.LSE_LANES), jnp.float32)
+    dq, dk, dv = jax.jit(
+        lambda a, b_, c: pk._bwd_stream_call(
+            a, b_, c, a, lse_l, lse_l, True, False)
+    )(fold(q), fold(k), fold(v))
+    assert np.isfinite(
+        np.asarray(jax.device_get(dq[0, -8:]), np.float32)).all()
     # Tail rows: under causal masking they attend across ALL k-blocks,
     # so this exercises the streamed kernel's cross-block softmax
     # carry (head rows complete inside the first block and would pass
