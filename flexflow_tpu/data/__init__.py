@@ -1,9 +1,15 @@
 from flexflow_tpu.data.csv import load_csv_matrix, load_feature_csvs
-from flexflow_tpu.data.loader import ArrayDataLoader, PrefetchLoader, synthetic_arrays
+from flexflow_tpu.data.loader import (
+    ArrayDataLoader,
+    DeviceResidentLoader,
+    PrefetchLoader,
+    synthetic_arrays,
+)
 from flexflow_tpu.data.criteo import load_criteo_h5, make_dlrm_arrays
 
 __all__ = [
     "ArrayDataLoader",
+    "DeviceResidentLoader",
     "PrefetchLoader",
     "load_csv_matrix",
     "load_feature_csvs",

@@ -63,15 +63,21 @@ class ArrayDataLoader:
         if self.shuffle:
             self._rng.shuffle(self._order)
 
+    def _next_indices(self) -> np.ndarray:
+        """The shared epoch contract: wrap at epoch end (reshuffling
+        when enabled), full batches only."""
+        if self._pos + self.batch_size > self.num_samples:
+            self.reset()
+        idx = self._order[self._pos : self._pos + self.batch_size]
+        self._pos += self.batch_size
+        return idx
+
     def next_batch(self) -> Dict[str, np.ndarray]:
         """Wraps around at epoch end (callers doing epoch accounting use
         ``batches_per_epoch`` + ``reset``).  Rows are gathered by the
         native threaded copy (``native/ffdata.cc``, the reference DLRM
         loader's host-gather, ``dlrm.cu:20-50``)."""
-        if self._pos + self.batch_size > self.num_samples:
-            self.reset()
-        idx = self._order[self._pos : self._pos + self.batch_size]
-        self._pos += self.batch_size
+        idx = self._next_indices()
         from flexflow_tpu.native import gather_rows
 
         return {
@@ -156,6 +162,54 @@ class PrefetchLoader:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+
+
+class DeviceResidentLoader(ArrayDataLoader):
+    """The reference's zero-copy dataset pattern, TPU-native: the
+    ENTIRE dataset is staged on device ONCE (replicated over the mesh —
+    the analogue of the pinned ZC DRAM region every GPU gathers from,
+    ``dlrm.cc:226-330``), and per step only a batch-size index vector
+    crosses host→device; rows gather ON DEVICE (``jnp.take``, the
+    ``dlrm.cu:20-50`` gather) and ``Executor.shard_batch`` moves each
+    gathered batch device-to-device into its consumer's sharding.
+
+    Use when the dataset fits HBM (it is resident for the run); the
+    host-path ``ArrayDataLoader`` + ``PrefetchLoader`` remains the
+    out-of-core path.  Epoch semantics are inherited (full batches
+    only, reshuffle per epoch — ``_next_indices``)."""
+
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        batch_size: int,
+        executor,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        import jax
+
+        super().__init__(arrays, batch_size, shuffle=shuffle, seed=seed)
+        self._ex = executor
+        self._rep = executor.plan.replicated()
+        #: the staged (replicated) dataset — one H2D per array, total.
+        self.device_arrays = {
+            k: jax.device_put(v, self._rep) for k, v in arrays.items()
+        }
+
+    def next_batch(self) -> Dict:
+        import jax
+        import jax.numpy as jnp
+
+        idx_host = self._next_indices()
+        idx = jax.device_put(
+            np.ascontiguousarray(idx_host.astype(np.int32)), self._rep
+        )
+        gathered = {
+            k: jnp.take(v, idx, axis=0)
+            for k, v in self.device_arrays.items()
+        }
+        # Device-to-device placement into each consumer's sharding.
+        return self._ex.shard_batch(gathered)
 
 
 def synthetic_arrays(
