@@ -48,7 +48,7 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --dtype float32|bfloat16   --optimizer sgd|adam   --momentum F
   --lr-schedule constant|cosine|step  --warmup N  --decay-steps N
   --min-lr F  --lr-gamma F (adam only)
-  --profiling   --dry-run   --remat   --trace DIR   --ones-init
+  --profiling   --dry-run   --remat   --trace DIR   --ones-init   --zc-dataset
   --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
   --granules N   --zero-opt
   --eval-iters N (held-out eval after training)   --clip-norm F
@@ -251,6 +251,11 @@ def run_training(
                 "--granules (hybrid mesh) and device-subset placement "
                 "cannot combine yet"
             )
+        if cfg.zc_dataset:
+            raise SystemExit(
+                "--zc-dataset stages onto the full mesh; layer-wise "
+                "(device-subset) strategies use the host loader path"
+            )
     if cfg.dry_run:
         return _dry_run(ff, ex, strategy)
     trainer = Trainer(ex)
@@ -278,15 +283,22 @@ def run_training(
             print("eval: dataset too small to hold out; "
                   "evaluating in-sample")
     if arrays is not None:
-        # Background prefetch overlaps the host gather + H2D transfer
+        if cfg.zc_dataset:
+            # --zc-dataset: the reference DLRM's zero-copy staging —
+            # whole dataset device-resident, per-step on-device gather
+            # (dlrm.cc:226-330); only an index vector crosses H2D.
+            from flexflow_tpu.data.loader import DeviceResidentLoader
+
+            source = iter(DeviceResidentLoader(
+                arrays, cfg.batch_size, ex, shuffle=True, seed=cfg.seed))
+        else:
+            source = iter(ArrayDataLoader(arrays, cfg.batch_size,
+                                          shuffle=True, seed=cfg.seed,
+                                          nthreads=cfg.loaders_per_node))
+        # Background prefetch overlaps the host/gather dispatch path
         # with the device step (the reference's double-buffered ZC
-        # staging); Trainer.fit's own shard_batch is then a no-op.
-        batches = PrefetchLoader(
-            iter(ArrayDataLoader(arrays, cfg.batch_size, shuffle=True,
-                                 seed=cfg.seed,
-                                 nthreads=cfg.loaders_per_node)),
-            ex.shard_batch,
-        )
+        # staging); shard_batch is a no-op on already-placed batches.
+        batches = PrefetchLoader(source, ex.shard_batch)
     iters = cfg.iterations * max(cfg.epochs, 1)
     stats = trainer.fit(iterations=iters, batches=batches, warmup=1,
                         log_every=cfg.print_freq,

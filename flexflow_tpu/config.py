@@ -87,6 +87,11 @@ class FFConfig:
     # step under jax.eval_shape and print the op/param table, running
     # nothing on any device.
     dry_run: bool = False
+    # --zc-dataset: stage the whole dataset on device once (replicated)
+    # and gather batches on device per step — the reference DLRM's
+    # zero-copy staging + in-step gather (dlrm.cc:226-330); use when
+    # the dataset fits HBM.  Off = host gather + prefetched H2D.
+    zc_dataset: bool = False
     # --search: run the MCMC strategy autotuner at launch when no -s
     # file is given (the reference runs its simulator offline and feeds
     # the result back via -s; this folds the two steps into one run).
@@ -176,6 +181,8 @@ class FFConfig:
                 cfg.profiling = True
             elif a == "--dry-run":
                 cfg.dry_run = True
+            elif a == "--zc-dataset":
+                cfg.zc_dataset = True
             elif a == "--remat":
                 cfg.remat = True
             elif a in ("-i", "--iterations"):

@@ -187,29 +187,42 @@ class DeviceResidentLoader(ArrayDataLoader):
         seed: int = 0,
     ):
         import jax
+        import jax.numpy as jnp
 
         super().__init__(arrays, batch_size, shuffle=shuffle, seed=seed)
+        if not hasattr(executor, "plan"):
+            raise ValueError(
+                "DeviceResidentLoader needs a full-mesh Executor (its "
+                "staging replicates over executor.plan); layer-wise "
+                "PipelineExecutor strategies use the host loader path"
+            )
         self._ex = executor
         self._rep = executor.plan.replicated()
         #: the staged (replicated) dataset — one H2D per array, total.
         self.device_arrays = {
             k: jax.device_put(v, self._rep) for k, v in arrays.items()
         }
+        # ONE jitted gather per step, with the consumers' shardings as
+        # out_shardings — gather + reshard fuse into a single dispatch
+        # (per-op eager calls through the relay cost ~16 ms each,
+        # CLAUDE.md; a per-key take loop would be dispatch-dominated).
+        batch_sh = executor.batch_shardings()
+        out_sh = {k: batch_sh.get(k, self._rep) for k in arrays}
+        self._gather = jax.jit(
+            lambda data, idx: {
+                k: jnp.take(v, idx, axis=0) for k, v in data.items()
+            },
+            out_shardings=out_sh,
+        )
 
     def next_batch(self) -> Dict:
         import jax
-        import jax.numpy as jnp
 
         idx_host = self._next_indices()
         idx = jax.device_put(
             np.ascontiguousarray(idx_host.astype(np.int32)), self._rep
         )
-        gathered = {
-            k: jnp.take(v, idx, axis=0)
-            for k, v in self.device_arrays.items()
-        }
-        # Device-to-device placement into each consumer's sharding.
-        return self._ex.shard_batch(gathered)
+        return self._gather(self.device_arrays, idx)
 
 
 def synthetic_arrays(

@@ -26,6 +26,19 @@ def test_dlrm_app_reference_arch_flags(capsys):
     assert "THROUGHPUT =" in capsys.readouterr().out
 
 
+def test_dlrm_app_zc_dataset(capsys):
+    """--zc-dataset routes batches through the device-resident loader
+    (the reference's ZC staging + in-step gather, dlrm.cc:226-330)."""
+    assert dlrm.main([
+        "-b", "16", "-i", "2", "--zc-dataset",
+        "--arch-sparse-feature-size", "8",
+        "--arch-embedding-size", "100-100-100-100",
+        "--arch-mlp-bot", "8-16-8",
+        "--arch-mlp-top", "40-16-1",
+    ]) == 0
+    assert "THROUGHPUT =" in capsys.readouterr().out
+
+
 def test_dlrm_app_loads_reference_pb_strategy(tmp_path, capsys):
     # A reference-format .pb driving table placement end-to-end.
     store = StrategyStore(8)
