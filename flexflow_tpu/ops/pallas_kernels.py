@@ -426,28 +426,17 @@ def _fwd_stream_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         )
 
 
-def flash_attention_lse_streamed(q, k, v, causal: bool = True,
-                                 interpret: Optional[bool] = None,
-                                 block_q: int = 512, block_k: int = 512):
-    """Forward-only streamed flash on (b, h, t, hd): any t with
-    ``t % block == 0``, VMEM-bounded by the blocks alone.  Not yet the
-    production path (no custom VJP; chip-unvalidated) — raced as
-    v6_stream and used by tests to pin numerics in interpret mode."""
-    if interpret is None:
-        interpret = _interpret_default()
-    b, h, t, hd = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
-    bh = b * h
-    fold = lambda x: x.reshape(bh, t, hd)
+def _fwd_stream_call(q, k, v, causal, interpret, block_q, block_k):
+    """Raw streamed forward on FOLDED (bh, t, hd) arrays; returns
+    (o, lse_lanes)."""
+    bh, t, hd = q.shape
     num_kb = t // block_k
     scale = 1.0 / math.sqrt(hd)
     kernel = functools.partial(
         _fwd_stream_kernel, block_q=block_q, block_k=block_k,
         causal=causal, scale=scale, num_kb=num_kb,
     )
-    out, lse = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid=(bh, t // block_q, num_kb),
         in_specs=[
@@ -469,9 +458,91 @@ def flash_attention_lse_streamed(q, k, v, causal: bool = True,
             pltpu.VMEM((block_q, hd), jnp.float32),
         ],
         interpret=interpret,
-    )(fold(q), fold(k), fold(v))
-    return (out.reshape(b, h, t, hd),
-            lse[:, :, 0].reshape(b, h, t))
+    )(q, k, v)
+
+
+def _stream_blocks(t: int, block_q: int, block_k: int):
+    """Clamp the streamed blocks to t; None if t doesn't tile."""
+    bq, bk = min(block_q, t), min(block_k, t)
+    if t % bq or t % bk:
+        return None
+    return bq, bk
+
+
+def _stream_default_block(hd: int) -> int:
+    """Dispatcher block size for the streamed path, scaled so the
+    working set (q/k/v blocks double-buffered + the f32 score block +
+    accumulator) stays inside scoped VMEM as hd grows — unmeasured
+    territory must fail toward smaller blocks, not Mosaic compile
+    errors (the _vmem_block_cap principle).  0 = don't dispatch."""
+    if hd <= 128:
+        return 512
+    if hd <= 256:
+        return 256
+    return 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse_streamed(q, k, v, causal: bool = True,
+                                 interpret: Optional[bool] = None,
+                                 block_q: int = 512, block_k: int = 512):
+    """Streamed flash on (b, h, t, hd): any t with ``t % block == 0``,
+    VMEM bounded by the working blocks alone (no resident K/V, so no
+    ``_vmem_block_cap`` on t).  Fully differentiable — the VJP runs the
+    streamed dq/dkv kernels.  Opt-in production path: the dispatcher
+    routes through it under ``FF_FLASH_STREAMED=1`` for fused-step
+    racing on chip (the FF_FLASH_FORCE_CHUNK pattern); also raced
+    per-kernel as v6_stream/b3_stream."""
+    (o, _lse), _ = _stream_fwd(q, k, v, causal, interpret, block_q, block_k)
+    return o, _lse
+
+
+def _stream_fwd(q, k, v, causal, interpret, block_q, block_k):
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, t, hd = q.shape
+    blocks = _stream_blocks(t, block_q, block_k)
+    assert blocks, (t, block_q, block_k)
+    bq, bk = blocks
+    fold = lambda x: x.reshape(b * h, t, hd)
+    o, lse_l = _fwd_stream_call(
+        fold(q), fold(k), fold(v), causal, interpret, bq, bk
+    )
+    out = (o.reshape(b, h, t, hd), lse_l[:, :, 0].reshape(b, h, t))
+    return out, (q, k, v, out[0], lse_l)
+
+
+def _cotangent_delta_lanes(o, g_o, g_lse, b, h, t):
+    """Shared VJP glue for both flash formulations: the per-row
+    ``delta = sum(o * do)`` with the lse cotangent folded in
+    (``d lse / d s = p``, so it enters ``ds = p * (dp - delta)`` as
+    ``delta -= g_lse``), broadcast to the LSE_LANES layout."""
+    delta = jnp.sum(o.astype(jnp.float32) * g_o.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32).reshape(b, h, t)
+    return jnp.broadcast_to(
+        delta.reshape(b * h, t)[:, :, None], (b * h, t, LSE_LANES)
+    )
+
+
+def _stream_bwd(causal, interpret, block_q, block_k, res, g):
+    if interpret is None:
+        interpret = _interpret_default()
+    q, k, v, o, lse_l = res
+    g_o, g_lse = g
+    b, h, t, hd = q.shape
+    bq, bk = _stream_blocks(t, block_q, block_k)
+    fold = lambda x: x.reshape(b * h, t, hd)
+    delta_l = _cotangent_delta_lanes(o, g_o, g_lse, b, h, t)
+    dq, dk, dv = _bwd_stream_call(
+        fold(q), fold(k), fold(v), fold(g_o.astype(q.dtype)),
+        lse_l, delta_l, causal, interpret, block_q=bq, block_k=bk,
+    )
+    unfold = lambda x: x.reshape(b, h, t, hd)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+flash_attention_lse_streamed.defvjp(_stream_fwd, _stream_bwd)
 
 
 def _dq_stream_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -716,14 +787,7 @@ def _flash_bwd(causal, interpret, res, g):
     g_o, g_lse = g
     b, h, t, hd = q.shape
     fold = lambda x: x.reshape(b * h, t, hd)
-    delta = jnp.sum(o.astype(jnp.float32) * g_o.astype(jnp.float32), axis=-1)
-    # d lse / d s = softmax(s) = p, so the lse cotangent enters the
-    # shared ds = p * (dp - delta) term as delta := delta - g_lse.
-    if g_lse is not None:
-        delta = delta - g_lse.astype(jnp.float32).reshape(b, h, t)
-    delta_l = jnp.broadcast_to(
-        delta.reshape(b * h, t)[:, :, None], (b * h, t, LSE_LANES)
-    )
+    delta_l = _cotangent_delta_lanes(o, g_o, g_lse, b, h, t)
     dq, dk, dv = _bwd_call(
         fold(q), fold(k), fold(v), fold(g_o.astype(q.dtype)),
         lse_l, delta_l, causal, interpret
@@ -874,6 +938,12 @@ def attention_lse_blocked(q, k, v, causal: bool = True,
 #: relay is trustworthy.  0 = off (normal dispatch).
 _FORCE_CHUNK = int(os.environ.get("FF_FLASH_FORCE_CHUNK", "0") or 0)
 
+#: FF_FLASH_STREAMED=1: dispatch through the streamed 3D-grid
+#: formulation (no resident K/V; fwd + bwd custom VJP) wherever t
+#: tiles by the streamed blocks — the fused-step racing knob for
+#: promoting v6_stream/b3_stream to production after chip validation.
+_STREAMED = os.environ.get("FF_FLASH_STREAMED", "0") == "1"
+
 
 def flash_attention_lse_auto(q, k, v, causal: bool = True,
                              interpret: Optional[bool] = None):
@@ -884,6 +954,12 @@ def flash_attention_lse_auto(q, k, v, causal: bool = True,
     (keeps the einsum path reachable if the support gates and this
     dispatcher ever diverge)."""
     b, h, t, hd = q.shape
+    if _STREAMED and t >= 16 and hd >= 8:
+        blk = _stream_default_block(hd)
+        if blk and _stream_blocks(t, blk, blk) is not None:
+            return flash_attention_lse_streamed(
+                q, k, v, causal, interpret, blk, blk
+            )
     if (_FORCE_CHUNK and t > _FORCE_CHUNK and t % _FORCE_CHUNK == 0
             and flash_supported((b, h, _FORCE_CHUNK, hd), q.dtype)):
         # A stale/oversized env value falls through to normal dispatch

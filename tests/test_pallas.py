@@ -401,3 +401,51 @@ def test_streamed_backward_matches_production(rng, causal):
     for a, b in zip(got, ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_streamed_vjp_matches_production_grads(rng):
+    """flash_attention_lse_streamed is a full custom-VJP path: grads
+    must match the production kernel's."""
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 2, 256, 64)),
+                           jnp.float32) for _ in range(3))
+    cot = jnp.asarray(rng.standard_normal((1, 2, 256, 64)), jnp.float32)
+
+    def loss_stream(q, k, v):
+        return jnp.sum(pk.flash_attention_lse_streamed(
+            q, k, v, True, None, 64, 64)[0] * cot)
+
+    def loss_prod(q, k, v):
+        return jnp.sum(pk.flash_attention_lse(q, k, v, True)[0] * cot)
+
+    gs = jax.grad(loss_stream, argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(loss_prod, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_streamed_env_dispatch(monkeypatch):
+    """FF_FLASH_STREAMED=1 routes auto through the streamed path for
+    tiling shapes (observed via a sentinel wrapper, not just output
+    shape) and falls through for ragged ones and oversized head dims."""
+    calls = []
+    real = pk.flash_attention_lse_streamed
+
+    def sentinel(q, k, v, *a, **kw):
+        calls.append(q.shape)
+        return real(q, k, v, *a, **kw)
+
+    monkeypatch.setattr(pk, "_STREAMED", True)
+    monkeypatch.setattr(pk, "flash_attention_lse_streamed", sentinel)
+    q = jnp.zeros((1, 1, 1024, 64), jnp.float32)
+    res = pk.flash_attention_lse_auto(q, q, q)
+    assert res is not None and res[0].shape == q.shape
+    assert calls == [q.shape], "streamed path not taken"
+    # Ragged t: streamed can't tile, normal dispatch takes over.
+    q2 = jnp.zeros((1, 1, 8200, 64), jnp.bfloat16)
+    res2 = pk.flash_attention_lse_auto(q2, q2, q2)
+    assert res2 is not None and res2[0].shape == q2.shape
+    assert len(calls) == 1, "ragged t must not route streamed"
+    # Oversized head dim: VMEM-unsafe at any streamed block — fall
+    # through (here: to None, nothing else supports it either).
+    assert pk._stream_default_block(512) == 0

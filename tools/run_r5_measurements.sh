@@ -62,18 +62,22 @@ step 7 sweep_flash python tools/sweep_flash.py
 # 8. Transformer step decomposition (layer slope + remat + chunk race).
 step 8 lm_decomp python tools/profile_lm_decomp.py
 
-# 9. ffsim calibration: measured fused-step vs simulated makespan
+# 9. Fused-step race: production flash dispatch vs the streamed
+# formulation (FF_FLASH_STREAMED) — the promotion gate for v6_stream.
+step 9 streamed_step python tools/race_streamed_step.py
+
+# 10. ffsim calibration: measured fused-step vs simulated makespan
 # (VERDICT item 3 — anchors the *_speedup_sim numbers).
-step 9 calibrate bash -c 'if [ -f tools/calibrate_ffsim.py ]; then python tools/calibrate_ffsim.py; else echo "calibrate_ffsim.py not present yet"; fi'
+step 10 calibrate bash -c 'if [ -f tools/calibrate_ffsim.py ]; then python tools/calibrate_ffsim.py; else echo "calibrate_ffsim.py not present yet"; fi'
 
-# 10. Input-prefetch A/B (VERDICT item 4 — host-decode overlap).
-step 10 prefetch_ab bash -c 'if [ -f tools/measure_prefetch.py ]; then python tools/measure_prefetch.py; else echo "measure_prefetch.py not present yet"; fi'
+# 11. Input-prefetch A/B/C (VERDICT item 4 — host/ZC overlap).
+step 11 prefetch_ab bash -c 'if [ -f tools/measure_prefetch.py ]; then python tools/measure_prefetch.py; else echo "measure_prefetch.py not present yet"; fi'
 
-# 11. XProf device-plane op breakdown of the fused train step.
-step 11 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
+# 12. XProf device-plane op breakdown of the fused train step.
+step 12 lm_trace python tools/profile_lm_trace.py "$OUT/lm_trace_dir"
 
-# 12. Measured-mode strategy search artifact.
-step 12 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
+# 13. Measured-mode strategy search artifact.
+step 13 search_measured python -m flexflow_tpu.search --model alexnet -b 256 \
   --devices 4 --measured -o "$OUT/alexnet_strategy_measured.json"
 
 echo "sequence complete $(date -u +%FT%TZ)" | tee -a "$OUT/sequence.log"
