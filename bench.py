@@ -356,6 +356,45 @@ def bench_candle(on_tpu: bool):
     return stats["samples_per_s"]
 
 
+def bench_superstep(n_chips: int, on_tpu: bool):
+    """Dispatch-amortization sweep (superstep execution): k train steps
+    fused into ONE compiled ``lax.scan`` dispatch with a single
+    host-readback fence per call (``Executor.build_superstep``).  Swept
+    at k in {1,4,8,16} on a dispatch-bound MLP — per-step compute far
+    below the per-dispatch cost, which through the axon relay is the
+    ~16 ms/call floor that dominates every eager step.  Reports
+    ms/step per k plus the k=8 amortization factor (the default
+    ``--steps-per-call`` operating point; k=16 probes the approach to
+    the relay-safe chain cap)."""
+    import numpy as np
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    batch = 64 * n_chips if on_tpu else 32
+    width = 256 if on_tpu else 64
+    iters = 32 if on_tpu else 16  # divisible by 16: no tail recompile
+    ff = FFModel(FFConfig(batch_size=batch, seed=3))
+    x = ff.create_tensor((batch, width), name="x")
+    lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+    t = ff.dense(x, width, activation="relu", name="fc1")
+    t = ff.dense(t, 8, name="fc2")
+    ff.softmax(t, lbl, name="softmax")
+    ex = Executor(ff, optimizer=SGDOptimizer(lr=0.01, momentum=0.9))
+    out = {"batch_size": batch, "iterations": iters}
+    for k in (1, 4, 8, 16):
+        stats = Trainer(ex).fit(iterations=iters, warmup=1,
+                                steps_per_call=k)
+        out[f"k{k}_ms_per_step"] = round(stats["elapsed_s"] / iters * 1e3, 3)
+    out["amortization_k8_vs_k1"] = round(
+        out["k1_ms_per_step"] / out["k8_ms_per_step"], 3
+    )
+    return out
+
+
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
     speedup (the ICML'18 headline claims it for AlexNet/VGG/Inception;
@@ -499,6 +538,12 @@ def main():
             )
     except Exception as e:
         extra["nmt_error"] = f"{type(e).__name__}: {e}"
+    checkpoint_result(per_chip)
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["superstep"] = bench_superstep(n_chips, on_tpu)
+    except Exception as e:
+        extra["superstep_error"] = f"{type(e).__name__}: {e}"
     checkpoint_result(per_chip)
     try:
         with contextlib.redirect_stdout(sys.stderr):

@@ -50,7 +50,7 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --min-lr F  --lr-gamma F (adam only)
   --profiling   --dry-run   --remat   --trace DIR   --ones-init   --zc-dataset
   --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
-  --granules N   --zero-opt
+  --granules N   --zero-opt   --steps-per-call K (superstep fusion)
   --eval-iters N (held-out eval after training)   --clip-norm F
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)"""
@@ -246,6 +246,12 @@ def run_training(
                 "--accum-steps composes with full-mesh strategies only; "
                 "pipeline strategies microbatch via --microbatches"
             )
+        if cfg.steps_per_call > 1:
+            raise SystemExit(
+                "--steps-per-call (superstep fusion) requires full-mesh "
+                "strategies; pipeline strategies dispatch per-stage "
+                "programs the superstep scan cannot fuse"
+            )
         if mesh_plan is not None:
             raise SystemExit(
                 "--granules (hybrid mesh) and device-subset placement "
@@ -302,7 +308,8 @@ def run_training(
     iters = cfg.iterations * max(cfg.epochs, 1)
     stats = trainer.fit(iterations=iters, batches=batches, warmup=1,
                         log_every=cfg.print_freq,
-                        accum_steps=cfg.accum_steps)
+                        accum_steps=cfg.accum_steps,
+                        steps_per_call=cfg.steps_per_call)
     print(f"ELAPSED TIME = {stats['elapsed_s']:.4f}s")
     print(f"THROUGHPUT = {stats['samples_per_s']:.2f} {label}/s")
     if cfg.eval_iters > 0:

@@ -68,6 +68,14 @@ class FFConfig:
     # Gradient accumulation: microbatches per optimizer step
     # (Executor.accum_train_step).
     accum_steps: int = 1
+    # --steps-per-call K: superstep execution — K full train steps
+    # compiled into ONE jitted lax.scan dispatch with a single host
+    # readback fence per superstep (Executor.build_superstep).  The
+    # dispatch-overhead amortization path for the relay's ~16 ms/call
+    # floor; full-mesh strategies only (pipeline strategies refuse).
+    # 1 = off; Trainer clamps at MAX_STEPS_PER_CALL (keep-chains-short
+    # relay hazard).
+    steps_per_call: int = 1
     # Row-sparse embedding updates: differentiate w.r.t. gathered rows
     # and scatter the row grads into the (donated) table instead of
     # materializing a table-sized dense gradient.  Exact plain-SGD
@@ -207,6 +215,13 @@ class FFConfig:
                 cfg.lr_gamma = float(_next())
             elif a == "--accum-steps":
                 cfg.accum_steps = int(_next())
+            elif a == "--steps-per-call":
+                cfg.steps_per_call = int(_next())
+                if cfg.steps_per_call < 1:
+                    raise SystemExit(
+                        f"--steps-per-call must be >= 1, got "
+                        f"{cfg.steps_per_call}"
+                    )
             elif a == "--granules":
                 cfg.granules = int(_next())
             elif a == "--microbatches":

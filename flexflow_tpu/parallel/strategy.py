@@ -114,6 +114,21 @@ class StrategyStore:
     def __contains__(self, op_name: str) -> bool:
         return op_name in self.table
 
+    def superstep_capable(self) -> bool:
+        """Whether superstep execution (``Executor.build_superstep``:
+        K train steps fused into one compiled dispatch) can realize
+        this strategy.  True when every op spans the full mesh;
+        layer-wise placement (``device_ids`` naming a proper device
+        subset, the reference's per-op ``gpu[]`` lists) runs through
+        ``PipelineExecutor``, whose per-stage host dispatch a single
+        ``lax.scan`` cannot fuse — callers must refuse loudly rather
+        than silently fall back to per-step dispatch."""
+        return not any(
+            pc.device_ids is not None
+            and len(set(pc.device_ids)) < self.num_devices
+            for pc in self.table.values()
+        )
+
     # -- (de)serialization ------------------------------------------------
 
     def save(self, path: str) -> None:

@@ -104,6 +104,7 @@ class Executor:
             for t in op.inputs:
                 self._consumer.setdefault(t.name, op)
         self._accum_cache: Dict[int, Any] = {}
+        self._superstep_cache: Dict[Tuple[int, int], Any] = {}
 
     # -- sharding assembly -------------------------------------------------
 
@@ -584,6 +585,14 @@ class Executor:
         cached = self._accum_cache.get(accum_steps)
         if cached is not None:
             return cached
+        fn = jax.jit(self._build_accum_step(accum_steps), donate_argnums=(0, 1, 2))
+        self._accum_cache[accum_steps] = fn
+        return fn
+
+    def _build_accum_step(self, accum_steps: int):
+        """The unjitted accumulated step (see :meth:`accum_train_step`)
+        — also the per-step body :meth:`build_superstep` scans over when
+        superstep execution composes with gradient accumulation."""
         for op in self.model.layers:
             if op.is_loss and getattr(op, "reduction", "mean") != "mean":
                 # Sum-reduced losses would need grad SUM across
@@ -614,9 +623,7 @@ class Executor:
             new_params, new_opt = self.optimizer.update(params, opt_state, g)
             return new_params, self._constrain_zero_opt(new_opt), new_state, m
 
-        fn = jax.jit(step, donate_argnums=(0, 1, 2))
-        self._accum_cache[accum_steps] = fn
-        return fn
+        return step
 
     def stack_microbatches(self, batch: Dict[str, Any], accum_steps: int):
         """Reshape a ``(accum*b, ...)`` host batch into the
@@ -625,6 +632,94 @@ class Executor:
         for k, v in batch.items():
             assert v.shape[0] % accum_steps == 0, (k, v.shape, accum_steps)
             out[k] = v.reshape((accum_steps, v.shape[0] // accum_steps) + v.shape[1:])
+        return out
+
+    # -- superstep execution -------------------------------------------------
+
+    def build_superstep(self, k: int, accum_steps: int = 1):
+        """K full train steps compiled into ONE jitted dispatch.
+
+        The per-step host round-trip is the largest remaining overhead
+        at dispatch-bound shapes (the axon relay's ~16 ms/call floor);
+        the reference amortizes it by letting Legion batch and pipeline
+        operator tasks across iterations.  Here the training LOOP itself
+        moves into XLA: a ``lax.scan`` of the train step over a stacked
+        batch queue shaped ``(k,) + batch`` (see :meth:`stack_steps`),
+        with the ``(params, opt_state, op_state)`` carry donated — op
+        state carries the dropout RNG chain, so stochastic layers
+        advance exactly as in k sequential steps.  Per-step metrics come
+        back stacked ``(k, ...)`` in one host readback, so loss curves
+        unstack bit-identically to k=1 execution.
+
+        Composes with gradient accumulation (``accum_steps > 1`` scans
+        the accumulated step, whose own inner microbatch scan nests
+        inside) and with ZeRO optimizer sharding (the step body re-pins
+        moment shardings every iteration).  Layer-wise (device-subset)
+        strategies dispatch per-stage programs from the host and cannot
+        fuse — Executor's constructor already rejects them, and
+        :meth:`StrategyStore.superstep_capable` lets callers refuse
+        before building anything.
+        """
+        if k < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {k}")
+        if not self.strategy.superstep_capable():
+            raise ValueError(
+                "superstep execution requires full-mesh strategies; "
+                "layer-wise (device-subset) placement dispatches "
+                "per-stage programs the scan cannot fuse"
+            )
+        cached = self._superstep_cache.get((k, accum_steps))
+        if cached is not None:
+            return cached
+        inner = (
+            self._build_accum_step(accum_steps)
+            if accum_steps > 1
+            else self.build_train_step()
+        )
+
+        def superstep(params, opt_state, state, stacked):
+            def body(carry, batch):
+                p, o, s = carry
+                p, o, s, m = inner(p, o, s, batch)
+                return (p, o, s), m
+
+            (p, o, s), ms = jax.lax.scan(
+                body, (params, opt_state, state), stacked
+            )
+            return p, o, s, ms
+
+        fn = jax.jit(superstep, donate_argnums=(0, 1, 2))
+        self._superstep_cache[(k, accum_steps)] = fn
+        return fn
+
+    def stack_steps(self, batches: Sequence[Dict[str, Any]], accum_steps: int = 1):
+        """Stack k per-step host batches into the device-resident
+        ``(k, ...)`` queue :meth:`build_superstep` scans over, placed
+        with each input's consumer sharding under unsharded leading
+        step (and microbatch) dims.  With ``accum_steps > 1`` each
+        element first takes the ``(accum, b, ...)`` microbatch layout
+        (:meth:`stack_microbatches`)."""
+        import numpy as np
+
+        if accum_steps > 1:
+            batches = [self.stack_microbatches(b, accum_steps) for b in batches]
+        lead = 1 + (1 if accum_steps > 1 else 0)
+        sh = self._batch_shardings
+        out = {}
+        for name in batches[0]:
+            vals = [b[name] for b in batches]
+            if all(isinstance(v, np.ndarray) for v in vals):
+                stacked = np.stack(vals)
+            else:
+                # Already-placed device batches (caller-owned loaders):
+                # one on-device concat, still a single dispatch.
+                stacked = jnp.stack([jnp.asarray(v) for v in vals])
+            if name in sh:
+                spec = PartitionSpec(*([None] * lead), *sh[name].spec)
+                stacked = jax.device_put(
+                    stacked, NamedSharding(self.plan.mesh, spec)
+                )
+            out[name] = stacked
         return out
 
     @functools.cached_property

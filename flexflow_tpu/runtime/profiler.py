@@ -40,6 +40,26 @@ class OpProfile:
         return f"{self.name:28s} {self.op_type:12s} {self.time_us:10.1f} us  -> {shapes}"
 
 
+def _on_axon_relay() -> bool:
+    """True when the backend is the axon TPU relay: every dispatch pays
+    the ~16 ms tunnel round-trip, so per-op eager timing measures the
+    relay, not the op.  The relay masquerades as "tpu" in
+    ``default_backend()``; its registration name ("axon") shows in
+    JAX_PLATFORMS (sitecustomize-forced) and in the device objects."""
+    import os
+
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+        if "axon" in os.environ.get("JAX_PLATFORMS", "").lower():
+            return True
+        d = jax.devices()[0]
+        tag = f"{getattr(d, 'platform', '')} {type(d).__name__} {d!r}"
+        return "axon" in tag.lower()
+    except Exception:
+        return False
+
+
 def profile_ops(
     ex: Executor,
     params: Any,
@@ -54,6 +74,20 @@ def profile_ops(
     each op runs with its real sharded inputs (produced by the previous
     ops) so the times include the op's own collectives.
     """
+    if _on_axon_relay():
+        import warnings
+
+        msg = (
+            "profile_ops: the backend is the axon TPU relay, where every "
+            "eager dispatch costs ~16 ms regardless of compute — per-op "
+            "times below are dispatch-dominated and MEANINGLESS.  Profile "
+            "the fused jitted step instead (Trainer.fit throughput, or an "
+            "XProf trace via --trace DIR / runtime.profiler.trace)."
+        )
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
+        import logging
+
+        logging.getLogger("ff.profiler").warning(msg)
     env: Dict[str, jax.Array] = {}
     for t in ex.model.input_tensors:
         env[t.name] = jax.device_put(batch[t.name], ex.input_sharding(t))

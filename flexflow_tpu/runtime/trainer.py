@@ -10,6 +10,7 @@ so relative numbers are comparable.
 from __future__ import annotations
 
 import contextlib
+import logging
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
@@ -20,15 +21,22 @@ import numpy as np
 from flexflow_tpu.metrics import PerfMetrics
 from flexflow_tpu.runtime.executor import Executor
 
+_log = logging.getLogger("ff.trainer")
+
+#: Relay hazard ceiling for ``steps_per_call`` (CLAUDE.md: long
+#: dependent chains of one jitted function between fences have wedged
+#: the tunnel; ~20 fused steps between host readbacks has always been
+#: safe).
+MAX_STEPS_PER_CALL = 20
+
 
 class Trainer:
     def __init__(self, executor: Executor):
         self.ex = executor
         self.metrics = PerfMetrics()
 
-    def synthetic_batch(self, seed: int = 0) -> Dict[str, jax.Array]:
-        """Device-resident synthetic inputs (reference: syntheticInput,
-        ``config.h:73``; DLRM loads random data once, ``dlrm.cc:144-150``)."""
+    def _synthetic_host_batch(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        """Host-side synthetic inputs keyed by input-tensor name."""
         rng = np.random.default_rng(seed)
         batch = {}
         for t in self.ex.model.input_tensors:
@@ -41,7 +49,12 @@ class Trainer:
                 arr = rng.standard_normal(size=t.shape).astype(np.float32)
                 arr = np.asarray(arr, dtype=t.dtype)  # ml_dtypes handles bf16
             batch[t.name] = arr
-        return self.ex.shard_batch(batch)
+        return batch
+
+    def synthetic_batch(self, seed: int = 0) -> Dict[str, jax.Array]:
+        """Device-resident synthetic inputs (reference: syntheticInput,
+        ``config.h:73``; DLRM loads random data once, ``dlrm.cc:144-150``)."""
+        return self.ex.shard_batch(self._synthetic_host_batch(seed))
 
     def fit(
         self,
@@ -54,9 +67,16 @@ class Trainer:
         resume: bool = True,
         accum_steps: int = 1,
         prefetch: int = 2,
+        steps_per_call: int = 1,
     ) -> Dict[str, float]:
         """Run ``iterations`` steps; returns throughput stats computed
         with the reference formula.
+
+        ``steps_per_call > 1`` switches to superstep execution
+        (``Executor.build_superstep``): K train steps fused into one
+        compiled ``lax.scan`` dispatch, fencing with ``jax.device_get``
+        once per superstep — the dispatch-overhead amortization path
+        (full-mesh strategies only; capped at ``MAX_STEPS_PER_CALL``).
 
         User-supplied ``batches`` are double-buffered by default: a
         background thread runs the host path (decode/gather) and the
@@ -70,6 +90,11 @@ class Trainer:
         from the latest saved step when ``resume`` and saves every
         ``save_every`` steps plus once at the end — the crash-recovery
         subsystem the reference lacks entirely (SURVEY.md §5)."""
+        if steps_per_call > 1:
+            return self._fit_superstep(
+                iterations, batches, warmup, log_every, checkpoint,
+                save_every, resume, accum_steps, prefetch, steps_per_call,
+            )
         ex = self.ex
         if accum_steps > 1:
             accum_fn = ex.accum_train_step(accum_steps)
@@ -189,6 +214,211 @@ class Trainer:
                 "iterations": iterations,
                 "batch_size": batch_size,
                 "loss": float(self.metrics.avg_loss),
+            }
+        finally:
+            if owned_prefetch is not None:
+                owned_prefetch.close()
+
+    def _fit_superstep(
+        self,
+        iterations: int,
+        batches,
+        warmup: int,
+        log_every: int,
+        checkpoint,
+        save_every: int,
+        resume: bool,
+        accum_steps: int,
+        prefetch: int,
+        k: int,
+    ) -> Dict[str, float]:
+        """Superstep training loop: K steps per compiled dispatch.
+
+        The measurement protocol is :meth:`fit`'s (fenced timed region,
+        checkpoint I/O excluded), but the fence granularity is one host
+        readback of the stacked per-step metrics PER SUPERSTEP — both
+        the amortization win and the relay keep-chains-short discipline.
+        The next stacked batch double-buffers through ``PrefetchLoader``
+        while the current superstep runs on device.
+
+        Accounting deviation from the k=1 path, by design: warmup
+        ROUNDS UP to whole supersteps — ``ceil(warmup/k)`` calls of the
+        SAME compiled k-program, i.e. ``ceil(warmup/k)*k`` real updates
+        and batches — because the warmup call is what keeps the timed
+        program's compile outside the timed region (a warmup-sized scan
+        would compile a different program and leave the k-program's
+        compile inside the measurement).  Checkpoint step numbers still
+        equal applied updates.  Finite ``batches`` iterables must be
+        sized for this contract; exhaustion raises a ValueError naming
+        the required count instead of dying mid-loop.  A non-divisible
+        ``iterations`` tail runs as one shorter superstep (a second
+        compile — prefer ``iterations % k == 0``).
+        """
+        ex = self.ex
+        if not isinstance(ex, Executor):
+            raise ValueError(
+                "steps_per_call > 1 requires the full-mesh Executor; "
+                "pipeline (layer-wise device-subset) strategies dispatch "
+                "per-stage programs the superstep scan cannot fuse — "
+                "run them with steps_per_call=1"
+            )
+        assert iterations > 0, "fit() needs at least one iteration"
+        if k > MAX_STEPS_PER_CALL:
+            _log.warning(
+                "steps_per_call=%d exceeds the relay-safe fence cap; "
+                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
+                k, MAX_STEPS_PER_CALL,
+            )
+            k = MAX_STEPS_PER_CALL
+        step_fns = {k: ex.build_superstep(k, accum_steps)}
+        params, opt_state, state = ex.init()
+        start_step = 0
+        if checkpoint is not None and resume:
+            if checkpoint.latest_step() is not None:
+                start_step, params, opt_state, state = checkpoint.restore(
+                    templates=(params, opt_state, state)
+                )
+                print(f"resumed from step {start_step}")
+
+        warm_calls = -(-warmup // k) if warmup > 0 else 0
+        if warm_calls and warm_calls * k != warmup:
+            _log.info(
+                "steps_per_call=%d: warmup rounded up from %d to %d steps "
+                "(%d supersteps)", k, warmup, warm_calls * k, warm_calls,
+            )
+        plan = [k] * (warm_calls + iterations // k)
+        if iterations % k:
+            plan.append(iterations % k)
+        total_steps = sum(plan)
+
+        from flexflow_tpu.data.loader import PrefetchLoader
+
+        owned_prefetch = None
+        if batches is None:
+            host = self._synthetic_host_batch()
+            fixed: Dict[int, Any] = {}
+
+            def synth():
+                for n in plan:
+                    if n not in fixed:
+                        fixed[n] = ex.stack_steps([host] * n, accum_steps)
+                    yield fixed[n]
+
+            batches = synth()
+        else:
+            src = iter(batches)
+
+            def groups():
+                done = 0
+                for n in plan:
+                    g = []
+                    for _ in range(n):
+                        try:
+                            g.append(next(src))
+                        except StopIteration:
+                            raise ValueError(
+                                f"batches exhausted after {done} steps; "
+                                f"steps_per_call={k} needs "
+                                f"ceil(warmup/k)*k + iterations = "
+                                f"{total_steps} batches (warmup rounds "
+                                f"up to whole supersteps)"
+                            ) from None
+                        done += 1
+                    yield g
+
+            place = lambda g: ex.stack_steps(g, accum_steps)
+            if isinstance(batches, PrefetchLoader):
+                # Caller-owned loader: it already overlaps host work +
+                # placement on its own thread; stack device-to-device
+                # synchronously rather than spinning a second loader
+                # thread that would re-place every batch.
+                batches = (place(g) for g in groups())
+            elif prefetch > 0:
+                owned_prefetch = PrefetchLoader(groups(), place, depth=prefetch)
+                batches = iter(owned_prefetch)
+            else:
+                batches = (place(g) for g in groups())
+
+        try:
+            ms = None
+            for _ in range(warm_calls):
+                superbatch = next(batches)
+                params, opt_state, state, ms = step_fns[k](
+                    params, opt_state, state, superbatch
+                )
+            start_step += warm_calls * k
+            if ms is not None:
+                jax.device_get(ms)  # fence: compile outside the timed loop
+
+            trace_ctx = contextlib.nullcontext()
+            if ex.config.trace_dir:
+                from flexflow_tpu.runtime.profiler import trace
+
+                trace_ctx = trace(ex.config.trace_dir)
+            ckpt_s = 0.0
+            timed = plan[warm_calls:]
+            steps_done = 0
+            superbatch = None
+            with trace_ctx:
+                start = time.perf_counter()
+                for n in timed:
+                    if n not in step_fns:
+                        step_fns[n] = ex.build_superstep(n, accum_steps)
+                    superbatch = next(batches)
+                    params, opt_state, state, ms = step_fns[n](
+                        params, opt_state, state, superbatch
+                    )
+                    # ONE host readback per superstep: the execution
+                    # fence AND the stacked per-step metrics, unstacked
+                    # so the loss curve is bit-identical to k=1.
+                    host_ms = jax.device_get(ms)
+                    for j in range(n):
+                        self.metrics.update(
+                            {key: v[j] for key, v in host_ms.items()}
+                        )
+                        steps_done += 1
+                        if log_every and steps_done % log_every == 0:
+                            print(f"iter {steps_done}: {self.metrics.report()}")
+                    if (
+                        checkpoint is not None and save_every
+                        and steps_done // save_every
+                        > (steps_done - n) // save_every
+                    ):
+                        # Superstep granularity: save at the first
+                        # boundary past each save_every multiple.
+                        t0 = time.perf_counter()
+                        checkpoint.save(
+                            start_step + steps_done, params, opt_state, state
+                        )
+                        ckpt_s += time.perf_counter() - t0
+                elapsed = time.perf_counter() - start - ckpt_s
+
+            if checkpoint is not None:
+                checkpoint.save(start_step + iterations, params, opt_state, state)
+            if ex.config.profiling:
+                from flexflow_tpu.runtime.profiler import profile_ops, report
+
+                one = {
+                    key: (
+                        v[0].reshape((-1,) + v.shape[3:])
+                        if accum_steps > 1 else v[0]
+                    )
+                    for key, v in superbatch.items()
+                }
+                print(report(profile_ops(ex, params, state, one)))
+            batch_size = ex.model.input_tensors[0].shape[0]
+            throughput = iterations * batch_size / elapsed
+            print(f"time = {elapsed:.4f}s")
+            print(f"tp = {throughput:.2f} samples/s")
+            self.final = (params, opt_state, state)
+            return {
+                "elapsed_s": elapsed,
+                "samples_per_s": throughput,
+                "iterations": iterations,
+                "batch_size": batch_size,
+                "loss": float(self.metrics.avg_loss),
+                "steps_per_call": k,
+                "supersteps": len(timed),
             }
         finally:
             if owned_prefetch is not None:

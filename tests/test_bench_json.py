@@ -1,0 +1,99 @@
+"""bench.py stdout contract: exactly ONE JSON line on stdout.
+
+The driver parses bench.py's stdout as a single JSON record; every
+human-readable printout (the reference's ``tp = ...`` lines, Trainer
+timing, sub-benchmark chatter) must land on stderr.  Until now this
+CLAUDE.md invariant was enforced only by convention — this test pins
+the plumbing with the heavy benchmark legs stubbed out (each stub
+prints to ITS caller's stdout exactly like Trainer.fit does, so the
+redirect_stdout routing itself is what is under test).
+"""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+# bench.py lives at the repo root (a driver script, not a package
+# module); resolvable regardless of how pytest was invoked.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@pytest.fixture
+def stubbed_bench(monkeypatch):
+    import bench
+
+    def chatty(value):
+        # Mimic Trainer.fit's reference-protocol prints: they go to
+        # whatever stdout is current, and main() must reroute them.
+        print("time = 0.0001s")
+        print("tp = 1.00 samples/s")
+        return value
+
+    monkeypatch.setattr(bench, "probe_backend", lambda: ("cpu", 0, None))
+    monkeypatch.setattr(
+        bench, "bench_alexnet", lambda n, t: chatty((100.0, 0.1, 32))
+    )
+    monkeypatch.setattr(
+        bench, "bench_dlrm", lambda n, t: chatty((50.0, 0.05, None))
+    )
+    monkeypatch.setattr(
+        bench, "bench_transformer", lambda t: chatty((1000.0, 0.2))
+    )
+    monkeypatch.setattr(
+        bench, "bench_transformer_longctx", lambda t: chatty((500.0, 0.15))
+    )
+    monkeypatch.setattr(
+        bench, "bench_transformer_32k", lambda t: chatty((100.0, 0.1))
+    )
+    monkeypatch.setattr(bench, "bench_candle", lambda t: chatty(10.0))
+    monkeypatch.setattr(
+        bench, "bench_nmt", lambda n, t: chatty((1.0, 20.0, 2))
+    )
+    monkeypatch.setattr(
+        bench, "bench_superstep",
+        lambda n, t: chatty({"k1_ms_per_step": 2.0, "k8_ms_per_step": 1.0}),
+    )
+    monkeypatch.setattr(
+        bench, "bench_op_parallel_speedup",
+        lambda n: {"op_parallel_speedup_sim": 1.5},
+    )
+    return bench
+
+
+def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
+    out, err = io.StringIO(), io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    monkeypatch.setattr(sys, "stderr", err)
+    rc = stubbed_bench.main()
+    assert rc == 0
+    lines = [l for l in out.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {lines}"
+    record = json.loads(lines[0])
+    assert record["metric"] == "alexnet_imgs_per_sec_per_chip"
+    assert record["value"] == 100.0
+    assert record["extra"]["superstep"]["k8_ms_per_step"] == 1.0
+    # The chatter landed on stderr, not stdout.
+    assert "tp = " in err.getvalue()
+
+
+def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
+    def boom(*a, **k):
+        print("partial output before the crash")
+        raise RuntimeError("leg exploded")
+
+    monkeypatch.setattr(stubbed_bench, "bench_dlrm", boom)
+    monkeypatch.setattr(stubbed_bench, "bench_superstep", boom)
+    out, err = io.StringIO(), io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    monkeypatch.setattr(sys, "stderr", err)
+    assert stubbed_bench.main() == 0
+    lines = [l for l in out.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert "leg exploded" in record["extra"]["dlrm_error"]
+    assert "leg exploded" in record["extra"]["superstep_error"]

@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.graph import FFModel
@@ -86,3 +87,42 @@ def test_profiling_flag_prints_breakdown(capsys):
     out = capsys.readouterr().out
     assert "fc1" in out and "TOTAL" in out
     assert "tp = " in out  # the reference throughput printout
+
+
+def test_relay_guard_warns_on_axon_backend(monkeypatch):
+    """profile_ops on the axon relay is dispatch-dominated (~16 ms/call
+    floor) and must warn loudly, pointing at the fused-step paths."""
+    from flexflow_tpu.runtime import profiler
+
+    monkeypatch.setattr(profiler, "_on_axon_relay", lambda: True)
+    ff = _model()
+    ex = Executor(ff)
+    params, _, state = ex.init()
+    with pytest.warns(RuntimeWarning, match="dispatch-dominated"):
+        profiles = profile_ops(ex, params, state, _batch(ex), reps=1,
+                               warmup=0)
+    assert profiles  # guard warns but does not block the measurement
+
+
+def test_relay_detection():
+    """_on_axon_relay: CPU backend is never the relay; a masquerading
+    non-cpu backend is recognized via the JAX_PLATFORMS override the
+    sitecustomize forces."""
+    from flexflow_tpu.runtime import profiler
+
+    assert profiler._on_axon_relay() is False  # conftest pins cpu
+
+    class _FakeJax:
+        @staticmethod
+        def default_backend():
+            return "tpu"
+
+        @staticmethod
+        def devices():
+            return []
+
+    import unittest.mock as mock
+
+    with mock.patch.object(profiler, "jax", _FakeJax), \
+         mock.patch.dict(os.environ, {"JAX_PLATFORMS": "axon"}):
+        assert profiler._on_axon_relay() is True
