@@ -53,7 +53,9 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --granules N   --zero-opt   --steps-per-call K (superstep fusion)
   --eval-iters N (held-out eval after training)   --clip-norm F
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
-  --search | --search-iters N (inline strategy autotuning)"""
+  --search | --search-iters N (inline strategy autotuning)
+  --resilient (detection + checkpoint rollback + SIGTERM emergency save)
+  --save-every N   --ckpt-dir PATH   --max-restarts N   --sync-ckpt"""
 
 
 def check_help(argv, doc: Optional[str]) -> None:
@@ -190,6 +192,169 @@ def _dry_run(ff: FFModel, ex, strategy: Optional[StrategyStore]) -> Dict[str, fl
             "samples_per_s": 0.0, "dry_run": True}
 
 
+def make_batch_fn(
+    ff: FFModel,
+    cfg: FFConfig,
+    arrays: Optional[Dict[str, np.ndarray]] = None,
+    int_high: Optional[Dict[str, int]] = None,
+):
+    """Deterministic per-step batches for the resilient loop:
+    ``batch_fn(step)`` must return the SAME batch every time a step is
+    (re)played, so rollback-replay after a fault reproduces the
+    unfaulted trajectory bit for bit.  With a dataset, each step draws
+    a with-replacement sample keyed by ``(seed, step)``; synthetic mode
+    draws fresh random inputs under the same key (through the shared
+    ``synthetic_host_batch`` rules, so the data distribution matches
+    the non-resilient loop's)."""
+    if arrays is not None:
+        n = len(next(iter(arrays.values())))
+
+        def batch_fn(step: int) -> Dict[str, np.ndarray]:
+            rng = np.random.default_rng((cfg.seed, step))
+            idx = rng.integers(0, n, size=cfg.batch_size)
+            return {k: v[idx] for k, v in arrays.items()}
+
+        return batch_fn
+
+    from flexflow_tpu.data.loader import synthetic_host_batch
+
+    def batch_fn(step: int) -> Dict[str, np.ndarray]:
+        return synthetic_host_batch(
+            ff, np.random.default_rng((cfg.seed, step)), int_high
+        )
+
+    return batch_fn
+
+
+def _holdout_split(cfg: FFConfig, arrays: Dict[str, np.ndarray]):
+    """--eval-iters with a dataset: reserve the trailing rows
+    (batch-aligned, at most 20% of the data) as a true holdout BEFORE
+    the training loader sees them.  Returns (train, eval) arrays."""
+    n = len(next(iter(arrays.values())))
+    want = min(cfg.eval_iters * cfg.batch_size,
+               max(cfg.batch_size, n // 5))
+    hold = (want // cfg.batch_size) * cfg.batch_size
+    if 0 < hold < n:
+        return ({k: v[: n - hold] for k, v in arrays.items()},
+                {k: v[n - hold:] for k, v in arrays.items()})
+    print("eval: dataset too small to hold out; evaluating in-sample")
+    return arrays, arrays
+
+
+def _run_eval(trainer: Trainer, params, state, cfg: FFConfig,
+              eval_arrays: Optional[Dict[str, np.ndarray]]):
+    """--eval-iters: read-only pass on the trained params (the
+    reference computes metrics only inside the training backward,
+    ``mse_loss.cu:61-112``).  One implementation shared by the plain
+    and resilient paths so their EVAL numbers stay comparable: rows
+    held out before training with a dataset, fresh synthetic batches
+    per iteration otherwise."""
+    if eval_arrays is not None:
+        eval_batches = iter(ArrayDataLoader(
+            eval_arrays, cfg.batch_size, shuffle=False,
+            seed=cfg.seed + 1, nthreads=cfg.loaders_per_node,
+        ))
+    else:
+        eval_batches = (
+            trainer.synthetic_batch(seed=cfg.seed + 1 + i)
+            for i in range(cfg.eval_iters)
+        )
+    ev = trainer.evaluate(params, state, eval_batches,
+                          iterations=cfg.eval_iters)
+    print(f"EVAL loss = {ev['loss']:.6f} "
+          f"accuracy = {100.0 * ev['accuracy']:.2f}%")
+    return ev
+
+
+def _run_resilient(
+    ff: FFModel,
+    cfg: FFConfig,
+    executor_factory,
+    first_ex,
+    arrays: Optional[Dict[str, np.ndarray]],
+    int_high: Optional[Dict[str, int]],
+    label: str,
+) -> Dict[str, float]:
+    """--resilient: the ResilientTrainer loop (runtime/resilience.py) —
+    failure detection, checkpoint rollback with deterministic replay,
+    and SIGTERM/SIGINT emergency saves; composes with --steps-per-call
+    (detection at the single per-superstep fence).  See RESILIENCE.md."""
+    import time
+
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer
+
+    if isinstance(first_ex, PipelineExecutor):
+        raise SystemExit(
+            "--resilient requires full-mesh strategies; layer-wise "
+            "(device-subset) placement has no rollback/replay support yet"
+        )
+    if cfg.accum_steps > 1:
+        raise SystemExit(
+            "--resilient does not compose with --accum-steps yet"
+        )
+    if cfg.zc_dataset:
+        raise SystemExit(
+            "--resilient replays batches via a deterministic host "
+            "batch_fn; --zc-dataset (device-resident staging) is not "
+            "wired into that path yet"
+        )
+    eval_arrays = None
+    if cfg.eval_iters > 0 and arrays is not None:
+        # The same true holdout as the non-resilient path: EVAL numbers
+        # stay comparable across the two modes.
+        arrays, eval_arrays = _holdout_split(cfg, arrays)
+    batch_fn = make_batch_fn(ff, cfg, arrays, int_high)
+    iters = cfg.iterations * max(cfg.epochs, 1)
+    ckdir = cfg.ckpt_dir or os.path.join(os.getcwd(), "ckpts")
+    with CheckpointManager(ckdir, async_save=cfg.async_checkpointing) as ck:
+        rt = ResilientTrainer(
+            executor_factory, ck,
+            policy=FailurePolicy(max_restarts=cfg.max_restarts),
+        )
+        start = time.perf_counter()
+        out = rt.fit(
+            iterations=iters,
+            batch_fn=batch_fn,
+            save_every=cfg.save_every,
+            seed=cfg.seed,
+            steps_per_call=cfg.steps_per_call,
+        )
+        elapsed = time.perf_counter() - start
+        completed = len(out["losses"])
+        throughput = completed * cfg.batch_size / max(elapsed, 1e-9)
+        print(f"time = {elapsed:.4f}s")
+        print(f"tp = {throughput:.2f} samples/s")
+        print(f"ELAPSED TIME = {elapsed:.4f}s")
+        print(f"THROUGHPUT = {throughput:.2f} {label}/s")
+        print(f"restarts = {out['restarts']}")
+        if completed == 0:
+            # A restarted job whose checkpoint already reached the
+            # target: nothing ran, nothing to evaluate meaningfully.
+            print(f"resumed at step {out['step']}: already complete")
+        if out["preempted"]:
+            # Clean exit BEFORE any eval pass: the kill-grace window is
+            # for the emergency save, not a metrics run on half-trained
+            # params.  The scheduler restarts us and the same
+            # --ckpt-dir resumes from the emergency snapshot.
+            print(f"PREEMPTED: emergency checkpoint at step {out['step']}")
+            raise SystemExit(0)
+        stats = {
+            "elapsed_s": elapsed,
+            "samples_per_s": throughput,
+            "iterations": out["step"],
+            "batch_size": cfg.batch_size,
+            "loss": out["loss"],
+            "restarts": out["restarts"],
+        }
+        if cfg.eval_iters > 0 and rt.executor is not None:
+            stats["eval"] = _run_eval(
+                Trainer(rt.executor), out["params"], out["state"], cfg,
+                eval_arrays,
+            )
+    return stats
+
+
 def run_training(
     ff: FFModel,
     cfg: FFConfig,
@@ -264,8 +429,6 @@ def run_training(
             )
     if cfg.dry_run:
         return _dry_run(ff, ex, strategy)
-    trainer = Trainer(ex)
-    batches = None
     if arrays is None and cfg.dataset_path:
         raise SystemExit(
             "this app has no -d loader; drop -d for synthetic input"
@@ -273,21 +436,26 @@ def run_training(
     if arrays is None and num_samples is not None:
         arrays = synthetic_arrays(ff, num_samples, seed=cfg.seed,
                                   int_high=int_high)
+    if cfg.resilient:
+        def executor_factory(_first=[ex]):
+            # First call reuses the executor built above (strategy
+            # validation already ran on it); recovery from a raised
+            # fault rebuilds fresh (new mesh/jit).
+            if _first:
+                return _first.pop()
+            return make_executor(
+                ff, strategy, config=cfg, optimizer=make_optimizer(cfg),
+                mesh_plan=mesh_plan, microbatches=cfg.microbatches,
+                schedule=cfg.pipeline_schedule,
+            )
+
+        return _run_resilient(ff, cfg, executor_factory, ex, arrays,
+                              int_high, label)
+    trainer = Trainer(ex)
+    batches = None
     eval_arrays = None
     if cfg.eval_iters > 0 and arrays is not None:
-        # True holdout: reserve the trailing rows (batch-aligned, at
-        # most 20% of the data) BEFORE the training loader sees them.
-        n = len(next(iter(arrays.values())))
-        want = min(cfg.eval_iters * cfg.batch_size,
-                   max(cfg.batch_size, n // 5))
-        hold = (want // cfg.batch_size) * cfg.batch_size
-        if 0 < hold < n:
-            eval_arrays = {k: v[n - hold:] for k, v in arrays.items()}
-            arrays = {k: v[: n - hold] for k, v in arrays.items()}
-        else:
-            eval_arrays = arrays
-            print("eval: dataset too small to hold out; "
-                  "evaluating in-sample")
+        arrays, eval_arrays = _holdout_split(cfg, arrays)
     if arrays is not None:
         if cfg.zc_dataset:
             # --zc-dataset: the reference DLRM's zero-copy staging —
@@ -306,32 +474,33 @@ def run_training(
         # staging); shard_batch is a no-op on already-placed batches.
         batches = PrefetchLoader(source, ex.shard_batch)
     iters = cfg.iterations * max(cfg.epochs, 1)
-    stats = trainer.fit(iterations=iters, batches=batches, warmup=1,
-                        log_every=cfg.print_freq,
-                        accum_steps=cfg.accum_steps,
-                        steps_per_call=cfg.steps_per_call)
+    import contextlib
+
+    ckpt_ctx = contextlib.nullcontext()
+    if cfg.ckpt_dir or cfg.save_every > 0:
+        # --ckpt-dir / --save-every without --resilient: plain periodic
+        # saves + resume through Trainer.fit (and its SIGTERM emergency
+        # save).  Same ./ckpts default as the resilient path.
+        from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+        ckpt_ctx = CheckpointManager(
+            cfg.ckpt_dir or os.path.join(os.getcwd(), "ckpts"),
+            async_save=cfg.async_checkpointing,
+        )
+    with ckpt_ctx as ck:
+        stats = trainer.fit(iterations=iters, batches=batches, warmup=1,
+                            log_every=cfg.print_freq,
+                            checkpoint=ck,  # None from the nullcontext
+                            save_every=cfg.save_every,
+                            accum_steps=cfg.accum_steps,
+                            steps_per_call=cfg.steps_per_call)
     print(f"ELAPSED TIME = {stats['elapsed_s']:.4f}s")
     print(f"THROUGHPUT = {stats['samples_per_s']:.2f} {label}/s")
+    if stats.get("preempted"):
+        print(f"PREEMPTED: emergency checkpoint at step "
+              f"{stats['checkpoint_step']}")
+        raise SystemExit(0)
     if cfg.eval_iters > 0:
-        # --eval-iters: read-only pass on the trained params (the
-        # reference computes metrics only inside the training
-        # backward, mse_loss.cu:61-112).  With a dataset the rows
-        # held out before training (above) are evaluated; synthetic
-        # mode draws fresh batches per iteration.
         params, _, state = trainer.final
-        if eval_arrays is not None:
-            eval_batches = iter(ArrayDataLoader(
-                eval_arrays, cfg.batch_size, shuffle=False,
-                seed=cfg.seed + 1, nthreads=cfg.loaders_per_node,
-            ))
-        else:
-            eval_batches = (
-                trainer.synthetic_batch(seed=cfg.seed + 1 + i)
-                for i in range(cfg.eval_iters)
-            )
-        ev = trainer.evaluate(params, state, eval_batches,
-                              iterations=cfg.eval_iters)
-        print(f"EVAL loss = {ev['loss']:.6f} "
-              f"accuracy = {100.0 * ev['accuracy']:.2f}%")
-        stats["eval"] = ev
+        stats["eval"] = _run_eval(trainer, params, state, cfg, eval_arrays)
     return stats

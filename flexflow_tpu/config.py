@@ -132,6 +132,29 @@ class FFConfig:
     # only inside the training backward, ``mse_loss.cu:61-112``; a
     # held-out eval pass is this rebuild's addition).
     eval_iters: int = 0
+    # --resilient: drive training through ResilientTrainer — failure
+    # detection (raised + non-finite loss), checkpoint rollback with
+    # deterministic batch replay, and SIGTERM/SIGINT emergency saves
+    # (runtime/resilience.py; RESILIENCE.md).  Composes with
+    # --steps-per-call: detection happens at the single per-superstep
+    # fence.  Full-mesh strategies only.
+    resilient: bool = False
+    # --save-every N: checkpoint every N steps (0 = end-of-run only).
+    # On the superstep path saves land at the first superstep boundary
+    # past each multiple; also the finiteness-fence period of the
+    # resilient per-step path (silent-failure detection latency).
+    save_every: int = 0
+    # --ckpt-dir PATH: checkpoint directory for --resilient /
+    # --save-every (default ./ckpts).  A restarted run with the same
+    # dir resumes from the latest (or emergency) snapshot.
+    ckpt_dir: Optional[str] = None
+    # --max-restarts N: crash-loop budget — consecutive recoveries
+    # without durable progress before giving up (FailurePolicy).
+    max_restarts: int = 3
+    # --sync-ckpt: disable async checkpointing (saves then block the
+    # train loop until durable; default is non-blocking background
+    # writes with a flush fence at restore/exit).
+    async_checkpointing: bool = True
     # --zero-opt: ZeRO-1-style optimizer-state sharding — each
     # parameter's optimizer moments (Adam m/v, SGD momentum) shard
     # their leading dim across the mesh axes the op's strategy assigns
@@ -249,6 +272,16 @@ class FFConfig:
                 cfg.clip_norm = float(_next())
             elif a == "--lazy-sparse-opt":
                 cfg.lazy_sparse_optimizer = True
+            elif a == "--resilient":
+                cfg.resilient = True
+            elif a == "--save-every":
+                cfg.save_every = int(_next())
+            elif a == "--ckpt-dir":
+                cfg.ckpt_dir = _next()
+            elif a == "--max-restarts":
+                cfg.max_restarts = int(_next())
+            elif a == "--sync-ckpt":
+                cfg.async_checkpointing = False
             i += 1
         return cfg
 

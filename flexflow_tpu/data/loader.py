@@ -225,6 +225,33 @@ class DeviceResidentLoader(ArrayDataLoader):
         return self._gather(self.device_arrays, idx)
 
 
+def synthetic_host_batch(
+    model,
+    rng: np.random.Generator,
+    int_high: Optional[Dict[str, int]] = None,
+) -> Dict[str, np.ndarray]:
+    """One host batch of random inputs matching ``model``'s input
+    tensors — the single source of the int-range / dtype-rounding
+    rules shared by ``Trainer.synthetic_batch`` and the resilient
+    loop's deterministic ``batch_fn`` (apps/common.make_batch_fn), so
+    the two paths draw identically-distributed data."""
+    int_high = int_high or {}
+    out = {}
+    for t in model.input_tensors:
+        if np.issubdtype(np.dtype(t.dtype), np.integer):
+            # Index-like input: labels or embedding ids.  Bounded by
+            # int_high[name] when given, else the tensor's own
+            # max_value (small conservative default).
+            hi = int_high.get(t.name, getattr(t, "max_value", 2))
+            out[t.name] = rng.integers(0, hi, size=t.shape).astype(np.int32)
+        else:
+            arr = rng.standard_normal(size=t.shape).astype(np.float32)
+            # ml_dtypes handles bf16: round through np.asarray, not a
+            # direct float64 astype.
+            out[t.name] = np.asarray(arr, dtype=np.dtype(t.dtype))
+    return out
+
+
 def synthetic_arrays(
     model,
     num_samples: int,

@@ -1,14 +1,23 @@
-from flexflow_tpu.runtime.checkpoint import CheckpointManager
+from flexflow_tpu.runtime.checkpoint import CheckpointManager, TornCheckpointError
 from flexflow_tpu.runtime.executor import Executor
 from flexflow_tpu.runtime.profiler import profile_ops, report, trace
-from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer, StepFailure
+from flexflow_tpu.runtime.resilience import (
+    FailurePolicy,
+    FaultInjector,
+    PreemptionHandler,
+    ResilientTrainer,
+    StepFailure,
+)
 from flexflow_tpu.runtime.trainer import Trainer
 
 __all__ = [
     "CheckpointManager",
+    "TornCheckpointError",
     "Executor",
     "Trainer",
     "FailurePolicy",
+    "FaultInjector",
+    "PreemptionHandler",
     "ResilientTrainer",
     "StepFailure",
     "profile_ops",

@@ -1,0 +1,256 @@
+"""Chaos scenario matrix for the resilience subsystem.
+
+One place defines the fault scenarios; two consumers drive them:
+``tests/test_chaos.py`` (pytest, per-scenario asserts) and
+``tools/chaos_smoke.py`` (a <2 min standalone runner in a fresh CPU
+subprocess).  Every scenario injects a fault through
+:class:`~flexflow_tpu.runtime.resilience.FaultInjector` into a
+``steps_per_call=8`` superstep run and requires the recovered loss
+trajectory to be **bit-identical** to the unfaulted run — the
+determinism contract that makes rollback-replay a correctness-neutral
+event (RESILIENCE.md).
+
+The model is deliberately tiny (2-layer MLP on the 8-device virtual
+mesh with a hybrid n2c4 strategy for fc1) so a full matrix run is
+dominated by jit compiles, not math.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.resilience import (
+    FailurePolicy,
+    FaultInjector,
+    ResilientTrainer,
+)
+
+#: Matrix defaults: the acceptance shape — a fault inside a k=8
+#: superstep, checkpoints at superstep boundaries.
+K, ITERS, SAVE_EVERY = 8, 16, 8
+
+
+def tiny_factory() -> Callable[[], Executor]:
+    """Executor factory for the chaos model: 16→32(relu)→4 softmax,
+    fc1 hybrid-parallel (n2 x c4) over the 8-device mesh."""
+
+    def make() -> Executor:
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 16), name="x")
+        lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+        t = ff.dense(x, 32, activation="relu", name="fc1")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        store = StrategyStore(8, {"fc1": ParallelConfig(n=2, c=4)})
+        return Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.1))
+
+    return make
+
+
+def chaos_batch_fn(step: int) -> Dict[str, np.ndarray]:
+    """Deterministic per-step batches: replayed steps see identical
+    data, which is what pins the recovered trajectory bit-identical."""
+    rng = np.random.default_rng(step)
+    return {
+        "x": rng.standard_normal((8, 16)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+    }
+
+
+def fit_once(
+    ck_dir: str,
+    injector: Optional[FaultInjector] = None,
+    k: int = K,
+    iters: int = ITERS,
+    save_every: int = SAVE_EVERY,
+) -> Dict:
+    """One ResilientTrainer run against ``ck_dir`` (async saves on)."""
+    with CheckpointManager(ck_dir, async_save=True) as ck:
+        rt = ResilientTrainer(
+            tiny_factory(), ck,
+            policy=FailurePolicy(max_restarts=3),
+            fault_injector=injector,
+        )
+        return rt.fit(
+            iterations=iters,
+            batch_fn=chaos_batch_fn,
+            save_every=save_every,
+            steps_per_call=k,
+        )
+
+
+def trajectory(losses: Dict[int, float], iters: int) -> np.ndarray:
+    return np.array([losses[i] for i in range(iters)])
+
+
+_BASELINES: Dict[Tuple[int, int, int], np.ndarray] = {}
+
+
+def baseline(root: str, k: int = K, iters: int = ITERS,
+             save_every: int = SAVE_EVERY) -> np.ndarray:
+    """The unfaulted ``steps_per_call=k`` trajectory (cached per shape
+    — it is deterministic, so one compute serves every scenario)."""
+    key = (k, iters, save_every)
+    if key not in _BASELINES:
+        out = fit_once(os.path.join(root, f"baseline_k{k}_{iters}"),
+                       k=k, iters=iters, save_every=save_every)
+        assert out["restarts"] == 0 and not out["preempted"]
+        _BASELINES[key] = trajectory(out["losses"], iters)
+    return _BASELINES[key]
+
+
+def _compare(name: str, base: np.ndarray, got: np.ndarray,
+             out: Dict) -> Tuple[bool, str]:
+    if got.shape == base.shape and np.array_equal(got, base):
+        return True, (f"{name}: trajectory bit-identical to unfaulted run "
+                      f"(restarts={out['restarts']})")
+    bad = int(np.argmax(got != base)) if got.shape == base.shape else -1
+    return False, (f"{name}: trajectory DIVERGED (first mismatch at step "
+                   f"{bad}, restarts={out['restarts']})")
+
+
+# -- scenarios -------------------------------------------------------------
+
+
+def scenario_raised_fault(root: str) -> Tuple[bool, str]:
+    """A raised (device-class) fault inside the second k=8 superstep:
+    recovery rebuilds the executor, restores step 8, replays."""
+    inj = FaultInjector(raise_at=(11,))
+    out = fit_once(os.path.join(root, "raised"), inj)
+    if out["restarts"] != 1:
+        return False, f"raised: expected 1 restart, got {out['restarts']}"
+    return _compare("raised", baseline(root),
+                    trajectory(out["losses"], ITERS), out)
+
+
+def scenario_nan_batch(root: str) -> Tuple[bool, str]:
+    """A silent fault: NaN inputs at step 11 poison the loss, detected
+    at the superstep fence, rolled back and replayed clean."""
+    inj = FaultInjector(nan_batch_at=(11,))
+    out = fit_once(os.path.join(root, "nan_batch"), inj)
+    if out["restarts"] != 1:
+        return False, f"nan_batch: expected 1 restart, got {out['restarts']}"
+    return _compare("nan_batch", baseline(root),
+                    trajectory(out["losses"], ITERS), out)
+
+
+def scenario_nan_loss(root: str) -> Tuple[bool, str]:
+    """Silent divergence without touching device numerics: the host
+    loss of step 11 reads as NaN once."""
+    inj = FaultInjector(nan_loss_at=(11,))
+    out = fit_once(os.path.join(root, "nan_loss"), inj)
+    if out["restarts"] != 1:
+        return False, f"nan_loss: expected 1 restart, got {out['restarts']}"
+    return _compare("nan_loss", baseline(root),
+                    trajectory(out["losses"], ITERS), out)
+
+
+def scenario_sigterm(root: str) -> Tuple[bool, str]:
+    """Preemption mid-run: SIGTERM before step 5 → emergency save at
+    the superstep boundary + clean return; a restarted trainer resumes
+    from the emergency snapshot and finishes.  The two processes'
+    trajectories concatenate bit-identically to the unfaulted run."""
+    d = os.path.join(root, "sigterm")
+    first = fit_once(d, FaultInjector(preempt_at=(5,)))
+    if not first["preempted"]:
+        return False, "sigterm: run was not preempted"
+    second = fit_once(d)  # the "restarted job": same ckpt dir, no faults
+    if second["preempted"] or second["step"] != ITERS:
+        return False, f"sigterm: restart did not finish ({second['step']})"
+    merged = {**first["losses"], **second["losses"]}
+    ok, detail = _compare("sigterm", baseline(root),
+                          trajectory(merged, ITERS), second)
+    if ok:
+        detail += f"; emergency save at step {first['step']}"
+    return ok, detail
+
+
+def scenario_corrupt_checkpoint(root: str) -> Tuple[bool, str]:
+    """Checkpoint corruption + a later fault (k=4 so two snapshots
+    exist): restore skips the torn latest snapshot, falls back to the
+    previous step, and replays the longer tail — still bit-identical."""
+    inj = FaultInjector(corrupt_checkpoint_at=(8,), raise_at=(10,))
+    out = fit_once(os.path.join(root, "corrupt"), inj,
+                   k=4, iters=12, save_every=4)
+    if out["restarts"] != 1:
+        return False, f"corrupt: expected 1 restart, got {out['restarts']}"
+    fired = {m for m, _ in inj.fired}
+    if fired != {"corrupt", "raise"}:
+        return False, f"corrupt: injector fired {sorted(fired)}"
+    return _compare("corrupt", baseline(root, k=4, iters=12, save_every=4),
+                    trajectory(out["losses"], 12), out)
+
+
+def scenario_force_save_kill(root: str) -> Tuple[bool, str]:
+    """Kill a force-replace between each of its phases: a fresh manager
+    must ALWAYS find a restorable checkpoint — the new value after the
+    staged snapshot committed, the old value before."""
+    import shutil
+
+    import jax.numpy as jnp
+
+    d = os.path.join(root, "force_kill")
+    old = {"w": jnp.full((4,), 1.0)}
+    new = {"w": jnp.full((4,), 2.0)}
+
+    def restored_w() -> float:
+        with CheckpointManager(d) as ck:
+            _, p, _, _ = ck.restore(templates=(old, None, {}))
+        return float(np.asarray(p["w"])[0])
+
+    with CheckpointManager(d) as ck:
+        ck.save(1, old, None, {})
+    # Kill during phase 1 (mid-write): orbax's own staging tmp is left
+    # behind, the old snapshot untouched.
+    os.makedirs(os.path.join(
+        d, "1.force-tmp.orbax-checkpoint-tmp-999", "params"))
+    if restored_w() != 1.0:
+        return False, "force_kill: mid-write crash lost the old snapshot"
+    # Kill after phase 1 (staged snapshot committed, old not retired).
+    with CheckpointManager(d) as ck:
+        ck._write_force_tmp(1, ck._items(new, None, {}))
+    if restored_w() != 2.0:
+        return False, "force_kill: committed staging was not promoted"
+    # Kill mid-phase-2 (old half-deleted, staged snapshot present).
+    with CheckpointManager(d) as ck:
+        ck._write_force_tmp(1, ck._items(new, None, {}))
+        shutil.rmtree(os.path.join(d, "1", "params"))  # torn old dir
+    if restored_w() != 2.0:
+        return False, "force_kill: torn old + staged new not recovered"
+    return True, ("force_kill: every kill point left a restorable "
+                  "checkpoint (write-new-then-retire)")
+
+
+SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
+    "raised_fault": scenario_raised_fault,
+    "nan_batch": scenario_nan_batch,
+    "nan_loss": scenario_nan_loss,
+    "sigterm": scenario_sigterm,
+    "corrupt_checkpoint": scenario_corrupt_checkpoint,
+    "force_save_kill": scenario_force_save_kill,
+}
+
+
+def run_matrix(root: str,
+               names: Optional[List[str]] = None) -> List[Tuple[bool, str, str]]:
+    """Run the chaos matrix under ``root``; returns
+    ``[(ok, name, detail), ...]`` in scenario order."""
+    results = []
+    for name, fn in SCENARIOS.items():
+        if names and name not in names:
+            continue
+        try:
+            ok, detail = fn(root)
+        except Exception as e:  # a scenario crashing IS a failure
+            ok, detail = False, f"{name}: crashed with {type(e).__name__}: {e}"
+        results.append((ok, name, detail))
+    return results

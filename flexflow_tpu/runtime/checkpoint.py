@@ -9,17 +9,49 @@ shardings, so a run checkpointed under one parallelization strategy
 can resume under another — the checkpoint is strategy-portable the
 way Legion regions never were), with retention and latest-step
 discovery for crash-resume.
+
+Durability model (see RESILIENCE.md):
+
+- **Async saves** (``async_save=True``): ``save`` copies the arrays out
+  synchronously and writes to disk in the background, so checkpointing
+  no longer stalls the train loop; ``wait_until_finished`` is the
+  flush fence, called automatically at ``restore``/``close``.
+- **Crash-safe force-replace**: replacing an existing step writes the
+  new snapshot to a ``<step>.force-tmp`` sibling first (orbax commits
+  it atomically via rename), only then retires the old directory and
+  promotes the new one — there is never a moment without a committed
+  snapshot on disk, and an interrupted swap is completed by
+  ``_recover_pending_force`` on the next manager init.
+- **Torn-snapshot tolerance**: latest-step restore skips a
+  half-deleted / unreadable step directory (e.g. a crash mid-delete or
+  bit rot) and falls back to the previous step instead of dying.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import re
+import shutil
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 
 _log = logging.getLogger("ff.checkpoint")
+
+#: Sibling-directory suffix for the crash-safe force-replace staging
+#: snapshot: ``<root>/<step>.force-tmp``.  Orbax's own step discovery
+#: ignores non-numeric names, so a staged snapshot never shadows a
+#: committed one.
+FORCE_TMP_SUFFIX = ".force-tmp"
+
+_FORCE_TMP_RE = re.compile(r"^(\d+)\.force-tmp$")
+
+
+class TornCheckpointError(OSError):
+    """A step directory exists but is not a complete snapshot (crash
+    mid-delete, partial corruption).  Latest-step restore treats it as
+    absent and falls back to the previous step."""
 
 
 def _ocp():
@@ -40,6 +72,12 @@ class CheckpointManager:
         ...
         step, params, opt_state, state = ckpt.restore(
             templates=(params0, opt0, state0))  # from Executor.init()
+
+    ``async_save=True`` makes ``save`` non-blocking (arrays are copied
+    out before it returns; disk writes complete in the background).
+    ``restore`` and ``close`` fence on pending writes, so the
+    resilience loop can restore at any time and process exit is always
+    durable.
     """
 
     def __init__(
@@ -47,6 +85,7 @@ class CheckpointManager:
         directory: str,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        async_save: bool = False,
     ):
         ocp = _ocp()
         # Keep remote URLs (gs://, s3://...) untouched; orbax requires
@@ -54,44 +93,162 @@ class CheckpointManager:
         self.directory = (
             directory if "://" in directory else os.path.abspath(directory)
         )
+        self.async_save = async_save
+        if "://" not in self.directory:
+            # Finish any force-replace a previous process died inside —
+            # BEFORE orbax scans the directory for steps.
+            self._recover_pending_force()
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=False,
+                enable_async_checkpointing=async_save,
             ),
         )
 
+    # -- crash recovery ----------------------------------------------------
+
+    def _recover_pending_force(self) -> None:
+        """Complete force-replace swaps interrupted by a crash.
+
+        A committed ``<step>.force-tmp`` directory IS the newest
+        snapshot for that step (orbax's Checkpointer renames it into
+        existence only after a fully successful write): retire whatever
+        remains of the old step directory — possibly half-deleted — and
+        promote the staged one.  Uncommitted staging garbage (orbax's
+        internal ``*.orbax-checkpoint-tmp-*`` write dirs for a crash
+        mid-write) is simply removed; the old snapshot was never
+        touched in that window.
+        """
+        if not os.path.isdir(self.directory):
+            return
+        for name in sorted(os.listdir(self.directory)):
+            path = os.path.join(self.directory, name)
+            if FORCE_TMP_SUFFIX + ".orbax-checkpoint-tmp" in name:
+                _log.warning("removing aborted force-save staging %s", name)
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            m = _FORCE_TMP_RE.match(name)
+            if not m:
+                continue
+            final = os.path.join(self.directory, m.group(1))
+            _log.warning(
+                "completing interrupted force-replace of step %s", m.group(1)
+            )
+            if os.path.lexists(final):
+                shutil.rmtree(final)
+            os.rename(path, final)
+
     # -- write -------------------------------------------------------------
 
-    def save(self, step: int, params, opt_state, state, force: bool = False) -> bool:
-        """Persist one training snapshot.  Empty subtrees (momentum-less
-        opt_state, stateless models) are simply omitted — orbax rejects
-        empty items — and reconstituted as None/{} on restore."""
+    def _items(self, params, opt_state, state) -> Dict[str, Any]:
+        """Empty subtrees (momentum-less opt_state, stateless models)
+        are simply omitted — orbax rejects empty items — and
+        reconstituted as None/{} on restore."""
         ocp = _ocp()
-        if step in self._mgr.all_steps():
-            if force:
-                # A run resumed from an *older* step may legitimately
-                # re-save this step with different state; replace the
-                # stale snapshot (orbax raises StepAlreadyExistsError
-                # even under force, so delete first).  NOT atomic: a
-                # crash between delete and save loses the old snapshot
-                # — only force when the caller truly wants replacement.
-                self._mgr.delete(step)
-            else:
-                # Same step saved already (e.g. a final forced save
-                # landing on a periodic one); a no-op, but say so.
-                _log.warning("skipping save: step %d already exists", step)
-                return False
         items: Dict[str, Any] = {"params": ocp.args.StandardSave(params)}
         if opt_state is not None and jax.tree.leaves(opt_state):
             items["opt_state"] = ocp.args.StandardSave(opt_state)
         if state and jax.tree.leaves(state):
             items["state"] = ocp.args.StandardSave(state)
+        return items
+
+    def save(self, step: int, params, opt_state, state, force: bool = False) -> bool:
+        """Persist one training snapshot.  ``force`` bypasses orbax's
+        save-interval gating and — when the step already exists —
+        replaces the stale snapshot crash-safely (a run resumed from an
+        *older* step may legitimately re-save a step with different
+        state)."""
+        ocp = _ocp()
+        items = self._items(params, opt_state, state)
+        if step in self._mgr.all_steps():
+            try:
+                torn = "params" not in set(self._mgr.item_metadata(step).keys())
+            except (KeyError, FileNotFoundError, OSError):
+                torn = True  # metadata unreadable = torn directory
+            if force or torn:
+                if torn and not force:
+                    _log.warning(
+                        "step %d exists but is torn; replacing it", step
+                    )
+                return self._force_replace(step, items)
+            # Same step saved already (e.g. a final forced save
+            # landing on a periodic one); a no-op, but say so.
+            _log.warning("skipping save: step %d already exists", step)
+            return False
         saved = self._mgr.save(step, args=ocp.args.Composite(**items), force=force)
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
         return saved
+
+    def _force_replace(self, step: int, items: Dict[str, Any]) -> bool:
+        """Replace an existing step with write-new-then-retire ordering.
+
+        Phases (each individually crash-safe; ``_recover_pending_force``
+        completes an interrupted swap on the next init):
+
+        1. write the new snapshot to ``<step>.force-tmp`` — orbax
+           commits it atomically (internal tmp dir + rename), so the
+           staged directory exists only when complete;
+        2. retire the old step directory;
+        3. promote the staged snapshot into place.
+
+        At every instant at least one committed snapshot of the step is
+        on disk — the documented delete-then-rewrite crash window is
+        gone.  Remote object stores have no atomic rename; they keep
+        the old delete-then-rewrite path (object stores don't tear
+        directories the way a killed local rmtree does).
+        """
+        ocp = _ocp()
+        if "://" in self.directory:
+            self._mgr.delete(step)
+            saved = self._mgr.save(
+                step, args=ocp.args.Composite(**items), force=True
+            )
+            self._mgr.wait_until_finished()
+            return saved
+        self._mgr.wait_until_finished()  # flush async writers first
+        tmp = self._write_force_tmp(step, items)
+        self._promote_force_tmp(step, tmp)
+        return True
+
+    def _write_force_tmp(self, step: int, items: Dict[str, Any]) -> str:
+        """Phase 1: stage the replacement snapshot next to the live one
+        (committed atomically by orbax's Checkpointer)."""
+        ocp = _ocp()
+        tmp = os.path.join(self.directory, f"{step}{FORCE_TMP_SUFFIX}")
+        if os.path.lexists(tmp):
+            shutil.rmtree(tmp)  # stale staging from an abandoned swap
+        ckptr = ocp.Checkpointer(ocp.CompositeCheckpointHandler(*items.keys()))
+        try:
+            ckptr.save(tmp, args=ocp.args.Composite(**items))
+        finally:
+            ckptr.close()
+        return tmp
+
+    def _promote_force_tmp(self, step: int, tmp: str) -> None:
+        """Phases 2+3: retire the old snapshot, promote the staged one."""
+        final = os.path.join(self.directory, str(step))
+        if os.path.lexists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        # The live orbax manager caches step/item metadata; resync it
+        # with the directory we just rewrote underneath it.
+        self.reload()
+
+    def wait_until_finished(self) -> None:
+        """Flush fence: block until every pending (async) save is
+        durable on disk.  Called automatically at restore/close; call
+        it directly before exiting a process that must not lose its
+        last snapshot (e.g. the preemption emergency save)."""
+        self._mgr.wait_until_finished()
+
+    def reload(self) -> None:
+        """Resync cached step/item metadata with the directory — after
+        anything mutates it underneath the live manager (chaos
+        corruption, an external process's swap)."""
+        self._mgr.reload()
 
     # -- read --------------------------------------------------------------
 
@@ -112,19 +269,59 @@ class CheckpointManager:
         arrays adopt the templates' shapes/dtypes/shardings, which is
         what makes restore work across a *different* mesh or strategy
         than the one that saved (orbax reshards on load).
+
+        With ``step=None`` (latest), a torn or unreadable step
+        directory is skipped with a warning and the previous step is
+        tried instead — a crash mid-delete must never strand a job that
+        still has an older intact snapshot.  An explicit ``step``
+        restores exactly that step or raises.
         """
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoint found under {self.directory}"
+        self.wait_until_finished()  # async saves must be durable & visible
+        if step is not None:
+            return self._restore_step(step, templates)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        last_err: Optional[Exception] = None
+        for s in steps:
+            try:
+                return self._restore_step(s, templates)
+            # Deliberately narrow: only torn/missing-file errors mean
+            # "try an older step".  A ValueError here is a template
+            # mismatch (changed model, wrong shapes) — a programmer
+            # error that must surface, not silently fall back.
+            except (TornCheckpointError, FileNotFoundError, OSError) as e:
+                _log.warning(
+                    "checkpoint step %d unreadable (%s: %s); "
+                    "falling back to the previous step",
+                    s, type(e).__name__, e,
                 )
+                last_err = e
+        # NOT FileNotFoundError: snapshots exist but none is readable —
+        # callers that treat "no checkpoint" as a fresh start must not
+        # silently restart from step 0 and overwrite whatever remains.
+        raise TornCheckpointError(
+            f"no restorable checkpoint under {self.directory} "
+            f"({len(steps)} step dirs present, all unreadable)"
+        ) from last_err
+
+    def _restore_step(
+        self, step: int, templates: Tuple[Any, Any, Any]
+    ) -> Tuple[int, Any, Any, Any]:
         ocp = _ocp()
         t_params, t_opt, t_state = templates
         # Which items this snapshot contains — through the same orbax
         # abstraction that wrote them (robust to layout/naming options,
         # unlike listing the step directory ourselves).
         present = set(self._mgr.item_metadata(step).keys())
+        if "params" not in present:
+            # The signature of a half-deleted directory: the step is
+            # discoverable but its payload is gone.
+            raise TornCheckpointError(
+                f"step {step}: no params item (torn/half-deleted snapshot)"
+            )
         items: Dict[str, Any] = {"params": ocp.args.StandardRestore(t_params)}
         if "opt_state" in present:
             items["opt_state"] = ocp.args.StandardRestore(t_opt)
@@ -136,6 +333,7 @@ class CheckpointManager:
         return step, restored["params"], opt_state, state
 
     def close(self) -> None:
+        self.wait_until_finished()
         self._mgr.close()
 
     def __enter__(self):

@@ -692,6 +692,14 @@ class Executor:
         self._superstep_cache[(k, accum_steps)] = fn
         return fn
 
+    @staticmethod
+    def metrics_row(ms: Dict[str, Any], j: int) -> Dict[str, Any]:
+        """Unstack step ``j``'s metrics from a superstep's stacked
+        ``(k, ...)`` metrics (host or device) — the per-step view both
+        the trainer's loss curve and the resilience layer's finiteness
+        scan consume at the single superstep fence."""
+        return {key: v[j] for key, v in ms.items()}
+
     def stack_steps(self, batches: Sequence[Dict[str, Any]], accum_steps: int = 1):
         """Stack k per-step host batches into the device-resident
         ``(k, ...)`` queue :meth:`build_superstep` scans over, placed

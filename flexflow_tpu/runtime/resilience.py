@@ -3,19 +3,32 @@
 The reference has NO failure handling: ``FatalError`` aborts the whole
 process (``cuda_helper.h:5-11``), there is no retry and no
 checkpoint-restart (SURVEY.md §5).  This subsystem is built from
-scratch for the TPU rebuild:
+scratch for the TPU rebuild (failure model + recovery decision matrix:
+RESILIENCE.md):
 
-- **Failure detection** — two classes per step: *raised* failures
-  (device/runtime errors escaping the jitted step) and *silent*
-  failures (non-finite loss: divergence, bad batch, flipped bits).
+- **Failure detection** — three classes: *raised* failures
+  (device/runtime errors escaping the jitted step), *silent* failures
+  (non-finite loss: divergence, bad batch, flipped bits), and
+  *preemption* (SIGTERM/SIGINT from the scheduler).
 - **Recovery** — restore the latest checkpoint through
   :class:`~flexflow_tpu.runtime.checkpoint.CheckpointManager` (whose
-  restores are sharding-portable), optionally rebuild the executor via
-  a user factory (fresh mesh/compile after a backend fault), and
-  resume; a restart budget bounds crash loops.
-- **Fault injection** — a per-step hook so tests (and chaos runs) can
-  raise at chosen steps, mirroring how the reference's
-  DISABLE_COMPUTATION builds exercise machinery without compute.
+  restores are sharding-portable and tolerate torn snapshots),
+  optionally rebuild the executor via a user factory (fresh mesh/
+  compile after a backend fault), and resume; a restart budget bounds
+  crash loops.  Batches come from ``batch_fn(step)``, so replayed
+  steps are deterministic and the recovered loss trajectory is
+  bit-identical to an unfaulted run.
+- **Superstep composition** — ``fit(steps_per_call=k)`` drives
+  :meth:`Executor.build_superstep`: K steps per compiled dispatch, ONE
+  host fence per superstep, and the stacked per-step metrics scanned
+  at that fence for the first non-finite step (max loss on rollback =
+  the steps since the last save, never more than one fence's worth of
+  undetected divergence).
+- **Fault injection** — :class:`FaultInjector`, a first-class chaos
+  harness: scheduled raised faults, NaN-in-batch, NaN-in-loss,
+  self-preemption, and checkpoint corruption, mirroring how the
+  reference's DISABLE_COMPUTATION builds exercise machinery without
+  compute (bare ``callable(step)`` hooks are still accepted).
 """
 
 from __future__ import annotations
@@ -23,13 +36,18 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import os
+import shutil
+import signal
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Iterable, Optional, Union
 
 import jax
+import numpy as np
 
 from flexflow_tpu.runtime.checkpoint import CheckpointManager
 from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.trainer import MAX_STEPS_PER_CALL
 
 logger = logging.getLogger("ff.resilience")
 
@@ -42,15 +60,197 @@ class FailurePolicy:
     rollback_on_nonfinite: bool = True
     backoff_s: float = 0.0
     # Exception types treated as recoverable; everything else re-raises.
-    recoverable: tuple = (RuntimeError, ValueError, OSError)
+    # Deliberately narrow: ValueError/TypeError/KeyError/AssertionError
+    # are programmer errors (bad shapes, wrong keys, broken configs) —
+    # replaying them from a checkpoint reproduces the same crash until
+    # the restart budget is exhausted, which buries the actual
+    # traceback under max_restarts replays.  Those must surface
+    # immediately (pinned by tests/test_resilience.py).
+    recoverable: tuple = (RuntimeError, OSError)
 
 
 class StepFailure(RuntimeError):
     """A detected silent failure (e.g. non-finite loss)."""
 
 
+class PreemptionHandler:
+    """SIGTERM/SIGINT → a flag the train loop checks at step/superstep
+    boundaries (the analogue of a cloud scheduler's grace window): the
+    loop finishes the in-flight dispatch, validates it, writes an
+    emergency checkpoint, and exits cleanly so the restarted job
+    resumes exactly where it stopped.
+
+    A second SIGINT restores default handling (an impatient ^C^C still
+    kills).  Installing handlers is only possible on the main thread;
+    elsewhere the handler degrades to never-triggered.
+    """
+
+    def __init__(self, install: bool = True,
+                 signals: Iterable[int] = (signal.SIGTERM, signal.SIGINT)):
+        self._install = install
+        self._signals = tuple(signals)
+        self._previous: Dict[int, Any] = {}
+        self.triggered = False
+        self.signum: Optional[int] = None
+
+    def _on_signal(self, signum, frame):
+        if self.triggered and signum == signal.SIGINT:
+            self._restore()
+            raise KeyboardInterrupt
+        self.triggered = True
+        self.signum = signum
+        logger.warning(
+            "received signal %d: emergency checkpoint at the next "
+            "step/superstep boundary, then clean exit", signum,
+        )
+
+    def __enter__(self) -> "PreemptionHandler":
+        if self._install:
+            try:
+                for s in self._signals:
+                    self._previous[s] = signal.signal(s, self._on_signal)
+            except ValueError:  # not the main thread
+                logger.info("signal handlers unavailable off the main "
+                            "thread; preemption handling disabled")
+                self._previous = {}
+        return self
+
+    def _restore(self) -> None:
+        for s, h in self._previous.items():
+            signal.signal(s, h)
+        self._previous = {}
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+
+class FaultInjector:
+    """First-class scheduled chaos for tests and ``tools/chaos_smoke.py``.
+
+    Every mode is one-shot per scheduled step — the fault fires on the
+    first visit and disarms — so the deterministic replay after a
+    rollback sees a clean step and the recovered trajectory can be
+    compared bit-for-bit against an unfaulted run.
+
+    Modes (all keyed by global step index):
+
+    - ``raise_at``: ``{step: exception}`` (or an iterable of steps,
+      raising ``RuntimeError``) raised host-side before the step runs —
+      the raised-failure class (device faults, preempted workers).
+    - ``nan_batch_at``: every float input of that step's batch becomes
+      NaN — a silent failure detected at the loss fence.
+    - ``nan_loss_at``: the host-read loss of that step is replaced with
+      NaN — silent divergence without touching device numerics.
+    - ``preempt_at``: SIGTERM to the own process before the step —
+      drives the emergency-save path end to end.
+    - ``corrupt_checkpoint_at``: after the first save at/after that
+      step, the newest snapshot's payload is destroyed — the
+      torn-checkpoint fallback class.
+    """
+
+    def __init__(
+        self,
+        raise_at: Union[Dict[int, BaseException], Iterable[int], None] = None,
+        nan_batch_at: Iterable[int] = (),
+        nan_loss_at: Iterable[int] = (),
+        preempt_at: Iterable[int] = (),
+        corrupt_checkpoint_at: Iterable[int] = (),
+    ):
+        if raise_at is None:
+            raise_at = {}
+        elif not isinstance(raise_at, dict):
+            raise_at = {
+                s: RuntimeError(f"injected fault at step {s}") for s in raise_at
+            }
+        self.raise_at = dict(raise_at)
+        self.nan_batch_at = set(nan_batch_at)
+        self.nan_loss_at = set(nan_loss_at)
+        self.preempt_at = set(preempt_at)
+        self.corrupt_checkpoint_at = set(corrupt_checkpoint_at)
+        #: Log of (mode, step) pairs actually fired, for assertions.
+        self.fired = []
+
+    # -- hooks the resilient loop drives -----------------------------------
+
+    def before_step(self, step: int) -> None:
+        """Host-side, before the step's batch is assembled."""
+        if step in self.preempt_at:
+            self.preempt_at.discard(step)
+            self.fired.append(("preempt", step))
+            os.kill(os.getpid(), signal.SIGTERM)
+        if step in self.raise_at:
+            exc = self.raise_at.pop(step)
+            self.fired.append(("raise", step))
+            raise exc
+
+    def poison_batch(self, step: int, batch: Dict[str, Any]) -> Dict[str, Any]:
+        if step not in self.nan_batch_at:
+            return batch
+        self.nan_batch_at.discard(step)
+        self.fired.append(("nan_batch", step))
+        return {
+            k: np.full_like(v, np.nan)
+            if isinstance(v, np.ndarray) and np.issubdtype(v.dtype, np.floating)
+            else v
+            for k, v in batch.items()
+        }
+
+    def poison_loss(self, step: int, loss: float) -> float:
+        if step not in self.nan_loss_at:
+            return loss
+        self.nan_loss_at.discard(step)
+        self.fired.append(("nan_loss", step))
+        return float("nan")
+
+    def after_save(self, step: int, checkpoint: CheckpointManager) -> None:
+        """Called after each periodic save completes (scheduling-wise;
+        the save itself may still be flushing asynchronously)."""
+        due = {s for s in self.corrupt_checkpoint_at if s <= step}
+        if not due:
+            return
+        self.corrupt_checkpoint_at -= due
+        self.fired.append(("corrupt", step))
+        self.corrupt(checkpoint)
+
+    @staticmethod
+    def corrupt(checkpoint: CheckpointManager) -> None:
+        """Destroy the newest snapshot's payload in place (local
+        directories only) — the torn/half-deleted directory the restore
+        fallback must survive."""
+        checkpoint.wait_until_finished()
+        step = checkpoint.latest_step()
+        if step is None or "://" in checkpoint.directory:
+            return
+        payload = os.path.join(checkpoint.directory, str(step), "params")
+        if os.path.isdir(payload):
+            shutil.rmtree(payload)
+            logger.warning("chaos: corrupted checkpoint step %d", step)
+        checkpoint.reload()  # drop the manager's cached metadata
+
+    @classmethod
+    def wrap(cls, obj) -> "FaultInjector":
+        """Normalize the ``fault_injector`` argument: None → inert
+        injector, FaultInjector → itself, bare ``callable(step)`` →
+        adapter firing it in :meth:`before_step` (the seed API)."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        return _CallableInjector(obj)
+
+
+class _CallableInjector(FaultInjector):
+    def __init__(self, fn: Callable[[int], None]):
+        super().__init__()
+        self._fn = fn
+
+    def before_step(self, step: int) -> None:
+        self._fn(step)
+
+
 class ResilientTrainer:
-    """Checkpointed train loop that survives step failures.
+    """Checkpointed train loop that survives step failures and
+    preemption, on both the per-step and the superstep execution path.
 
     ``executor_factory`` rebuilds the Executor after a raised failure
     (a fresh factory call re-jits against a healthy backend); plain
@@ -62,7 +262,7 @@ class ResilientTrainer:
         executor_factory: Callable[[], Executor],
         checkpoint: CheckpointManager,
         policy: Optional[FailurePolicy] = None,
-        fault_injector: Optional[Callable[[int], None]] = None,
+        fault_injector: Union[FaultInjector, Callable[[int], None], None] = None,
     ):
         self.executor_factory = executor_factory
         self.checkpoint = checkpoint
@@ -72,6 +272,9 @@ class ResilientTrainer:
         # progress (the crash-loop budget); total_restarts = lifetime.
         self.restarts = 0
         self.total_restarts = 0
+        #: The executor of the finished (or failed) fit, for post-run
+        #: evaluation against the returned params/state.
+        self.executor: Optional[Executor] = None
 
     # -- internals ---------------------------------------------------------
 
@@ -117,48 +320,176 @@ class ResilientTrainer:
         batch_fn: Callable[[int], Dict[str, Any]],
         save_every: int = 10,
         seed: int = 0,
+        steps_per_call: int = 1,
+        check_every: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Run ``iterations`` steps with detection + recovery.
 
         ``batch_fn(step)`` supplies the batch for a step, so replayed
         steps after a rollback see the same data (deterministic resume,
-        which the reference cannot do at all).
+        which the reference cannot do at all) — the recovered loss
+        trajectory is bit-identical to an unfaulted run's.
+
+        ``steps_per_call=k > 1`` fuses K steps into one compiled
+        superstep dispatch (``Executor.build_superstep``): the stacked
+        per-step metrics come back in ONE host fence per superstep and
+        are scanned there for the first non-finite step.  ``k=1`` keeps
+        per-step dispatch but amortizes the finiteness fence too:
+        device-side losses accumulate and are validated in one batched
+        readback every ``check_every`` steps (default: ``save_every``)
+        — the relay's ~16 ms/call dispatch floor no longer buys a
+        blocking fence every iteration.  Detection latency is bounded
+        by the fence period either way, and a save never covers
+        unvalidated steps (the fence always runs first).
+
+        On SIGTERM/SIGINT the loop finishes + validates the in-flight
+        step/superstep, force-saves, flushes, and returns with
+        ``preempted=True`` (callers exit 0; a restarted job resumes
+        from that emergency snapshot automatically).
+
+        Returns step/restarts/params/opt_state/state/loss as before,
+        plus ``losses`` — ``{step: validated host loss}`` for every
+        step this process ran — and ``preempted``.
         """
+        injector = FaultInjector.wrap(self.fault_injector)
+        k = max(1, steps_per_call)
+        if k > MAX_STEPS_PER_CALL:
+            logger.warning(
+                "steps_per_call=%d exceeds the relay-safe fence cap; "
+                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
+                k, MAX_STEPS_PER_CALL,
+            )
+            k = MAX_STEPS_PER_CALL
+        # The k=1 fence period is the same relay hazard as the
+        # superstep length (an unfenced dependent dispatch chain):
+        # clamp it to the same cap.
+        check_every = min(check_every or save_every or 1, MAX_STEPS_PER_CALL)
         ex = self.executor_factory()
         step, params, opt_state, state = self._fresh_state(ex, seed)
-        last_loss = math.nan
-        while step < iterations:
-            try:
-                if self.fault_injector is not None:
-                    self.fault_injector(step)
-                batch = ex.shard_batch(batch_fn(step))
-                params, opt_state, state, metrics = ex.train_step(
-                    params, opt_state, state, batch
-                )
-                loss = float(jax.device_get(metrics["train_loss"]))
-                if self.policy.rollback_on_nonfinite and not math.isfinite(loss):
-                    raise StepFailure(f"non-finite loss at step {step}: {loss}")
-            except self.policy.recoverable as e:  # noqa: PERF203
-                ex, step, params, opt_state, state = self._recover(ex, seed, e)
-                continue
-            last_loss = loss
-            step += 1
-            if save_every and step % save_every == 0:
-                self.checkpoint.save(step, params, opt_state, state)
-                # Durable forward progress: the budget bounds crash
-                # *loops*, not total faults over the job lifetime.
-                self.restarts = 0
-        # Final save: if the step was already saved periodically it is
-        # this very state (same trajectory since the last restore) —
-        # skip, avoiding force's delete-then-rewrite crash window.  A
-        # fresh step forces past any orbax save-interval gating.
+        if step >= iterations:
+            # A restarted job whose checkpoint already reached the
+            # target (e.g. preempted on the final step): nothing to
+            # run; the returned losses dict is empty.
+            logger.info(
+                "resumed at step %d >= iterations %d: already complete",
+                step, iterations,
+            )
+        losses: Dict[int, float] = {}
+        sstep_fns: Dict[int, Any] = {}
+        pending = []  # k=1: (step, device loss) awaiting the batched fence
+        preempted = False
+
+        def validate_pending():
+            """ONE host readback for all pending per-step losses; record
+            the finite prefix, raise StepFailure at the first bad one."""
+            nonlocal pending
+            if not pending:
+                return
+            host = jax.device_get([m for _, m in pending])
+            todo, pending = pending, []
+            for (s, _), v in zip(todo, host):
+                self._record(losses, injector, s, float(v))
+
+        with PreemptionHandler() as preempt:
+            while step < iterations:
+                try:
+                    if k == 1:
+                        injector.before_step(step)
+                        batch = ex.shard_batch(
+                            injector.poison_batch(step, batch_fn(step))
+                        )
+                        params, opt_state, state, metrics = ex.train_step(
+                            params, opt_state, state, batch
+                        )
+                        pending.append((step, metrics["train_loss"]))
+                        step += 1
+                        trig = preempt.triggered
+                        at_save = bool(save_every) and step % save_every == 0
+                        if (len(pending) >= check_every or at_save
+                                or step >= iterations or trig):
+                            validate_pending()
+                            if at_save:
+                                self.checkpoint.save(
+                                    step, params, opt_state, state
+                                )
+                                injector.after_save(step, self.checkpoint)
+                                # Durable forward progress: the budget
+                                # bounds crash *loops*, not total faults
+                                # over the job lifetime.
+                                self.restarts = 0
+                    else:
+                        n = min(k, iterations - step)
+                        group = []
+                        for i in range(n):
+                            injector.before_step(step + i)
+                            group.append(
+                                injector.poison_batch(step + i, batch_fn(step + i))
+                            )
+                        fn = sstep_fns.get(n)
+                        if fn is None:
+                            fn = sstep_fns[n] = ex.build_superstep(n)
+                        stacked = ex.stack_steps(group)
+                        params, opt_state, state, ms = fn(
+                            params, opt_state, state, stacked
+                        )
+                        # ONE host fence per superstep: the stacked
+                        # per-step metrics, scanned for the first
+                        # non-finite step.
+                        host = jax.device_get(ms["train_loss"])
+                        # Read the preemption flag AFTER the fence —
+                        # nearly all wall time is inside the dispatch,
+                        # so a signal landing there still exits at THIS
+                        # boundary, not one superstep later.
+                        trig = preempt.triggered
+                        for j in range(n):
+                            self._record(
+                                losses, injector, step + j, float(host[j]),
+                                f" (superstep offset {j} of {n})",
+                            )
+                        prev, step = step, step + n
+                        if save_every and step // save_every > prev // save_every:
+                            # Superstep granularity: save at the first
+                            # boundary past each save_every multiple.
+                            self.checkpoint.save(step, params, opt_state, state)
+                            injector.after_save(step, self.checkpoint)
+                            self.restarts = 0
+                    if trig:
+                        preempted = True
+                        logger.warning(
+                            "preempted: emergency checkpoint at step %d, "
+                            "exiting cleanly", step,
+                        )
+                        break
+                except self.policy.recoverable as e:  # noqa: PERF203
+                    pending = []
+                    new_ex, step, params, opt_state, state = self._recover(
+                        ex, seed, e
+                    )
+                    if new_ex is not ex:
+                        ex, sstep_fns = new_ex, {}  # stale jits died with it
+        # Final (or emergency) save: if the step was already saved
+        # periodically it is this very state (same trajectory since the
+        # last restore) — skip; a fresh step force-saves past orbax's
+        # save-interval gating (force-replace is crash-safe now).  The
+        # flush fence makes it durable before the process exits.
         if step not in self.checkpoint.all_steps():
             self.checkpoint.save(step, params, opt_state, state, force=True)
+        self.checkpoint.wait_until_finished()
+        self.executor = ex
         return {
             "step": step,
             "restarts": self.total_restarts,
             "params": params,
             "opt_state": opt_state,
             "state": state,
-            "loss": last_loss,
+            "loss": losses.get(step - 1, math.nan),
+            "losses": losses,
+            "preempted": preempted,
         }
+
+    def _record(self, losses, injector, s: int, v: float, where: str = ""):
+        """Validate one host loss at the fence; record it or raise."""
+        v = injector.poison_loss(s, v)
+        if self.policy.rollback_on_nonfinite and not math.isfinite(v):
+            raise StepFailure(f"non-finite loss at step {s}{where}: {v}")
+        losses[s] = v

@@ -15,7 +15,6 @@ import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from flexflow_tpu.metrics import PerfMetrics
@@ -37,19 +36,9 @@ class Trainer:
 
     def _synthetic_host_batch(self, seed: int = 0) -> Dict[str, np.ndarray]:
         """Host-side synthetic inputs keyed by input-tensor name."""
-        rng = np.random.default_rng(seed)
-        batch = {}
-        for t in self.ex.model.input_tensors:
-            if jnp.issubdtype(t.dtype, jnp.integer):
-                # Index-like input: labels or embedding ids.  Use a small
-                # conservative range; models can overwrite.
-                hi = getattr(t, "max_value", 2)
-                arr = rng.integers(0, hi, size=t.shape).astype(np.int32)
-            else:
-                arr = rng.standard_normal(size=t.shape).astype(np.float32)
-                arr = np.asarray(arr, dtype=t.dtype)  # ml_dtypes handles bf16
-            batch[t.name] = arr
-        return batch
+        from flexflow_tpu.data.loader import synthetic_host_batch
+
+        return synthetic_host_batch(self.ex.model, np.random.default_rng(seed))
 
     def synthetic_batch(self, seed: int = 0) -> Dict[str, jax.Array]:
         """Device-resident synthetic inputs (reference: syntheticInput,
@@ -136,6 +125,13 @@ class Trainer:
             # for already-placed arrays) — the ZC-memory gather path.
             batches = (ex.shard_batch(b) for b in raw)
 
+        # Preemption (SIGTERM/SIGINT) with a checkpoint attached: finish
+        # the in-flight step, save at the boundary, exit cleanly so a
+        # restarted run resumes (resilience.PreemptionHandler; imported
+        # lazily — resilience imports this module for the fence cap).
+        from flexflow_tpu.runtime.resilience import PreemptionHandler
+
+        preempt = PreemptionHandler(install=checkpoint is not None).__enter__()
         try:
             # Warmup (compile) outside the timed region — the reference's
             # init_layers()+first-iteration cuDNN algo search equivalent.
@@ -178,6 +174,9 @@ class Trainer:
                         t0 = time.perf_counter()
                         checkpoint.save(start_step + it + 1, params, opt_state, state)
                         ckpt_s += time.perf_counter() - t0
+                    if preempt.triggered:
+                        break  # emergency save below, then clean exit
+                completed = it + 1
                 # The execution fence (dlrm.cc:159-162): a host readback of
                 # the final step's metrics; the step chain serializes
                 # through params.  elapsed is taken here, INSIDE the trace
@@ -188,7 +187,12 @@ class Trainer:
 
             self.metrics.update(final_m)
             if checkpoint is not None:
-                checkpoint.save(start_step + iterations, params, opt_state, state)
+                checkpoint.save(start_step + completed, params, opt_state, state)
+                if hasattr(checkpoint, "wait_until_finished"):
+                    checkpoint.wait_until_finished()  # durable before exit
+                if preempt.triggered:
+                    print(f"preempted: emergency checkpoint at step "
+                          f"{start_step + completed}, exiting cleanly")
             if ex.config.profiling:
                 # --profiling: per-op breakdown, the reference's per-task
                 # cudaEvent timings (conv_2d.cu:515-546).
@@ -200,7 +204,7 @@ class Trainer:
                     print("profiling: per-op breakdown unavailable for "
                           "pipeline executors")
             batch_size = ex.model.input_tensors[0].shape[0]
-            throughput = iterations * batch_size / elapsed
+            throughput = completed * batch_size / elapsed
             # Reference printout formulas (cnn.cc:128-129, dlrm.cc:165-166).
             print(f"time = {elapsed:.4f}s")
             print(f"tp = {throughput:.2f} samples/s")
@@ -208,14 +212,19 @@ class Trainer:
             #: the run that just finished — for post-training evaluation
             #: or manual checkpointing.
             self.final = (params, opt_state, state)
-            return {
+            stats = {
                 "elapsed_s": elapsed,
                 "samples_per_s": throughput,
-                "iterations": iterations,
+                "iterations": completed,
                 "batch_size": batch_size,
                 "loss": float(self.metrics.avg_loss),
             }
+            if preempt.triggered:
+                stats["preempted"] = True
+                stats["checkpoint_step"] = start_step + completed
+            return stats
         finally:
+            preempt.__exit__(None, None, None)
             if owned_prefetch is not None:
                 owned_prefetch.close()
 
@@ -339,6 +348,9 @@ class Trainer:
             else:
                 batches = (place(g) for g in groups())
 
+        from flexflow_tpu.runtime.resilience import PreemptionHandler
+
+        preempt = PreemptionHandler(install=checkpoint is not None).__enter__()
         try:
             ms = None
             for _ in range(warm_calls):
@@ -373,9 +385,7 @@ class Trainer:
                     # so the loss curve is bit-identical to k=1.
                     host_ms = jax.device_get(ms)
                     for j in range(n):
-                        self.metrics.update(
-                            {key: v[j] for key, v in host_ms.items()}
-                        )
+                        self.metrics.update(Executor.metrics_row(host_ms, j))
                         steps_done += 1
                         if log_every and steps_done % log_every == 0:
                             print(f"iter {steps_done}: {self.metrics.report()}")
@@ -391,10 +401,17 @@ class Trainer:
                             start_step + steps_done, params, opt_state, state
                         )
                         ckpt_s += time.perf_counter() - t0
+                    if preempt.triggered:
+                        break  # emergency save at this superstep boundary
                 elapsed = time.perf_counter() - start - ckpt_s
 
             if checkpoint is not None:
-                checkpoint.save(start_step + iterations, params, opt_state, state)
+                checkpoint.save(start_step + steps_done, params, opt_state, state)
+                if hasattr(checkpoint, "wait_until_finished"):
+                    checkpoint.wait_until_finished()  # durable before exit
+                if preempt.triggered:
+                    print(f"preempted: emergency checkpoint at step "
+                          f"{start_step + steps_done}, exiting cleanly")
             if ex.config.profiling:
                 from flexflow_tpu.runtime.profiler import profile_ops, report
 
@@ -407,20 +424,25 @@ class Trainer:
                 }
                 print(report(profile_ops(ex, params, state, one)))
             batch_size = ex.model.input_tensors[0].shape[0]
-            throughput = iterations * batch_size / elapsed
+            throughput = steps_done * batch_size / elapsed
             print(f"time = {elapsed:.4f}s")
             print(f"tp = {throughput:.2f} samples/s")
             self.final = (params, opt_state, state)
-            return {
+            stats = {
                 "elapsed_s": elapsed,
                 "samples_per_s": throughput,
-                "iterations": iterations,
+                "iterations": steps_done,
                 "batch_size": batch_size,
                 "loss": float(self.metrics.avg_loss),
                 "steps_per_call": k,
                 "supersteps": len(timed),
             }
+            if preempt.triggered:
+                stats["preempted"] = True
+                stats["checkpoint_step"] = start_step + steps_done
+            return stats
         finally:
+            preempt.__exit__(None, None, None)
             if owned_prefetch is not None:
                 owned_prefetch.close()
 

@@ -71,6 +71,20 @@ def test_candle_uno_app(capsys):
     assert "THROUGHPUT =" in capsys.readouterr().out
 
 
+def test_candle_uno_app_resilient_superstep(tmp_path, capsys):
+    """--resilient --save-every --steps-per-call wired together: the
+    ResilientTrainer loop drives superstep dispatch with periodic
+    checkpoints (runtime/resilience.py; RESILIENCE.md)."""
+    assert candle_uno.main([
+        "-b", "8", "-i", "4",
+        "--dense-layers", "64-64", "--dense-feature-layers", "32",
+        "--resilient", "--save-every", "2", "--steps-per-call", "2",
+        "--ckpt-dir", str(tmp_path / "ck"),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "THROUGHPUT =" in out and "restarts = 0" in out
+
+
 def test_transformer_app_hybrid(capsys):
     assert transformer.main([
         "-b", "8", "-i", "1", "--seq", "64", "--vocab", "64",

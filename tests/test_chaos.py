@@ -1,0 +1,27 @@
+"""Chaos-matrix acceptance for the resilience subsystem.
+
+Each scenario (flexflow_tpu/runtime/chaos.py) injects a fault into a
+``steps_per_call=8`` superstep run — raised fault, NaN batch, NaN
+loss, SIGTERM preemption, checkpoint corruption — and must recover and
+finish with a loss trajectory **bit-identical** to the unfaulted run;
+the force-save scenario kills a crash-safe replace between each phase
+and must always find a restorable checkpoint.  The same matrix runs
+standalone via ``tools/chaos_smoke.py``.
+"""
+
+import pytest
+
+from flexflow_tpu.runtime import chaos
+
+
+@pytest.fixture(scope="module")
+def chaos_root(tmp_path_factory):
+    # One root for the whole module: the unfaulted baseline trajectory
+    # is computed once and shared by every scenario.
+    return str(tmp_path_factory.mktemp("chaos"))
+
+
+@pytest.mark.parametrize("name", list(chaos.SCENARIOS))
+def test_chaos_scenario(chaos_root, name):
+    ok, detail = chaos.SCENARIOS[name](chaos_root)
+    assert ok, detail

@@ -148,6 +148,216 @@ class TestTrainerIntegration:
             assert ck.latest_step() == 7
 
 
+class TestDurability:
+    """Async saves, crash-safe force-replace, torn-snapshot fallback
+    (the checkpoint half of the resilience tentpole; RESILIENCE.md)."""
+
+    def test_async_save_roundtrip(self, tmp_path):
+        """async_save: non-blocking saves; restore fences on pending
+        writes, so the round trip is exact regardless of flush timing."""
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05, momentum=0.9))
+        p, o, s = ex.init(seed=3)
+        p1, o1, s1 = _run_steps(ex, p, o, s, [_batch(ex, seed=0)])
+        with CheckpointManager(str(tmp_path / "ck"), async_save=True) as ck:
+            ck.save(1, p1, o1, s1)
+            step, p2, o2, s2 = ck.restore(templates=ex.init(seed=0))
+            assert step == 1
+            _assert_trees_equal(p1, p2)
+            _assert_trees_equal(o1, o2)
+        # close() flushed: a fresh manager still sees a durable step.
+        with CheckpointManager(str(tmp_path / "ck")) as ck:
+            assert ck.latest_step() == 1
+
+    def test_force_replace_is_atomic_and_leaves_no_staging(self, tmp_path):
+        """force=True on an existing step: write-new-then-retire — the
+        replacement lands, nothing of the staging snapshot remains."""
+        import os
+
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05))
+        p, o, s = ex.init(seed=1)
+        p2 = jax.tree.map(lambda x: x + 1.0, p)
+        with CheckpointManager(str(tmp_path / "ck")) as ck:
+            ck.save(1, p, o, s)
+            assert ck.save(1, p2, o, s, force=True)
+            step, pr, _, _ = ck.restore(templates=(p, o, s))
+            assert step == 1
+            _assert_trees_equal(p2, pr)
+            assert ck.all_steps() == [1]
+        assert not any(
+            ".force-tmp" in n for n in os.listdir(tmp_path / "ck")
+        )
+
+    def test_kill_between_force_save_phases_always_restorable(self, tmp_path):
+        """Simulated kills at each force-replace phase boundary: a
+        fresh manager must always find a restorable checkpoint — the
+        old snapshot before the staged one commits, the new after."""
+        import os
+        import shutil
+
+        d = str(tmp_path / "ck")
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05))
+        p, o, s = ex.init(seed=1)
+        p_new = jax.tree.map(lambda x: x + 1.0, p)
+
+        def restored():
+            with CheckpointManager(d) as ck:
+                _, pr, _, _ = ck.restore(templates=(p, o, s))
+            return pr
+
+        with CheckpointManager(d) as ck:
+            ck.save(1, p, o, s)
+        # Kill mid-write (phase 1): only orbax's internal staging tmp
+        # exists — recovery discards it, the old snapshot survives.
+        os.makedirs(os.path.join(
+            d, "1.force-tmp.orbax-checkpoint-tmp-0", "params"))
+        _assert_trees_equal(p, restored())
+        # Kill after the staged snapshot committed but before retire.
+        with CheckpointManager(d) as ck:
+            ck._write_force_tmp(1, ck._items(p_new, o, s))
+        _assert_trees_equal(p_new, restored())
+        # Kill mid-retire: staged snapshot + half-deleted old dir.
+        with CheckpointManager(d) as ck:
+            ck._write_force_tmp(1, ck._items(p_new, o, s))
+            shutil.rmtree(os.path.join(d, "1", "params"))
+        _assert_trees_equal(p_new, restored())
+
+    def test_restore_falls_back_past_torn_step(self, tmp_path):
+        """A half-deleted latest step (crash mid-delete / corruption)
+        must not strand the job: latest-restore skips it and restores
+        the previous intact step."""
+        import os
+        import shutil
+
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05))
+        p, o, s = ex.init(seed=1)
+        p2 = jax.tree.map(lambda x: x + 1.0, p)
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as ck:
+            ck.save(1, p, o, s)
+            ck.save(2, p2, o, s)
+        shutil.rmtree(os.path.join(d, "2", "params"))  # tear the latest
+        with CheckpointManager(d) as ck:
+            step, pr, _, _ = ck.restore(templates=(p, o, s))
+        assert step == 1
+        _assert_trees_equal(p, pr)
+
+    def test_all_steps_torn_raises_instead_of_fresh_start(self, tmp_path):
+        """Snapshots exist but none is readable: restore must raise
+        TornCheckpointError, NOT FileNotFoundError — resilience's
+        _fresh_state treats the latter as 'no checkpoint yet' and would
+        silently restart from step 0 over a damaged run."""
+        import os
+        import shutil
+
+        from flexflow_tpu.runtime.checkpoint import TornCheckpointError
+
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05))
+        p, o, s = ex.init(seed=1)
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as ck:
+            ck.save(1, p, o, s)
+        shutil.rmtree(os.path.join(d, "1", "params"))
+        with CheckpointManager(d) as ck:
+            with pytest.raises(TornCheckpointError):
+                ck.restore(templates=(p, o, s))
+
+    def test_template_mismatch_propagates_not_fallback(self, tmp_path):
+        """A template whose tree structure doesn't match the snapshot
+        (a changed/renamed layer) is a programmer error: restore must
+        raise it, not 'fall back' through every intact step and report
+        no checkpoint found (which resilience would treat as a fresh
+        start and overwrite the run)."""
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05))
+        p, o, s = ex.init(seed=1)
+        with CheckpointManager(str(tmp_path / "ck")) as ck:
+            ck.save(1, p, o, s)
+            bad = {("fc1_renamed" if k == "fc1" else k): v
+                   for k, v in p.items()}
+            with pytest.raises(ValueError, match="key mismatch"):
+                ck.restore(templates=(bad, o, s))
+
+    def test_periodic_save_replaces_torn_step(self, tmp_path):
+        """A non-force save landing on a torn step dir (a replayed run
+        crossing the same boundary) must replace it, not skip it."""
+        import os
+        import shutil
+
+        ff = _tiny_model()
+        ex = Executor(ff, optimizer=SGDOptimizer(lr=0.05))
+        p, o, s = ex.init(seed=1)
+        d = str(tmp_path / "ck")
+        with CheckpointManager(d) as ck:
+            ck.save(1, p, o, s)
+            shutil.rmtree(os.path.join(d, "1", "params"))
+            ck.reload()
+            assert ck.save(1, p, o, s)  # replaced, not skipped
+            step, pr, _, _ = ck.restore(templates=(p, o, s))
+        assert step == 1
+        _assert_trees_equal(p, pr)
+
+
+def test_zero_sharded_opt_state_portable_restore(tmp_path):
+    """Satellite: ZeRO-sharded optimizer moments (Adam m/v split over
+    the DP mesh axes, --zero-opt) must restore exactly AND be
+    strategy-portable — saved under a hybrid n2c4 strategy, restored
+    into a pure-DP executor, then trained, matching the uninterrupted
+    hybrid run (the DP≡strategy invariant extended through a
+    checkpoint boundary; the seed suite only covered dense params)."""
+    from flexflow_tpu.optim import AdamOptimizer
+
+    def model():
+        ff = FFModel(FFConfig(batch_size=8, zero_sharded_optimizer=True))
+        x = ff.create_tensor((8, 12), name="x")
+        lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+        t = ff.dense(x, 16, activation="relu", name="fc1")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    store_a = StrategyStore(8, {"fc1": ParallelConfig(n=2, c=4),
+                                "fc2": ParallelConfig(c=2)})
+    hosts = []
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        hosts.append({
+            "x": rng.standard_normal((8, 12)).astype(np.float32),
+            "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+        })
+
+    # Uninterrupted reference: 4 steps under the hybrid strategy.
+    ex_ref = Executor(model(), strategy=store_a,
+                      optimizer=AdamOptimizer(lr=0.01))
+    p, o, s = ex_ref.init(seed=7)
+    p_ref, o_ref, _ = _run_steps(
+        ex_ref, p, o, s, [ex_ref.shard_batch(h) for h in hosts])
+
+    # 2 steps under hybrid, save, restore into pure-DP ZeRO, 2 more.
+    ex_a = Executor(model(), strategy=store_a,
+                    optimizer=AdamOptimizer(lr=0.01))
+    p, o, s = ex_a.init(seed=7)
+    p2, o2, s2 = _run_steps(
+        ex_a, p, o, s, [ex_a.shard_batch(h) for h in hosts[:2]])
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        ck.save(2, p2, o2, s2)
+        ex_b = Executor(model(), optimizer=AdamOptimizer(lr=0.01))  # DP
+        step, pr, orr, sr = ck.restore(templates=ex_b.init(seed=0))
+    assert step == 2
+    # The ZeRO-sharded moment buffers round-trip exactly (values; the
+    # shardings are now ex_b's — that resharding IS the portability).
+    _assert_trees_equal(o2, orr)
+    p_b, o_b, _ = _run_steps(
+        ex_b, pr, orr, sr, [ex_b.shard_batch(h) for h in hosts[2:]])
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_b)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_dropout_rng_state_resumes_exactly(tmp_path):
     """Dropout's PRNG key is op STATE: a restore must continue the
     mask stream exactly where the run left off (4 straight steps ==

@@ -4,13 +4,19 @@ lacks entirely — FatalError aborts, SURVEY.md §5)."""
 import numpy as np
 import pytest
 
+import jax
+
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.optim import SGDOptimizer
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 from flexflow_tpu.runtime.checkpoint import CheckpointManager
 from flexflow_tpu.runtime.executor import Executor
-from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer
+from flexflow_tpu.runtime.resilience import (
+    FailurePolicy,
+    FaultInjector,
+    ResilientTrainer,
+)
 
 
 def _factory():
@@ -125,3 +131,163 @@ def test_unrecoverable_exception_propagates(tmp_path):
         with pytest.raises(Fatal):
             rt.fit(iterations=2, batch_fn=_batch_fn)
         assert rt.restarts == 0
+
+
+def test_programmer_errors_surface_immediately(tmp_path):
+    """Regression for the over-broad recoverable default: ValueError is
+    a programmer error (bad shapes, wrong keys, broken configs) —
+    replaying it from a checkpoint reproduces the same crash until the
+    restart budget is exhausted and buries the traceback.  It must
+    propagate on the FIRST occurrence, with zero restarts."""
+    def inject(step):
+        raise ValueError("shape bug: expected (8, 16), got (8, 17)")
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(_factory(), ck, fault_injector=inject)
+        with pytest.raises(ValueError, match="shape bug"):
+            rt.fit(iterations=4, batch_fn=_batch_fn)
+        assert rt.restarts == 0 and rt.total_restarts == 0
+
+
+def test_real_shape_bug_surfaces_immediately(tmp_path):
+    """A batch_fn emitting the wrong feature width must crash on first
+    contact (the executor's input assert), not spin the restart loop."""
+    def bad_batch(step):
+        b = _batch_fn(step)
+        b["x"] = np.zeros((8, 17), np.float32)  # model declares (8, 16)
+        return b
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        rt = ResilientTrainer(_factory(), ck)
+        with pytest.raises((AssertionError, TypeError, ValueError)):
+            rt.fit(iterations=4, batch_fn=bad_batch)
+        assert rt.restarts == 0
+
+
+def _trajectory(out, iters):
+    return np.array([out["losses"][i] for i in range(iters)])
+
+
+def test_superstep_trajectory_matches_per_step(tmp_path):
+    """fit(steps_per_call=4) must reproduce the per-step resilient
+    loop's loss trajectory bit-for-bit (the superstep scan invariant of
+    tests/test_superstep.py, now through the resilient loop)."""
+    with CheckpointManager(str(tmp_path / "a")) as ck:
+        out1 = ResilientTrainer(_factory(), ck).fit(
+            iterations=8, batch_fn=_batch_fn, save_every=4)
+    with CheckpointManager(str(tmp_path / "b")) as ck:
+        out4 = ResilientTrainer(_factory(), ck).fit(
+            iterations=8, batch_fn=_batch_fn, save_every=4, steps_per_call=4)
+    np.testing.assert_array_equal(_trajectory(out1, 8), _trajectory(out4, 8))
+
+
+def test_superstep_rollback_replays_bit_identical(tmp_path):
+    """A raised fault inside a k=4 superstep: rollback to the last
+    boundary checkpoint, deterministic replay, trajectory identical to
+    the unfaulted superstep run."""
+    with CheckpointManager(str(tmp_path / "ref")) as ck:
+        ref = ResilientTrainer(_factory(), ck).fit(
+            iterations=12, batch_fn=_batch_fn, save_every=4, steps_per_call=4)
+    inj = FaultInjector(raise_at=(9,))
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        out = ResilientTrainer(_factory(), ck, fault_injector=inj).fit(
+            iterations=12, batch_fn=_batch_fn, save_every=4, steps_per_call=4)
+    assert out["restarts"] == 1 and inj.fired == [("raise", 9)]
+    np.testing.assert_array_equal(_trajectory(ref, 12), _trajectory(out, 12))
+
+
+def test_nan_loss_injection_rolls_back(tmp_path):
+    """NaN-in-loss mode: silent divergence surfaced at the batched
+    fence without touching device numerics; one-shot, so the replay is
+    clean and the final trajectory matches the unfaulted run."""
+    with CheckpointManager(str(tmp_path / "ref")) as ck:
+        ref = ResilientTrainer(_factory(), ck).fit(
+            iterations=6, batch_fn=_batch_fn, save_every=2)
+    inj = FaultInjector(nan_loss_at=(4,))
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        out = ResilientTrainer(_factory(), ck, fault_injector=inj).fit(
+            iterations=6, batch_fn=_batch_fn, save_every=2)
+    assert out["restarts"] == 1 and inj.fired == [("nan_loss", 4)]
+    np.testing.assert_array_equal(_trajectory(ref, 6), _trajectory(out, 6))
+
+
+def test_per_step_fence_is_amortized(tmp_path, monkeypatch):
+    """Satellite: the per-step path must not host-fence the loss every
+    iteration (dispatch-dominated on the relay) — one batched readback
+    per check_every window."""
+    fences = []
+    real = jax.device_get
+
+    def counting(x):
+        if isinstance(x, list):
+            fences.append(len(x))
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        out = ResilientTrainer(_factory(), ck).fit(
+            iterations=12, batch_fn=_batch_fn, save_every=0, check_every=4)
+    assert out["step"] == 12
+    # 12 steps / check_every=4 → exactly 3 batched fences of 4 losses.
+    assert fences == [4, 4, 4]
+
+
+def test_check_every_clamped_to_relay_cap(tmp_path, monkeypatch):
+    """check_every is the same unfenced-dependent-chain hazard as
+    steps_per_call on the TPU relay (CLAUDE.md keep-chains-short):
+    it must clamp to MAX_STEPS_PER_CALL too."""
+    from flexflow_tpu.runtime.trainer import MAX_STEPS_PER_CALL
+
+    fences = []
+    real = jax.device_get
+
+    def counting(x):
+        if isinstance(x, list):
+            fences.append(len(x))
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        out = ResilientTrainer(_factory(), ck).fit(
+            iterations=25, batch_fn=_batch_fn, save_every=0, check_every=50)
+    assert out["step"] == 25
+    assert fences and max(fences) <= MAX_STEPS_PER_CALL
+
+
+def test_preemption_emergency_save_and_resume(tmp_path):
+    """SIGTERM mid-run: validate the in-flight window, emergency-save,
+    return preempted=True; a restarted trainer resumes from the
+    emergency snapshot and the concatenated trajectory is bit-identical
+    to an unfaulted run."""
+    with CheckpointManager(str(tmp_path / "ref")) as ck:
+        ref = ResilientTrainer(_factory(), ck).fit(
+            iterations=9, batch_fn=_batch_fn, save_every=3)
+    ckdir = str(tmp_path / "ck")
+    inj = FaultInjector(preempt_at=(4,))
+    with CheckpointManager(ckdir) as ck:
+        first = ResilientTrainer(_factory(), ck, fault_injector=inj).fit(
+            iterations=9, batch_fn=_batch_fn, save_every=3)
+    assert first["preempted"] and 0 < first["step"] < 9
+    assert first["step"] in (5, 6)  # next boundary after the signal
+    with CheckpointManager(ckdir) as ck:
+        second = ResilientTrainer(_factory(), ck).fit(
+            iterations=9, batch_fn=_batch_fn, save_every=3)
+    assert not second["preempted"] and second["step"] == 9
+    merged = {**first["losses"], **second["losses"]}
+    np.testing.assert_array_equal(
+        _trajectory(ref, 9), np.array([merged[i] for i in range(9)])
+    )
+
+
+def test_bare_callable_injector_still_works(tmp_path):
+    """The seed API — fault_injector as a bare callable(step) — keeps
+    working through the FaultInjector.wrap adapter."""
+    calls = []
+
+    def inject(step):
+        calls.append(step)
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        out = ResilientTrainer(_factory(), ck, fault_injector=inject).fit(
+            iterations=3, batch_fn=_batch_fn, save_every=2)
+    assert out["step"] == 3 and calls == [0, 1, 2]

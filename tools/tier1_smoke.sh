@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Fast pre-commit smoke: the targeted suites from CLAUDE.md covering
-# ops/oracles, strategy numerics, the pipeline runtime, and superstep
-# execution — <3 min on the 8-dev virtual CPU mesh, vs ~14 min for the
-# full tier-1 run.  Single core box: no pytest-xdist.
+# ops/oracles, strategy numerics, the pipeline runtime, superstep
+# execution, and the resilience/checkpoint subsystem — <4 min on the
+# 8-dev virtual CPU mesh, vs ~14 min for the full tier-1 run.  Single
+# core box: no pytest-xdist.
 #
 # Usage: ./tools/tier1_smoke.sh [extra pytest args]
 set -euo pipefail
@@ -12,4 +13,6 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_sharding_equivalence.py \
     tests/test_pipeline.py \
     tests/test_superstep.py \
+    tests/test_resilience.py \
+    tests/test_checkpoint.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"
