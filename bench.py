@@ -395,6 +395,97 @@ def bench_superstep(n_chips: int, on_tpu: bool):
     return out
 
 
+def bench_pipeline(n_chips: int, on_tpu: bool):
+    """Layer-wise pipeline leg: S stages x mb microbatches at chunk
+    c in {1, mb} — c=mb folds each stage's per-microbatch fwd/bwd
+    programs into ONE scanned program, cutting host programs per step
+    from 2*S*mb to 2*S (``programs`` fields record the actual
+    ``last_schedule`` event counts) — plus the k=8 fence-amortized
+    pipeline superstep A/B at the dispatch-minimal chunk.  Stage count
+    is capped by the visible device count (stages need distinct device
+    subsets); a 1-chip run reports why it skipped instead of faking a
+    pipeline."""
+    import numpy as np
+
+    import jax
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+    from flexflow_tpu.runtime.pipeline import PipelineExecutor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    nd = len(jax.devices())
+    batch = 64 * nd if on_tpu else 32
+    width = 256 if on_tpu else 64
+    iters = 16 if on_tpu else 8
+    depth = 4
+
+    def build():
+        ff = FFModel(FFConfig(batch_size=batch, seed=5))
+        x = ff.create_tensor((batch, width), name="x")
+        lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+        t = x
+        for i in range(depth):
+            t = ff.dense(t, width, activation="relu", name=f"fc{i}")
+        t = ff.dense(t, 8, name="head")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    def store(S):
+        st = StrategyStore(nd)
+        per = nd // S
+        names = [f"fc{i}" for i in range(depth)] + ["head", "softmax"]
+        for i, name in enumerate(names):
+            si = min(i * S // len(names), S - 1)
+            ids = tuple(range(si * per, (si + 1) * per))
+            st.set(name, ParallelConfig(n=per, device_ids=ids))
+        return st
+
+    out = {"batch_size": batch, "iterations": iters, "n_devices": nd}
+    sweep_S = [S for S in (2, 4) if S <= nd]
+    if not sweep_S:
+        out["skipped"] = (
+            f"{nd} device(s): pipeline stages need distinct device "
+            f"subsets (>= 2 devices)"
+        )
+        return out
+    ff = build()
+    for S in sweep_S:
+        for mb in (4, 8):
+            for c in (1, mb):
+                pipe = PipelineExecutor(
+                    ff, store(S),
+                    optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                    microbatches=mb, chunk=c,
+                )
+                stats = Trainer(pipe).fit(iterations=iters, warmup=1)
+                key = f"s{S}_mb{mb}_c{c}"
+                out[f"{key}_ms_per_step"] = round(
+                    stats["elapsed_s"] / iters * 1e3, 3
+                )
+                out[f"{key}_programs"] = len(pipe.last_schedule)
+    # Amortization headline: dispatch-minimal chunk vs per-microbatch
+    # at the deepest swept config.
+    S, mb = sweep_S[-1], 8
+    out["chunk_amortization"] = round(
+        out[f"s{S}_mb{mb}_c1_ms_per_step"]
+        / out[f"s{S}_mb{mb}_c{mb}_ms_per_step"], 3
+    )
+    # Pipeline superstep: k=8 steps under one device_get fence.
+    pipe = PipelineExecutor(
+        ff, store(sweep_S[0]),
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+        microbatches=4, chunk=4,
+    )
+    stats = Trainer(pipe).fit(iterations=iters, warmup=1, steps_per_call=8)
+    out["superstep_k8_ms_per_step"] = round(
+        stats["elapsed_s"] / iters * 1e3, 3
+    )
+    return out
+
+
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
     speedup (the ICML'18 headline claims it for AlexNet/VGG/Inception;
@@ -544,6 +635,12 @@ def main():
             extra["superstep"] = bench_superstep(n_chips, on_tpu)
     except Exception as e:
         extra["superstep_error"] = f"{type(e).__name__}: {e}"
+    checkpoint_result(per_chip)
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["pipeline"] = bench_pipeline(n_chips, on_tpu)
+    except Exception as e:
+        extra["pipeline_error"] = f"{type(e).__name__}: {e}"
     checkpoint_result(per_chip)
     try:
         with contextlib.redirect_stdout(sys.stderr):

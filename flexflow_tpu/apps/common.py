@@ -50,7 +50,10 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --min-lr F  --lr-gamma F (adam only)
   --profiling   --dry-run   --remat   --trace DIR   --ones-init   --zc-dataset
   --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
-  --granules N   --zero-opt   --steps-per-call K (superstep fusion)
+  --pipeline-chunk C (scan C microbatches per stage program)
+  --granules N   --zero-opt
+  --steps-per-call K (superstep: fused scan on full-mesh strategies,
+                      one-fence-per-K amortization on pipeline ones)
   --eval-iters N (held-out eval after training)   --clip-norm F
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)
@@ -284,10 +287,12 @@ def _run_resilient(
     from flexflow_tpu.runtime.checkpoint import CheckpointManager
     from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer
 
-    if isinstance(first_ex, PipelineExecutor):
+    if isinstance(first_ex, PipelineExecutor) and cfg.steps_per_call > 1:
         raise SystemExit(
-            "--resilient requires full-mesh strategies; layer-wise "
-            "(device-subset) placement has no rollback/replay support yet"
+            "--resilient --steps-per-call K>1 requires full-mesh "
+            "strategies (ResilientTrainer's superstep path drives "
+            "Executor.build_superstep); layer-wise strategies compose "
+            "with --resilient at steps-per-call 1"
         )
     if cfg.accum_steps > 1:
         raise SystemExit(
@@ -404,18 +409,13 @@ def run_training(
         mesh_plan=mesh_plan,
         microbatches=cfg.microbatches,
         schedule=cfg.pipeline_schedule,
+        chunk=cfg.pipeline_chunk,
     )
     if isinstance(ex, PipelineExecutor):
         if cfg.accum_steps > 1:
             raise SystemExit(
                 "--accum-steps composes with full-mesh strategies only; "
                 "pipeline strategies microbatch via --microbatches"
-            )
-        if cfg.steps_per_call > 1:
-            raise SystemExit(
-                "--steps-per-call (superstep fusion) requires full-mesh "
-                "strategies; pipeline strategies dispatch per-stage "
-                "programs the superstep scan cannot fuse"
             )
         if mesh_plan is not None:
             raise SystemExit(
@@ -446,7 +446,7 @@ def run_training(
             return make_executor(
                 ff, strategy, config=cfg, optimizer=make_optimizer(cfg),
                 mesh_plan=mesh_plan, microbatches=cfg.microbatches,
-                schedule=cfg.pipeline_schedule,
+                schedule=cfg.pipeline_schedule, chunk=cfg.pipeline_chunk,
             )
 
         return _run_resilient(ff, cfg, executor_factory, ex, arrays,

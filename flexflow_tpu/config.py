@@ -90,6 +90,14 @@ class FFConfig:
     # --pipeline-schedule 1f1b|gpipe: stage-program dispatch order
     # (1f1b bounds live activations per stage; gpipe = fill then drain).
     pipeline_schedule: str = "1f1b"
+    # --pipeline-chunk C: microbatch chunk factor for layer-wise
+    # strategies — each stage's fwd/bwd runs as ONE jitted lax.scan
+    # over C stacked microbatches, cutting host programs per step from
+    # 2*S*m to 2*S*ceil(m/C) (the pipeline's dispatch-amortization
+    # knob; C=m is dispatch-minimal, numerics bit-identical across C).
+    # Memory: the 1F1B live-activation bound becomes chunk-granular
+    # ((S-si)*C microbatches per stage).
+    pipeline_chunk: int = 1
     # Compute-free graph/shape validation (the reference's
     # DISABLE_COMPUTATION build, ``ops.h:19``): trace the full train
     # step under jax.eval_shape and print the op/param table, running
@@ -137,7 +145,9 @@ class FFConfig:
     # deterministic batch replay, and SIGTERM/SIGINT emergency saves
     # (runtime/resilience.py; RESILIENCE.md).  Composes with
     # --steps-per-call: detection happens at the single per-superstep
-    # fence.  Full-mesh strategies only.
+    # fence.  Layer-wise (pipeline) strategies compose at
+    # --steps-per-call 1 (per-stage {si: ...} trees checkpoint like any
+    # pytree); the fused superstep path stays full-mesh only.
     resilient: bool = False
     # --save-every N: checkpoint every N steps (0 = end-of-run only).
     # On the superstep path saves land at the first superstep boundary
@@ -255,6 +265,13 @@ class FFConfig:
                     raise SystemExit(
                         f"--pipeline-schedule must be 1f1b or gpipe, "
                         f"got {cfg.pipeline_schedule!r}"
+                    )
+            elif a == "--pipeline-chunk":
+                cfg.pipeline_chunk = int(_next())
+                if cfg.pipeline_chunk < 1:
+                    raise SystemExit(
+                        f"--pipeline-chunk must be >= 1, got "
+                        f"{cfg.pipeline_chunk}"
                     )
             elif a == "--search":
                 cfg.search_iters = cfg.search_iters or 20_000

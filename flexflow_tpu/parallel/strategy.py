@@ -114,20 +114,39 @@ class StrategyStore:
     def __contains__(self, op_name: str) -> bool:
         return op_name in self.table
 
-    def superstep_capable(self) -> bool:
-        """Whether superstep execution (``Executor.build_superstep``:
-        K train steps fused into one compiled dispatch) can realize
-        this strategy.  True when every op spans the full mesh;
-        layer-wise placement (``device_ids`` naming a proper device
-        subset, the reference's per-op ``gpu[]`` lists) runs through
-        ``PipelineExecutor``, whose per-stage host dispatch a single
-        ``lax.scan`` cannot fuse — callers must refuse loudly rather
-        than silently fall back to per-step dispatch."""
-        return not any(
+    def superstep_mode(self) -> str:
+        """How ``steps_per_call > 1`` (superstep execution) realizes
+        this strategy — every strategy family supports supersteps, in
+        one of two forms:
+
+        - ``"fused"``: every op spans the full mesh, so
+          ``Executor.build_superstep`` compiles K train steps into ONE
+          ``lax.scan`` dispatch (dispatch AND fence both amortize).
+        - ``"amortized"``: layer-wise placement (``device_ids`` naming
+          a proper device subset, the reference's per-op ``gpu[]``
+          lists) runs through ``PipelineExecutor``, whose per-stage
+          host dispatch a single scan cannot fuse — K steps instead
+          dispatch back-to-back sharing ONE ``jax.device_get`` fence
+          per superstep (``Trainer._fit_superstep_pipeline``), and the
+          per-step dispatch count is cut separately by the pipeline
+          ``chunk`` factor.
+        """
+        layer_wise = any(
             pc.device_ids is not None
             and len(set(pc.device_ids)) < self.num_devices
             for pc in self.table.values()
         )
+        return "amortized" if layer_wise else "fused"
+
+    def superstep_capable(self) -> bool:
+        """Whether ``Executor.build_superstep`` (the FUSED superstep:
+        K train steps in one compiled dispatch) can realize this
+        strategy.  False means layer-wise placement — supersteps still
+        exist but only as the fence-amortized pipeline form (see
+        :meth:`superstep_mode`); ``build_superstep`` callers must
+        refuse loudly rather than silently fall back to per-step
+        dispatch."""
+        return self.superstep_mode() == "fused"
 
     # -- (de)serialization ------------------------------------------------
 

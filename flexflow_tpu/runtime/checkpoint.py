@@ -145,7 +145,10 @@ class CheckpointManager:
     def _items(self, params, opt_state, state) -> Dict[str, Any]:
         """Empty subtrees (momentum-less opt_state, stateless models)
         are simply omitted — orbax rejects empty items — and
-        reconstituted as None/{} on restore."""
+        reconstituted from the restore TEMPLATES (a leafless structure
+        carries no data, so the template IS the snapshot; returning
+        ``{}`` instead would lose container structure like the
+        pipeline's per-stage ``{si: {}}`` state dicts)."""
         ocp = _ocp()
         items: Dict[str, Any] = {"params": ocp.args.StandardSave(params)}
         if opt_state is not None and jax.tree.leaves(opt_state):
@@ -328,8 +331,10 @@ class CheckpointManager:
         if "state" in present:
             items["state"] = ocp.args.StandardRestore(t_state)
         restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
-        opt_state = restored["opt_state"] if "opt_state" in present else None
-        state = restored["state"] if "state" in present else {}
+        # Absent items were leafless at save time: the template is the
+        # exact snapshot (None stays None, {si: {}} keeps its stages).
+        opt_state = restored["opt_state"] if "opt_state" in present else t_opt
+        state = restored["state"] if "state" in present else t_state
         return step, restored["params"], opt_state, state
 
     def close(self) -> None:

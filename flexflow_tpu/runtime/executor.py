@@ -61,6 +61,32 @@ def _merge_metrics(acc: Dict[str, jax.Array], m: Dict[str, jax.Array]) -> Dict[s
     return out
 
 
+def mean_metrics(
+    metrics: Dict[str, jax.Array],
+    count: Optional[int] = None,
+    stacked: bool = False,
+) -> Dict[str, jax.Array]:
+    """Count-aware per-microbatch metric reduction, shared by every
+    multi-microbatch execution path (``Executor._build_accum_step``'s
+    stacked scan output, ``PipelineExecutor._finish_step``'s summed
+    accumulator): integer-dtype metrics are COUNTS (samples, correct
+    predictions) and sum across microbatches; float metrics are means
+    and average.  ``stacked=True`` reduces a leading microbatch axis;
+    otherwise ``metrics`` are already summed and ``count`` divides the
+    float entries."""
+    if stacked:
+        return {
+            k: jnp.sum(v, axis=0)
+            if jnp.issubdtype(v.dtype, jnp.integer)
+            else jnp.mean(v, axis=0)
+            for k, v in metrics.items()
+        }
+    return {
+        k: v if jnp.issubdtype(v.dtype, jnp.integer) else v / count
+        for k, v in metrics.items()
+    }
+
+
 class Executor:
     """Compiles an FFModel + StrategyStore onto a MeshPlan."""
 
@@ -614,12 +640,7 @@ class Executor:
             g = self._clip_grads(
                 jax.tree.map(lambda x: jnp.mean(x, axis=0), grads)
             )
-            m = {
-                k: jnp.sum(v, axis=0)
-                if jnp.issubdtype(v.dtype, jnp.integer)
-                else jnp.mean(v, axis=0)
-                for k, v in metrics.items()
-            }
+            m = mean_metrics(metrics, stacked=True)
             new_params, new_opt = self.optimizer.update(params, opt_state, g)
             return new_params, self._constrain_zero_opt(new_opt), new_state, m
 
@@ -657,8 +678,11 @@ class Executor:
         moment shardings every iteration).  Layer-wise (device-subset)
         strategies dispatch per-stage programs from the host and cannot
         fuse — Executor's constructor already rejects them, and
-        :meth:`StrategyStore.superstep_capable` lets callers refuse
-        before building anything.
+        :meth:`StrategyStore.superstep_mode` tells callers which
+        superstep form a strategy supports: this FUSED one, or the
+        pipeline's fence-amortized form
+        (``Trainer._fit_superstep_pipeline``: k steps dispatched
+        back-to-back under one ``device_get``).
         """
         if k < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {k}")

@@ -39,13 +39,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.ops.base import Op, TensorSpec
 from flexflow_tpu.optim import SGDOptimizer
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
-from flexflow_tpu.runtime.executor import Executor, _merge_metrics
+from flexflow_tpu.runtime.executor import Executor, _merge_metrics, mean_metrics
 
 _log = logging.getLogger("ff.pipeline")
 
@@ -198,6 +199,21 @@ class PipelineExecutor:
     ``microbatches`` splits the batch GPipe-style; 1 reproduces the
     reference's plain layer-wise placement (compute still pipelined
     across *iterations* by async dispatch, as Legion's dataflow did).
+
+    ``chunk`` is the microbatch chunk factor ``c``: each stage's
+    forward (and backward, with in-scan gradient accumulation) runs as
+    ONE jitted ``lax.scan`` over ``c`` stacked microbatches, cutting
+    host programs per step from ``2*S*m`` to ``2*S*ceil(m/c)`` — the
+    pipeline's answer to the per-program dispatch floor
+    (PIPELINE_OVERHEAD.md; ~1.4-1.6 ms/program on this host, ~16 ms
+    through the axon relay).  ``c=1`` reproduces the per-microbatch
+    event loop exactly; ``c=m`` is the dispatch-minimal GPipe-shaped
+    limit.  Numerics are bit-identical across ``c``: the scan carries
+    the running per-stage gradient (and last-stage metrics) sum, so
+    accumulation order is microbatch order regardless of chunking.
+    The memory tradeoff is explicit: the 1F1B live-activation bound
+    becomes chunk-granular (at most ``(S-si)*c`` microbatch
+    activations live per stage instead of ``S-si``).
     """
 
     def __init__(
@@ -209,6 +225,7 @@ class PipelineExecutor:
         devices: Optional[Sequence[jax.Device]] = None,
         microbatches: int = 1,
         schedule: str = "1f1b",
+        chunk: int = 1,
     ):
         self.model = model
         self.config = config or model.config
@@ -226,15 +243,30 @@ class PipelineExecutor:
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
         self.microbatches = microbatches
+        if chunk < 1:
+            raise ValueError(f"pipeline chunk must be >= 1, got {chunk}")
+        if chunk > microbatches:
+            _log.warning(
+                "pipeline chunk %d exceeds microbatches %d; clamping "
+                "(c=m is already the dispatch-minimal limit)",
+                chunk, microbatches,
+            )
+            chunk = microbatches
+        self.chunk = chunk
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.schedule = schedule
         #: dispatch-order event trace of the last train_step — a list of
-        #: ("F"|"B", stage, microbatch); tests and the dry run verify
-        #: the schedule by EVENT ORDER, not wall clock (the virtual
-        #: mesh multiplexes one core, PIPELINE_OVERHEAD.md).
+        #: ("F"|"B", stage, unit) where a unit is a microbatch (chunk=1)
+        #: or a CHUNK of ``chunk`` stacked microbatches; tests and the
+        #: dry run verify the schedule by EVENT ORDER, not wall clock
+        #: (the virtual mesh multiplexes one core,
+        #: PIPELINE_OVERHEAD.md).  len(last_schedule) is exactly the
+        #: fwd+bwd host program count of the step: 2*S*ceil(m/c).
         self.last_schedule: List[Tuple[str, int, int]] = []
         self._zero_douts: Dict[Tuple, jax.Array] = {}
+        self._zero_grads_cache: Dict[int, Any] = {}
+        self._zero_metrics_cache: Dict[int, Any] = {}
         all_devices = list(devices) if devices is not None else jax.devices()
         self.stages = derive_stages(model, strategy)
 
@@ -350,6 +382,132 @@ class PipelineExecutor:
     def _bwd_fns(self):
         return [self._stage_bwd(i) for i in range(len(self.stages))]
 
+    # -- chunked-scan stage programs ----------------------------------------
+    #
+    # One jitted lax.scan per (stage, chunk) instead of one program per
+    # (stage, microbatch): the scan body is EXACTLY the per-microbatch
+    # program, state/gradient/metric accumulation threads through the
+    # carry in microbatch order, so numerics are bit-identical to the
+    # chunk=1 event loop (pinned by tests/test_pipeline_chunk.py).
+
+    def _stage_fwd_chunk(self, si: int):
+        """(params, state, stacked_inputs) -> (stacked_outs,
+        stacked_prestates, new_state).  ``stacked_inputs`` carries a
+        leading chunk dim; the scan threads stage state (BN stats,
+        dropout RNG) through the microbatches in order and emits each
+        microbatch's PRE-forward state for the backward's remat."""
+        ex, st = self.stage_ex[si], self.stages[si]
+
+        def fwd(params, state, stacked):
+            def body(s, xs):
+                _, _, new_s, env = ex.forward(params, s, xs, training=True)
+                outs = {n: env[n] for n in st.out_names}
+                return new_s, (outs, s)
+
+            new_state, (outs, prestates) = jax.lax.scan(body, state, stacked)
+            return outs, prestates, new_state
+
+        return jax.jit(fwd)
+
+    def _stage_bwd_chunk(self, si: int):
+        """(params, prestates, stacked_inputs, stacked_douts, dloss,
+        grads_acc, metrics_acc) -> (grads, metrics, stacked_dxs).
+
+        The scan carries the RUNNING per-stage gradient sum (and, for
+        the last stage, the running metrics sum): the caller passes the
+        accumulated value from the previous chunk (zeros for the
+        first), so cross-chunk accumulation order is microbatch order —
+        the bit-identity-across-``c`` invariant.  ``metrics_acc=None``
+        (every stage but the last) drops metrics from the carry."""
+        ex, st = self.stage_ex[si], self.stages[si]
+        diffable = self._diffable_inputs(si)
+
+        def bwd(params, prestates, inputs, douts, dloss, grads_acc,
+                metrics_acc):
+            const_in = {k: v for k, v in inputs.items() if k not in diffable}
+            xs_in = {k: v for k, v in inputs.items() if k in diffable}
+
+            def body(carry, per_mb):
+                s, const, xs, dd = per_mb
+
+                def f(p, x):
+                    loss, metrics, new_state, env = ex.forward(
+                        p, s, {**const, **x}, training=True
+                    )
+                    outs = {n: env[n] for n in st.out_names}
+                    return (outs, loss), (metrics, new_state)
+
+                (_, _), vjp, (metrics, _) = jax.vjp(
+                    f, params, xs, has_aux=True
+                )
+                dparams, dxs = vjp((dd, dloss))
+                if metrics_acc is None:
+                    g = jax.tree.map(jnp.add, carry, dparams)
+                    return g, dxs
+                g, macc = carry
+                g = jax.tree.map(jnp.add, g, dparams)
+                macc = {k: macc[k] + metrics[k] for k in macc}
+                return (g, macc), dxs
+
+            init = (
+                grads_acc if metrics_acc is None
+                else (grads_acc, metrics_acc)
+            )
+            carry, dxs = jax.lax.scan(
+                body, init, (prestates, const_in, xs_in, douts)
+            )
+            if metrics_acc is None:
+                return carry, None, dxs
+            g, macc = carry
+            return g, macc, dxs
+
+        return jax.jit(bwd)
+
+    @functools.cached_property
+    def _fwd_chunk_fns(self):
+        return [self._stage_fwd_chunk(i) for i in range(len(self.stages))]
+
+    @functools.cached_property
+    def _bwd_chunk_fns(self):
+        return [self._stage_bwd_chunk(i) for i in range(len(self.stages))]
+
+    def _zero_grads(self, si: int, params_si):
+        """Cached zero gradient tree for stage ``si`` — the first
+        chunk's carry init.  NEVER donated (the same buffers seed every
+        step); adding 0 to the first microbatch's gradient is bit-exact
+        (the chunk=1 path starts from the gradient itself)."""
+        z = self._zero_grads_cache.get(si)
+        if z is None:
+            z = self._zero_grads_cache[si] = jax.jit(
+                lambda p: jax.tree.map(jnp.zeros_like, p)
+            )(params_si)
+        return z
+
+    def _zero_metrics(self, si: int, params_si, prestates, inputs):
+        """Cached zero metrics tree (last stage only): structure from
+        an eval_shape of the stage forward at microbatch shapes — no
+        device compute, computed once."""
+        z = self._zero_metrics_cache.get(si)
+        if z is None:
+            elem = lambda v: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+            p_avals = jax.tree.map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params_si
+            )
+            s_avals = jax.tree.map(elem, prestates)
+            x_avals = jax.tree.map(elem, inputs)
+
+            def f(p, s, xs):
+                _, metrics, _, _ = self.stage_ex[si].forward(
+                    p, s, xs, training=True
+                )
+                return metrics
+
+            m_avals = jax.eval_shape(f, p_avals, s_avals, x_avals)
+            z = self._zero_metrics_cache[si] = {
+                k: jnp.zeros(a.shape, a.dtype) for k, a in m_avals.items()
+            }
+        return z
+
     @functools.cached_property
     def _grad_sq_fns(self):
         def make(si):
@@ -409,14 +567,38 @@ class PipelineExecutor:
         sh = self._in_shardings[si]
         return jax.device_put(values, {n: sh[n] for n in values})
 
+    @staticmethod
+    def _stacked(sh: NamedSharding) -> NamedSharding:
+        """The same sharding under an unsharded leading chunk dim."""
+        return NamedSharding(sh.mesh, PartitionSpec(None, *sh.spec))
+
+    @functools.cached_property
+    def _chunk_in_shardings(self) -> List[Dict[str, Any]]:
+        """Per-stage input shardings with the leading chunk dim
+        unsharded — the chunked analogue of ``_in_shardings``."""
+        return [
+            {n: self._stacked(sh) for n, sh in per_stage.items()}
+            for per_stage in self._in_shardings
+        ]
+
+    def _put_stage_many_chunk(self, si: int, values: Dict[str, Any]):
+        sh = self._chunk_in_shardings[si]
+        return jax.device_put(values, {n: sh[n] for n in values})
+
     def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
-        """Graph inputs land on the stage that consumes them."""
+        """Graph inputs land on the stage that consumes them — one
+        batched ``device_put`` per stage (dispatch cost is per call,
+        not per array, the round-5 train_step fix)."""
+        graph_inputs = {t.name for t in self.model.input_tensors}
         out = dict(batch)
         for si, st in enumerate(self.stages):
-            graph_inputs = {t.name for t in self.model.input_tensors}
-            for n in st.in_names:
-                if n in graph_inputs and n in batch:
-                    out[n] = self._put_stage(si, n, batch[n])
+            vals = {
+                n: batch[n]
+                for n in st.in_names
+                if n in graph_inputs and n in batch
+            }
+            if vals:
+                out.update(self._put_stage_many(si, vals))
         return out
 
     # -- the step -----------------------------------------------------------
@@ -435,7 +617,11 @@ class PipelineExecutor:
         return outs
 
     def build_schedule(self, S: int, m: int) -> List[Tuple[str, int, int]]:
-        """Dispatch-order event list ``("F"|"B", stage, microbatch)``.
+        """Dispatch-order event list ``("F"|"B", stage, unit)`` where a
+        unit is a microbatch (``chunk=1``) or a chunk of stacked
+        microbatches (``train_step`` passes ``ceil(m/c)`` units) — one
+        event == one host program either way, so ``len(...)`` audits
+        the per-step dispatch count.
 
         ``gpipe``: all forwards (fill), then all backwards (drain) —
         every microbatch's activations live simultaneously.
@@ -489,27 +675,82 @@ class PipelineExecutor:
             done.update(fired)
         return events
 
-    def _zero_dout(self, si: int, name: str, y):
+    def _zero_dout(self, si: int, name: str, y, stacked: bool = False):
         """Cached zero cotangent for an output with no downstream
         gradient — identical every microbatch and step, so one device
-        buffer serves all of them (never donated)."""
-        key = (si, name, tuple(y.shape), str(y.dtype))
+        buffer serves all of them (never donated).  ``stacked`` keys a
+        chunk-shaped buffer (leading chunk dim unsharded)."""
+        key = (si, name, tuple(y.shape), str(y.dtype), stacked)
         z = self._zero_douts.get(key)
         if z is None:
+            sh = self.stage_ex[si].output_sharding(
+                self._producer[name], self._spec_of[name]
+            )
+            if stacked:
+                sh = self._stacked(sh)
             z = self._zero_douts[key] = jax.device_put(
-                jnp.zeros(y.shape, y.dtype),
-                self.stage_ex[si].output_sharding(
-                    self._producer[name], self._spec_of[name]
-                ),
+                jnp.zeros(y.shape, y.dtype), sh
             )
         return z
+
+    def _collect_douts(self, si: int, dout_acc: Dict[str, List[Any]],
+                       boundary_u: Dict[str, Any], stacked: bool):
+        """Assemble the backward's output cotangents for one unit
+        (microbatch, or chunk when ``stacked``): sum the downstream
+        contributions on the producer's mesh — a skip connection
+        consumed by several later stages contributes several — or use
+        the cached zero cotangent (shape from the actual unit value,
+        not the full-batch spec).  Consumed here: every later stage's
+        backward (the only writers) already fired, so drop the
+        cotangent list AND this output's activation — without this,
+        peak memory scales with m and the 1F1B bound is fiction (all
+        of a unit's forwards precede its first backward, so no later
+        event reads the activation)."""
+        ex, st = self.stage_ex[si], self.stages[si]
+        douts = {}
+        for n in st.out_names:
+            contribs = dout_acc.pop(n, None)
+            if contribs:
+                sh = ex.output_sharding(self._producer[n], self._spec_of[n])
+                if stacked:
+                    sh = self._stacked(sh)
+                parts = [jax.device_put(g, sh) for g in contribs]
+                total = parts[0]
+                for p in parts[1:]:
+                    total = total + p
+                douts[n] = total
+            else:
+                douts[n] = self._zero_dout(si, n, boundary_u[n],
+                                           stacked=stacked)
+            boundary_u.pop(n, None)
+        return douts
 
     def train_step(self, params, opt_state, state, batch):
         """One optimizer step: microbatched pipelined fwd+bwd, grads
         meaned over microbatches, per-stage optimizer updates.  Stage
         programs dispatch in ``build_schedule`` order (1F1B by
-        default); numerics are schedule-invariant — per-stage gradient
-        accumulation still runs in microbatch order."""
+        default); numerics are schedule-invariant AND chunk-invariant —
+        per-stage gradient accumulation always runs in microbatch
+        order.  With ``clip_norm == 0`` the step is FENCE-FREE (no
+        ``device_get``), which is what lets ``Trainer.fit`` amortize
+        the host fence over ``steps_per_call`` pipeline steps; with
+        ``clip_norm > 0`` one batched fence per step remains (the
+        global norm couples all stages host-side — the documented
+        one-fence-per-step floor)."""
+        if self.chunk > 1:
+            grads, stage_state, metrics_acc = self._run_chunked(
+                params, state, batch
+            )
+        else:
+            grads, stage_state, metrics_acc = self._run_microbatched(
+                params, state, batch
+            )
+        return self._finish_step(params, opt_state, stage_state, grads,
+                                 metrics_acc)
+
+    def _run_microbatched(self, params, state, batch):
+        """The chunk=1 event loop: one fwd/bwd program per (stage,
+        microbatch) event."""
         m = self.microbatches
         S = len(self.stages)
         micros = self._split_micro(batch, m)
@@ -552,32 +793,8 @@ class PipelineExecutor:
                 stage_state[si] = new_state
                 boundary[mi].update(outs)
                 continue
-            ex = self.stage_ex[si]
-            douts = {}
-            for n in st.out_names:
-                # Consumed here: every later stage's backward (the only
-                # writers) already fired, so drop the cotangent list
-                # and this output's activation — without this, peak
-                # memory scales with m and the 1F1B bound is fiction.
-                contribs = dout_back[mi].pop(n, None)
-                if contribs:
-                    sh = ex.output_sharding(
-                        self._producer[n], self._spec_of[n]
-                    )
-                    parts = [jax.device_put(g, sh) for g in contribs]
-                    total = parts[0]
-                    for p in parts[1:]:
-                        total = total + p
-                    douts[n] = total
-                else:
-                    # Output unused downstream-gradient-wise; shape
-                    # from the actual microbatch value, not the
-                    # declared (full-batch) spec.
-                    douts[n] = self._zero_dout(si, n, boundary[mi][n])
-                # All of microbatch mi's forwards precede its first
-                # backward (F(sj,mi) < B(sj,mi) <= B(si,mi)), so no
-                # later event reads this activation.
-                boundary[mi].pop(n, None)
+            douts = self._collect_douts(si, dout_back[mi], boundary[mi],
+                                        stacked=False)
             dparams, dxs, mets, _ = self._bwd_fns[si](
                 params[si], fwd_state[mi][si], stage_inputs[mi][si],
                 douts, dloss_seed,
@@ -596,17 +813,107 @@ class PipelineExecutor:
                 metrics_acc = _merge_metrics(metrics_acc, {
                     k: v for k, v in mets.items()
                 })
+        return grads, stage_state, metrics_acc
 
+    def _chunk_plan(self, m: int, c: int) -> List[int]:
+        """Chunk lengths covering ``m`` microbatches: ``ceil(m/c)``
+        chunks of ``c``, the last possibly shorter."""
+        n = -(-m // c)
+        return [min(c, m - ci * c) for ci in range(n)]
+
+    def _chunk_slice(self, v, ci: int, m: int, c: int, length: int):
+        """Microbatches ``[ci*c, ci*c+length)`` of a full-batch tensor,
+        stacked ``(length, mb, ...)``."""
+        assert v.shape[0] % m == 0, (v.shape, m)  # _split_micro's contract
+        sz = v.shape[0] // m
+        lo = ci * c * sz
+        return v[lo:lo + length * sz].reshape(
+            (length, sz) + tuple(v.shape[1:])
+        )
+
+    def _run_chunked(self, params, state, batch):
+        """The chunked-scan event loop: one fwd/bwd *scan* program per
+        (stage, chunk) event — ``2*S*ceil(m/c)`` host programs per
+        step.  Cross-chunk gradient/metric accumulation threads the
+        previous chunk's sums into the next scan's carry, so the
+        summation order is microbatch order — bit-identical to
+        ``_run_microbatched``."""
+        m, c = self.microbatches, self.chunk
+        S = len(self.stages)
+        lengths = self._chunk_plan(m, c)
+        n_chunks = len(lengths)
+        graph_inputs = {t.name for t in self.model.input_tensors}
+
+        stage_state = dict(state)
+        stage_inputs: List[List[Any]] = [[None] * S for _ in range(n_chunks)]
+        pre_states: List[List[Any]] = [[None] * S for _ in range(n_chunks)]
+        boundary: List[Dict[str, Any]] = [dict() for _ in range(n_chunks)]
+        dout_back: List[Dict[str, List[Any]]] = [dict() for _ in range(n_chunks)]
+        dloss_seed = jnp.float32(1.0 / m)
+        grads = {si: None for si in range(S)}
+        metrics_acc = None
+
+        events = self.build_schedule(S, n_chunks)
+        self.last_schedule = events
+        for kind, si, ci in events:
+            st = self.stages[si]
+            if kind == "F":
+                vals = {
+                    n: (self._chunk_slice(batch[n], ci, m, c, lengths[ci])
+                        if n in graph_inputs else boundary[ci][n])
+                    for n in st.in_names
+                }
+                inputs = self._put_stage_many_chunk(si, vals)
+                stage_inputs[ci][si] = inputs
+                outs, pres, new_state = self._fwd_chunk_fns[si](
+                    params[si], stage_state[si], inputs
+                )
+                pre_states[ci][si] = pres
+                stage_state[si] = new_state
+                boundary[ci].update(outs)
+                continue
+            douts = self._collect_douts(si, dout_back[ci], boundary[ci],
+                                        stacked=True)
+            g_acc = (grads[si] if grads[si] is not None
+                     else self._zero_grads(si, params[si]))
+            m_acc = None
+            if si == S - 1:
+                m_acc = (metrics_acc if metrics_acc is not None
+                         else self._zero_metrics(
+                             si, params[si], pre_states[ci][si],
+                             stage_inputs[ci][si]))
+            g, mets, dxs = self._bwd_chunk_fns[si](
+                params[si], pre_states[ci][si], stage_inputs[ci][si],
+                douts, dloss_seed, g_acc, m_acc,
+            )
+            grads[si] = g
+            if si == S - 1:
+                metrics_acc = mets
+            # Release the remat inputs/states this backward consumed.
+            stage_inputs[ci][si] = None
+            pre_states[ci][si] = None
+            for n, gx in dxs.items():
+                dout_back[ci].setdefault(n, []).append(gx)
+        return grads, stage_state, metrics_acc or {}
+
+    def _finish_step(self, params, opt_state, stage_state, grads,
+                     metrics_acc):
+        """Shared step tail: global clip-norm (ONE batched fence), the
+        per-stage optimizer updates, and count-aware metric means."""
+        m = self.microbatches
+        S = len(self.stages)
         # --clip-norm: the global L2 norm spans ALL stages' gradients;
         # per-stage squared norms combine on the host (the pipeline
         # step is host-orchestrated anyway), then each stage scales —
         # numerically identical to Executor._clip_grads, keeping the
-        # DP≡strategy invariant under layer-wise placement.
+        # DP≡strategy invariant under layer-wise placement.  The fetch
+        # is ONE device_get of all S squared norms (each separate fetch
+        # is a ~1.5-16 ms round-trip through the relay).
         if self.config.clip_norm > 0.0:
-            total = sum(
-                float(jax.device_get(self._grad_sq_fns[si](grads[si])))
-                for si in range(S)
+            sqs = jax.device_get(
+                [self._grad_sq_fns[si](grads[si]) for si in range(S)]
             )
+            total = sum(float(x) for x in sqs)
             c = self.config.clip_norm
             scale = min(1.0, c / max(total ** 0.5, 1e-15))
             if scale < 1.0:
@@ -620,10 +927,7 @@ class PipelineExecutor:
             new_params[si], new_opt[si] = self._opt_fns[si](
                 params[si], opt_state[si], grads[si]
             )
-        m_out = {
-            k: v if jnp.issubdtype(v.dtype, jnp.integer) else v / m
-            for k, v in metrics_acc.items()
-        }
+        m_out = mean_metrics(metrics_acc, count=m)
         return new_params, new_opt, stage_state, m_out
 
     # -- compute-free mode ---------------------------------------------------
@@ -754,9 +1058,11 @@ def make_executor(
         if any(len(set(ids)) < nd for ids in subsets):
             mb = kwargs.pop("microbatches", 1)
             sched = kwargs.pop("schedule", "1f1b")
+            chunk = kwargs.pop("chunk", 1)
             kwargs.pop("mesh_plan", None)
             return PipelineExecutor(
-                model, strategy, microbatches=mb, schedule=sched, **kwargs
+                model, strategy, microbatches=mb, schedule=sched,
+                chunk=chunk, **kwargs
             )
         _log.warning(
             "strategy device_ids span the full mesh; explicit ordering is "
@@ -764,4 +1070,5 @@ def make_executor(
         )
     kwargs.pop("microbatches", None)
     kwargs.pop("schedule", None)
+    kwargs.pop("chunk", None)
     return Executor(model, strategy=strategy, **kwargs)

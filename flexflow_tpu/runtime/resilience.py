@@ -365,6 +365,16 @@ class ResilientTrainer:
         # clamp it to the same cap.
         check_every = min(check_every or save_every or 1, MAX_STEPS_PER_CALL)
         ex = self.executor_factory()
+        if k > 1 and not hasattr(ex, "build_superstep"):
+            # Layer-wise (pipeline) executors have no fused superstep;
+            # the k=1 path composes fully (per-stage {si: ...} trees
+            # checkpoint/restore through orbax like any pytree).
+            raise ValueError(
+                "steps_per_call > 1 in ResilientTrainer requires the "
+                "full-mesh Executor (build_superstep); layer-wise "
+                "(device-subset) strategies compose with resilience at "
+                "steps_per_call=1"
+            )
         step, params, opt_state, state = self._fresh_state(ex, seed)
         if step >= iterations:
             # A restarted job whose checkpoint already reached the

@@ -19,6 +19,7 @@ import numpy as np
 
 from flexflow_tpu.metrics import PerfMetrics
 from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.pipeline import PipelineExecutor
 
 _log = logging.getLogger("ff.trainer")
 
@@ -44,6 +45,36 @@ class Trainer:
         """Device-resident synthetic inputs (reference: syntheticInput,
         ``config.h:73``; DLRM loads random data once, ``dlrm.cc:144-150``)."""
         return self.ex.shard_batch(self._synthetic_host_batch(seed))
+
+    def _batch_source(self, batches, total: int, prefetch: int):
+        """Per-step batch plumbing shared by :meth:`fit` and the
+        pipeline superstep loop: a fixed synthetic batch when
+        ``batches`` is None (infinite), a caller-owned
+        ``PrefetchLoader`` as-is (already device-placing), otherwise an
+        owned ``PrefetchLoader`` — bounded to exactly the ``total``
+        batches this run consumes, so the worker never pulls ahead
+        past the run and a caller-reused iterator loses nothing (the
+        synchronous path's contract) — or, with ``prefetch=0``, a
+        synchronous ``shard_batch`` generator.  Returns
+        ``(iterator, owned_prefetch_or_None)``; the caller closes the
+        owned loader."""
+        from flexflow_tpu.data.loader import PrefetchLoader
+
+        ex = self.ex
+        if batches is None:
+            fixed = self.synthetic_batch()
+            return iter(lambda: fixed, None), None
+        if isinstance(batches, PrefetchLoader):
+            return batches, None
+        if prefetch > 0:
+            import itertools
+
+            owned = PrefetchLoader(
+                itertools.islice(iter(batches), total),
+                ex.shard_batch, depth=prefetch,
+            )
+            return owned, owned
+        return (ex.shard_batch(b) for b in iter(batches)), None
 
     def fit(
         self,
@@ -80,6 +111,16 @@ class Trainer:
         ``save_every`` steps plus once at the end — the crash-recovery
         subsystem the reference lacks entirely (SURVEY.md §5)."""
         if steps_per_call > 1:
+            if isinstance(self.ex, PipelineExecutor):
+                # Layer-wise strategies cannot FUSE k steps into one
+                # scan (per-stage host dispatch), but the host fence
+                # amortizes the same way: k steps dispatch back-to-back
+                # with ONE device_get per superstep.
+                return self._fit_superstep_pipeline(
+                    iterations, batches, warmup, log_every, checkpoint,
+                    save_every, resume, accum_steps, prefetch,
+                    steps_per_call,
+                )
             return self._fit_superstep(
                 iterations, batches, warmup, log_every, checkpoint,
                 save_every, resume, accum_steps, prefetch, steps_per_call,
@@ -100,30 +141,9 @@ class Trainer:
                     templates=(params, opt_state, state)
                 )
                 print(f"resumed from step {start_step}")
-        from flexflow_tpu.data.loader import PrefetchLoader
-
-        owned_prefetch = None
-        if batches is None:
-            fixed = self.synthetic_batch()
-            batches = iter(lambda: fixed, None)  # infinite
-        elif isinstance(batches, PrefetchLoader):
-            pass  # caller-owned prefetch; already device-placing
-        elif prefetch > 0:
-            # Bounded to exactly the batches this run consumes, so the
-            # worker never pulls ahead past the run and a caller-reused
-            # iterator loses nothing (the synchronous path's contract).
-            import itertools
-
-            owned_prefetch = PrefetchLoader(
-                itertools.islice(iter(batches), warmup + iterations),
-                ex.shard_batch, depth=prefetch,
-            )
-            batches = owned_prefetch
-        else:
-            raw = iter(batches)
-            # Place each host batch in its consumers' shardings (no-op
-            # for already-placed arrays) — the ZC-memory gather path.
-            batches = (ex.shard_batch(b) for b in raw)
+        batches, owned_prefetch = self._batch_source(
+            batches, warmup + iterations, prefetch
+        )
 
         # Preemption (SIGTERM/SIGINT) with a checkpoint attached: finish
         # the in-flight step, save at the boundary, exit cleanly so a
@@ -436,6 +456,174 @@ class Trainer:
                 "loss": float(self.metrics.avg_loss),
                 "steps_per_call": k,
                 "supersteps": len(timed),
+            }
+            if preempt.triggered:
+                stats["preempted"] = True
+                stats["checkpoint_step"] = start_step + steps_done
+            return stats
+        finally:
+            preempt.__exit__(None, None, None)
+            if owned_prefetch is not None:
+                owned_prefetch.close()
+
+    def _fit_superstep_pipeline(
+        self,
+        iterations: int,
+        batches,
+        warmup: int,
+        log_every: int,
+        checkpoint,
+        save_every: int,
+        resume: bool,
+        accum_steps: int,
+        prefetch: int,
+        k: int,
+    ) -> Dict[str, float]:
+        """Fence-amortized supersteps over the layer-wise pipeline.
+
+        The full-mesh superstep fuses K steps into ONE compiled scan;
+        the pipeline's step is host-orchestrated per-stage dispatch and
+        cannot fuse (``StrategyStore.superstep_mode() == "amortized"``)
+        — but the HOST FENCE amortizes identically: K ``train_step``
+        dispatches run back-to-back and their per-step metrics come
+        back in ONE ``jax.device_get`` per superstep, which through the
+        axon relay is the ~16 ms round-trip being amortized.  The
+        dependent program chain between fences is ``k`` steps long
+        (each ``2*S*ceil(m/c)`` programs), so the relay-safe cap of
+        ``MAX_STEPS_PER_CALL`` applies unchanged — pair a large ``k``
+        with a pipeline ``chunk`` to keep the chain short.
+
+        Honest limit: with ``clip_norm > 0`` the global-norm fetch
+        inside ``train_step`` is a per-step fence — the floor is one
+        fence per STEP, not per superstep, and a loud warning says so
+        rather than silently serializing.
+
+        Unlike the fused path, warmup needs NO rounding (there is no
+        k-sized compiled program whose compile must stay outside the
+        timed region), so finite ``batches`` keep the k=1 contract:
+        ``warmup + iterations`` batches.
+        """
+        ex = self.ex
+        assert iterations > 0, "fit() needs at least one iteration"
+        if accum_steps > 1:
+            raise ValueError(
+                "accum_steps composes with full-mesh strategies only; "
+                "pipeline strategies microbatch via microbatches="
+            )
+        if k > MAX_STEPS_PER_CALL:
+            _log.warning(
+                "steps_per_call=%d exceeds the relay-safe fence cap; "
+                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
+                k, MAX_STEPS_PER_CALL,
+            )
+            k = MAX_STEPS_PER_CALL
+        if ex.config.clip_norm > 0.0:
+            _log.warning(
+                "steps_per_call=%d with clip_norm=%g: the global-norm "
+                "fetch is a per-step fence, so dispatch amortizes but "
+                "the fence does not (one-fence-per-step floor)",
+                k, ex.config.clip_norm,
+            )
+        params, opt_state, state = ex.init()
+        start_step = 0
+        if checkpoint is not None and resume:
+            if checkpoint.latest_step() is not None:
+                start_step, params, opt_state, state = checkpoint.restore(
+                    templates=(params, opt_state, state)
+                )
+                print(f"resumed from step {start_step}")
+
+        batches, owned_prefetch = self._batch_source(
+            batches, warmup + iterations, prefetch
+        )
+
+        from flexflow_tpu.runtime.resilience import PreemptionHandler
+
+        preempt = PreemptionHandler(install=checkpoint is not None).__enter__()
+        try:
+            m = None
+            for _ in range(warmup):
+                batch = next(batches)
+                params, opt_state, state, m = ex.train_step(
+                    params, opt_state, state, batch
+                )
+            start_step += warmup
+            if m is not None:
+                jax.device_get(m)  # fence: compiles outside the timed loop
+
+            trace_ctx = contextlib.nullcontext()
+            if ex.config.trace_dir:
+                from flexflow_tpu.runtime.profiler import trace
+
+                trace_ctx = trace(ex.config.trace_dir)
+            ckpt_s = 0.0
+            steps_done = 0
+            supersteps = 0
+            with trace_ctx:
+                start = time.perf_counter()
+                while steps_done < iterations:
+                    n = min(k, iterations - steps_done)
+                    ms = []
+                    for _ in range(n):
+                        batch = next(batches)
+                        params, opt_state, state, m = ex.train_step(
+                            params, opt_state, state, batch
+                        )
+                        ms.append(m)
+                    # ONE host readback per superstep: all n steps'
+                    # metrics — the fence AND the amortization.
+                    host_ms = jax.device_get(ms)
+                    supersteps += 1
+                    # Read the preemption flag AFTER the fence, so a
+                    # signal landing mid-superstep still exits at THIS
+                    # boundary.
+                    trig = preempt.triggered
+                    for hm in host_ms:
+                        self.metrics.update(hm)
+                        steps_done += 1
+                        if log_every and steps_done % log_every == 0:
+                            print(f"iter {steps_done}: "
+                                  f"{self.metrics.report()}")
+                    if (
+                        checkpoint is not None and save_every
+                        and steps_done // save_every
+                        > (steps_done - n) // save_every
+                    ):
+                        t0 = time.perf_counter()
+                        checkpoint.save(
+                            start_step + steps_done, params, opt_state,
+                            state,
+                        )
+                        ckpt_s += time.perf_counter() - t0
+                    if trig:
+                        break  # emergency save at this boundary
+                elapsed = time.perf_counter() - start - ckpt_s
+
+            if checkpoint is not None:
+                checkpoint.save(
+                    start_step + steps_done, params, opt_state, state
+                )
+                if hasattr(checkpoint, "wait_until_finished"):
+                    checkpoint.wait_until_finished()
+                if preempt.triggered:
+                    print(f"preempted: emergency checkpoint at step "
+                          f"{start_step + steps_done}, exiting cleanly")
+            if ex.config.profiling:
+                print("profiling: per-op breakdown unavailable for "
+                      "pipeline executors")
+            batch_size = ex.model.input_tensors[0].shape[0]
+            throughput = steps_done * batch_size / elapsed
+            print(f"time = {elapsed:.4f}s")
+            print(f"tp = {throughput:.2f} samples/s")
+            self.final = (params, opt_state, state)
+            stats = {
+                "elapsed_s": elapsed,
+                "samples_per_s": throughput,
+                "iterations": steps_done,
+                "batch_size": batch_size,
+                "loss": float(self.metrics.avg_loss),
+                "steps_per_call": k,
+                "supersteps": supersteps,
             }
             if preempt.triggered:
                 stats["preempted"] = True

@@ -20,6 +20,13 @@ import jax  # noqa: E402
 # jax_platforms at import; override it back before any backend init.
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: do NOT wire jax's persistent compilation cache
+# (jax_compilation_cache_dir) into this suite to speed up the one-core
+# box: with min_compile_time 0 the XLA:CPU executable deserializer
+# SEGFAULTS deterministically in the orbax-heavy checkpoint tests
+# (jax 0.4.37), and with a safe 1.0s threshold the warm-run saving is
+# ~10% — not worth the crash surface.  Measured 2026-08-04 (ISSUE 3).
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

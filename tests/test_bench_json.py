@@ -59,6 +59,15 @@ def stubbed_bench(monkeypatch):
         lambda n, t: chatty({"k1_ms_per_step": 2.0, "k8_ms_per_step": 1.0}),
     )
     monkeypatch.setattr(
+        bench, "bench_pipeline",
+        lambda n, t: chatty({
+            "s2_mb4_c1_ms_per_step": 4.0, "s2_mb4_c1_programs": 16,
+            "s2_mb4_c4_ms_per_step": 2.0, "s2_mb4_c4_programs": 4,
+            "chunk_amortization": 2.0,
+            "superstep_k8_ms_per_step": 1.5,
+        }),
+    )
+    monkeypatch.setattr(
         bench, "bench_op_parallel_speedup",
         lambda n: {"op_parallel_speedup_sim": 1.5},
     )
@@ -77,6 +86,14 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert record["metric"] == "alexnet_imgs_per_sec_per_chip"
     assert record["value"] == 100.0
     assert record["extra"]["superstep"]["k8_ms_per_step"] == 1.0
+    # The pipeline leg's schema: per-config ms/step + last_schedule
+    # program counts (the 2*S*m -> 2*S*ceil(m/c) dispatch audit) and
+    # the chunk/superstep amortization headlines.
+    pipe = record["extra"]["pipeline"]
+    assert pipe["s2_mb4_c1_programs"] == 16
+    assert pipe["s2_mb4_c4_programs"] == 4
+    assert pipe["chunk_amortization"] == 2.0
+    assert pipe["superstep_k8_ms_per_step"] == 1.5
     # The chatter landed on stderr, not stdout.
     assert "tp = " in err.getvalue()
 
@@ -88,6 +105,7 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
 
     monkeypatch.setattr(stubbed_bench, "bench_dlrm", boom)
     monkeypatch.setattr(stubbed_bench, "bench_superstep", boom)
+    monkeypatch.setattr(stubbed_bench, "bench_pipeline", boom)
     out, err = io.StringIO(), io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     monkeypatch.setattr(sys, "stderr", err)
@@ -97,3 +115,4 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     record = json.loads(lines[0])
     assert "leg exploded" in record["extra"]["dlrm_error"]
     assert "leg exploded" in record["extra"]["superstep_error"]
+    assert "leg exploded" in record["extra"]["pipeline_error"]

@@ -393,3 +393,63 @@ def test_dropout_rng_state_resumes_exactly(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(s4["drop"]["rng"]), np.asarray(sr4["drop"]["rng"])
     )
+
+
+class TestPipelineCheckpoint:
+    """Per-stage {si: params}/{si: opt_state} trees through the manager
+    (ISSUE 3): layer-wise executors checkpoint like any pytree."""
+
+    _shared = None
+
+    def _pipe(self, fresh=False):
+        from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+        from flexflow_tpu.runtime.pipeline import PipelineExecutor
+
+        if not fresh and type(self)._shared is not None:
+            return type(self)._shared  # executors are call-stateless
+        ff = _tiny_model()
+        store = StrategyStore(8)
+        store.set("fc1", ParallelConfig(n=4, device_ids=(0, 1, 2, 3)))
+        for n in ("fc2", "softmax"):
+            store.set(n, ParallelConfig(n=4, device_ids=(4, 5, 6, 7)))
+        pipe = PipelineExecutor(
+            ff, store, optimizer=SGDOptimizer(lr=0.05, momentum=0.9),
+            microbatches=2, chunk=2,
+        )
+        if not fresh:
+            type(self)._shared = pipe
+        return pipe
+
+    def test_restore_then_train_on_matches_uninterrupted(self, tmp_path):
+        """Train 4 pipeline steps straight vs 2 + save + restore into a
+        FRESH executor + 2: identical per-stage params AND momentum."""
+        ex = self._pipe()
+        batches = [_batch(ex, seed=s) for s in range(4)]
+        p, o, s = ex.init(seed=0)
+        p4, o4, s4 = _run_steps(ex, p, o, s, batches)
+
+        ex2 = self._pipe()
+        p, o, s = ex2.init(seed=0)
+        p2, o2, s2 = _run_steps(ex2, p, o, s, batches[:2])
+        with CheckpointManager(str(tmp_path / "ck")) as ck:
+            ck.save(2, p2, o2, s2)
+            ex3 = self._pipe(fresh=True)
+            pr, orr, sr = ex3.init(seed=1)  # different init: restore wins
+            step, pr, orr, sr = ck.restore(templates=(pr, orr, sr))
+        assert step == 2
+        pr4, or4, _ = _run_steps(ex3, pr, orr, sr, batches[2:])
+        _assert_trees_equal(p4, pr4)
+        _assert_trees_equal(o4, or4)  # momentum buffers round-trip
+
+    def test_trainer_fit_saves_and_resumes_pipeline(self, tmp_path):
+        """Trainer.fit(checkpoint=...) on a PipelineExecutor: periodic
+        saves + resume, including through the superstep path."""
+        ex = self._pipe()
+        with CheckpointManager(str(tmp_path / "ck")) as ck:
+            Trainer(ex).fit(iterations=4, warmup=1, save_every=2,
+                            checkpoint=ck, steps_per_call=2)
+            assert ck.latest_step() == 5  # warmup counts as an update
+        with CheckpointManager(str(tmp_path / "ck")) as ck:
+            stats = Trainer(ex).fit(iterations=2, warmup=1,
+                                    checkpoint=ck, steps_per_call=2)
+        assert stats["iterations"] == 2

@@ -1,0 +1,326 @@
+"""Chunked-scan pipeline dispatch + pipeline supersteps (ISSUE 3).
+
+The invariants pinned here extend the superstep family
+(``tests/test_superstep.py``) to the layer-wise runtime:
+
+- **Chunk invariance** — ``chunk=c`` folds each stage's per-microbatch
+  fwd/bwd programs into ONE jitted ``lax.scan`` over ``c`` stacked
+  microbatches; loss AND param trajectories must be BIT-IDENTICAL
+  across ``c`` (the scan carries the running gradient/metric sums, so
+  accumulation order is microbatch order regardless of chunking).
+- **Dispatch accounting** — ``last_schedule`` records one event per
+  host program: ``2*S*ceil(m/c)`` per step, dependency-valid at chunk
+  granularity.
+- **Pipeline supersteps** — ``Trainer.fit(steps_per_call=k)`` on a
+  PipelineExecutor dispatches k steps back-to-back under ONE
+  ``jax.device_get`` fence; trajectories bit-identical to k=1.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime.pipeline import PipelineExecutor
+from flexflow_tpu.runtime.trainer import Trainer
+
+
+def _model(batch=16, dropout=0.0):
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, 12), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    t = ff.dense(x, 16, activation="relu", name="enc0")
+    t = ff.dense(t, 16, activation="relu", name="enc1")
+    if dropout > 0.0:
+        t = ff.dropout(t, rate=dropout, name="drop")
+    t = ff.dense(t, 16, activation="relu", name="dec0")
+    t = ff.dense(t, 4, activation=None, name="dec1")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+def _store(nd=8, with_dropout=False):
+    enc = tuple(range(nd // 2))
+    dec = tuple(range(nd // 2, nd))
+    store = StrategyStore(nd)
+    for n in ("enc0", "enc1"):
+        store.set(n, ParallelConfig(n=len(enc), device_ids=enc))
+    names = ("drop",) if with_dropout else ()
+    for n in names + ("dec0", "dec1", "softmax"):
+        store.set(n, ParallelConfig(n=len(dec), device_ids=dec))
+    return store
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "x": rng.standard_normal((batch, 12)).astype(np.float32),
+            "label": rng.integers(0, 4, size=(batch,)).astype(np.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+def _pipe_fresh(microbatches=4, chunk=1, schedule="1f1b", clip=0.0,
+                dropout=0.0):
+    cfg = FFConfig(batch_size=16, clip_norm=clip)
+    return PipelineExecutor(
+        _model(dropout=dropout), _store(with_dropout=dropout > 0.0),
+        config=cfg, optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+        microbatches=microbatches, schedule=schedule, chunk=chunk,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _pipe(microbatches=4, chunk=1, schedule="1f1b", clip=0.0, dropout=0.0):
+    """Executors are stateless between train_step calls (params are
+    explicit), so tests sharing a config share its compiled stage
+    programs — the suite runs on one core and compiles dominate."""
+    return _pipe_fresh(microbatches, chunk, schedule, clip, dropout)
+
+
+def _run(pipe, batches):
+    params, opt_state, state = pipe.init(seed=0)
+    losses = []
+    for b in batches:
+        params, opt_state, state, m = pipe.train_step(
+            params, opt_state, state, pipe.shard_batch(b)
+        )
+        losses.append(np.asarray(jax.device_get(m["train_loss"])))
+    return np.array(losses), jax.device_get(params)
+
+
+def _assert_bit_identical(run_a, run_b, msg=""):
+    losses_a, params_a = run_a
+    losses_b, params_b = run_b
+    np.testing.assert_array_equal(losses_a, losses_b, err_msg=msg)
+    for a, b in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_b)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=msg
+        )
+
+
+# -- chunk invariance ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [2, 4])
+def test_chunked_bit_identical_to_event_loop(chunk):
+    """c in {2, m}: trajectories bit-identical to the c=1 per-microbatch
+    event loop (the acceptance-criterion invariant)."""
+    batches = _batches(3)
+    ref = _run(_pipe(chunk=1), batches)
+    got = _run(_pipe(chunk=chunk), batches)
+    _assert_bit_identical(ref, got, f"chunk={chunk}")
+
+
+def test_chunked_nondivisible_tail():
+    """m=4, c=3: chunks of 3+1 microbatches — the short tail chunk is
+    its own compiled scan length and numerics stay bit-identical."""
+    batches = _batches(2)
+    ref = _run(_pipe(chunk=1), batches)
+    got = _run(_pipe(chunk=3), batches)
+    _assert_bit_identical(ref, got, "chunk=3 (non-divisible)")
+
+
+def test_chunked_schedule_invariant():
+    """Chunked numerics are also schedule-invariant (1f1b vs gpipe at
+    chunk granularity)."""
+    batches = _batches(2)
+    _assert_bit_identical(
+        _run(_pipe(chunk=2, schedule="1f1b"), batches),
+        _run(_pipe(chunk=2, schedule="gpipe"), batches),
+    )
+
+
+def test_chunked_clip_norm_bit_identical():
+    """The batched clip-norm fence (ONE device_get of all S squared
+    norms) preserves global-norm clipping numerics across chunking."""
+    batches = _batches(2, seed=3)
+    ref = _run(_pipe(chunk=1, clip=0.5), batches)
+    got = _run(_pipe(chunk=4, clip=0.5), batches)
+    _assert_bit_identical(ref, got, "clip_norm chunked")
+    # And the clip actually engaged (scale < 1 at lr-sized grads).
+    noclip = _run(_pipe(chunk=4), batches)
+    assert not np.array_equal(
+        jax.tree.leaves(ref[1])[0], jax.tree.leaves(noclip[1])[0]
+    )
+
+
+def test_chunked_dropout_rng_chain():
+    """The stacked-prestate remat threads the dropout RNG chain through
+    the scan exactly as the per-microbatch loop does."""
+    batches = _batches(2)
+    ref = _run(_pipe(chunk=1, dropout=0.5), batches)
+    got = _run(_pipe(chunk=2, dropout=0.5), batches)
+    _assert_bit_identical(ref, got, "dropout chunked")
+
+
+def test_chunked_skip_connection(rng):
+    """A stage-0 output consumed by TWO later stages: stacked cotangent
+    contributions sum on the producer's mesh per chunk."""
+    batch = 8
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, 12), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    t0 = ff.dense(x, 8, activation="relu", name="s0")
+    t1 = ff.dense(t0, 8, activation="relu", name="s1")
+    t2 = ff.concat([t0, t1], axis=1, name="s2cat")
+    t3 = ff.dense(t2, 4, activation=None, name="s2fc")
+    ff.softmax(t3, lbl, name="softmax")
+    store = StrategyStore(6)
+    store.set("s0", ParallelConfig(n=2, device_ids=(0, 1)))
+    store.set("s1", ParallelConfig(n=2, device_ids=(2, 3)))
+    for name in ("s2cat", "s2fc", "softmax"):
+        store.set(name, ParallelConfig(n=2, device_ids=(4, 5)))
+    batch_data = {
+        "x": rng.standard_normal((batch, 12)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(batch,)).astype(np.int32),
+    }
+
+    def run(chunk):
+        pipe = PipelineExecutor(
+            ff, store, optimizer=SGDOptimizer(lr=0.1),
+            microbatches=2, chunk=chunk,
+        )
+        p, o, s = pipe.init(seed=0)
+        p2, _, _, m = pipe.train_step(p, o, s, pipe.shard_batch(batch_data))
+        return np.array(jax.device_get(m["train_loss"])), jax.device_get(p2)
+
+    _assert_bit_identical(run(1), run(2), "skip connection chunked")
+
+
+# -- dispatch accounting ------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk,n_units", [(1, 4), (2, 2), (3, 2), (4, 1)])
+def test_chunk_cuts_programs_per_step(chunk, n_units):
+    """last_schedule records one event per host program: 2*S*ceil(m/c),
+    dependency-valid at chunk granularity."""
+    pipe = _pipe(microbatches=4, chunk=chunk)
+    params, opt_state, state = pipe.init(seed=0)
+    pipe.train_step(params, opt_state, state,
+                    pipe.shard_batch(_batches(1)[0]))
+    S = len(pipe.stages)
+    ev = pipe.last_schedule
+    assert len(ev) == 2 * S * n_units, (chunk, ev)
+    assert ev == pipe.build_schedule(S, n_units)
+    pos = {e: i for i, e in enumerate(ev)}
+    for kind, si, ci in ev:
+        if kind == "F" and si > 0:
+            assert pos[("F", si - 1, ci)] < pos[("F", si, ci)]
+        if kind == "B":
+            assert pos[("F", si, ci)] < pos[("B", si, ci)]
+            if si < S - 1:
+                assert pos[("B", si + 1, ci)] < pos[("B", si, ci)]
+
+
+def test_chunk_clamped_to_microbatches(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="ff.pipeline"):
+        pipe = _pipe_fresh(microbatches=2, chunk=8)
+    assert pipe.chunk == 2
+    assert any("clamping" in r.message for r in caplog.records)
+    with pytest.raises(ValueError, match="chunk"):
+        _pipe_fresh(chunk=0)
+
+
+# -- pipeline supersteps ------------------------------------------------------
+
+
+def test_pipeline_superstep_bit_identical():
+    """k pipeline steps under ONE fence: loss/param trajectories
+    bit-identical to steps_per_call=1, for c=1 and c=m."""
+    n_steps, k = 6, 3
+    batches = _batches(n_steps + 1)  # +1 warmup
+
+    def fit(steps_per_call, chunk):
+        pipe = _pipe(chunk=chunk)
+        tr = Trainer(pipe)
+        stats = tr.fit(
+            iterations=n_steps, warmup=1, steps_per_call=steps_per_call,
+            batches=iter(batches), prefetch=0,
+        )
+        return stats, jax.device_get(tr.final[0])
+
+    s1, p1 = fit(1, 1)
+    sk, pk = fit(k, 1)
+    skc, pkc = fit(k, 4)
+    assert sk["steps_per_call"] == k and sk["supersteps"] == 2
+    for got in (pk, pkc):
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipeline_superstep_remainder_and_stats():
+    """iterations not divisible by k: the tail superstep is shorter;
+    stats account every step exactly once (no warmup rounding on the
+    pipeline path — there is no k-sized compiled program)."""
+    pipe = _pipe(chunk=4)
+    stats = Trainer(pipe).fit(iterations=5, warmup=2, steps_per_call=2)
+    assert stats["iterations"] == 5
+    assert stats["steps_per_call"] == 2
+    assert stats["supersteps"] == 3  # 2 + 2 + 1
+    assert stats["samples_per_s"] > 0
+
+
+def test_pipeline_superstep_clamps(caplog):
+    import logging
+
+    from flexflow_tpu.runtime.trainer import MAX_STEPS_PER_CALL
+
+    pipe = _pipe(chunk=4)
+    with caplog.at_level(logging.WARNING, logger="ff.trainer"):
+        stats = Trainer(pipe).fit(
+            iterations=2, warmup=0, steps_per_call=MAX_STEPS_PER_CALL + 5,
+        )
+    assert stats["steps_per_call"] == MAX_STEPS_PER_CALL
+    assert any("clamping" in r.message for r in caplog.records)
+
+
+def test_pipeline_superstep_clip_norm_warns_fence_floor(caplog):
+    """clip_norm > 0 keeps a per-step fence (the global norm couples
+    stages host-side): documented honestly with a loud warning, never
+    silently serialized."""
+    import logging
+
+    pipe = _pipe(chunk=4, clip=1.0)
+    with caplog.at_level(logging.WARNING, logger="ff.trainer"):
+        Trainer(pipe).fit(iterations=2, warmup=1, steps_per_call=2)
+    assert any("one-fence-per-step" in r.message for r in caplog.records)
+
+
+def test_pipeline_superstep_accum_refused():
+    pipe = _pipe(chunk=2)
+    with pytest.raises(ValueError, match="accum"):
+        Trainer(pipe).fit(iterations=2, steps_per_call=2, accum_steps=2)
+
+
+# -- CLI / app plumbing -------------------------------------------------------
+
+
+def test_pipeline_chunk_cli():
+    assert FFConfig.parse_args(["--pipeline-chunk", "4"]).pipeline_chunk == 4
+    assert FFConfig.parse_args([]).pipeline_chunk == 1
+    with pytest.raises(SystemExit):
+        FFConfig.parse_args(["--pipeline-chunk", "0"])
+
+
+def test_pipeline_chunk_app_end_to_end():
+    """--pipeline --pipeline-chunk --steps-per-call through the shared
+    app harness (the test_apps nmt --pipeline pattern)."""
+    from flexflow_tpu.apps import nmt
+
+    assert nmt.main([
+        "-b", "16", "-i", "2", "--hidden", "8", "--vocab", "32",
+        "--src-len", "4", "--tgt-len", "4", "--pipeline",
+        "-ll:tpu", "8", "--microbatches", "2", "--pipeline-chunk", "2",
+        "--steps-per-call", "2",
+    ]) == 0

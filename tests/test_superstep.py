@@ -210,20 +210,38 @@ def test_trainer_clamps_steps_per_call(caplog):
     assert any("clamping" in r.message for r in caplog.records)
 
 
-def test_superstep_refuses_pipeline_strategies():
-    """Layer-wise (device-subset) strategies dispatch per-stage
-    programs; superstep execution must refuse loudly (the
-    test_zero_opt rejection-path pattern)."""
-    from flexflow_tpu.runtime.pipeline import make_executor
+def test_superstep_pipeline_strategies_amortize():
+    """Layer-wise (device-subset) strategies cannot FUSE k steps into
+    one scan (``superstep_mode() == "amortized"``, ``build_superstep``
+    unavailable), but ``Trainer.fit(steps_per_call=k)`` now runs them
+    through the fence-amortized pipeline superstep path instead of
+    refusing: k per-stage-dispatched steps share ONE ``device_get``."""
+    from flexflow_tpu.runtime.pipeline import PipelineExecutor, make_executor
 
     ff = _model(batch=8)
     st = StrategyStore(8)
     st.set("fc1", ParallelConfig(n=4, device_ids=(0, 1, 2, 3)))
     st.set("fc2", ParallelConfig(n=4, device_ids=(4, 5, 6, 7)))
     assert not st.superstep_capable()
+    assert st.superstep_mode() == "amortized"
     ex = make_executor(ff, st, devices=jax.devices()[:8])
-    with pytest.raises(ValueError, match="steps_per_call"):
-        Trainer(ex).fit(iterations=2, steps_per_call=2)
+    assert isinstance(ex, PipelineExecutor)
+    stats = Trainer(ex).fit(iterations=4, warmup=1, steps_per_call=2)
+    assert stats["iterations"] == 4
+    assert stats["steps_per_call"] == 2 and stats["supersteps"] == 2
+    # The FUSED superstep stays Executor-only: ResilientTrainer's k>1
+    # path drives build_superstep and must refuse loudly.
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    from flexflow_tpu.runtime.resilience import ResilientTrainer
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with CheckpointManager(d) as ck:
+            rt = ResilientTrainer(lambda: ex, ck)
+            with pytest.raises(ValueError, match="steps_per_call"):
+                rt.fit(iterations=2,
+                       batch_fn=lambda s: _host_batches(1, batch=8)[0],
+                       steps_per_call=2)
 
 
 def test_superstep_capable_full_mesh():

@@ -1,12 +1,16 @@
 """Pipeline dispatch-overhead measurement (PIPELINE_OVERHEAD.md rows).
 
-VERDICT r4 item 5 acceptance: S=4 mb=4 <= plain-Executor step time at
-the b512 x w1024 config.  Reruns the round-3 table configs on the
-8-device virtual CPU mesh with the current runtime (1F1B schedule,
-batched stage-input device_put, cached zero cotangents) so the before
-(round-3 table) / after (this) delta is attributable to the round-5
-work.  The virtual mesh multiplexes ONE core, so these numbers isolate
-host dispatch + boundary transfer cost, exactly as in round 3.
+Round 6 (ISSUE 3) additions on top of the round-3/5 table: a CHUNK
+sweep (``--pipeline-chunk`` c folds each stage's per-microbatch fwd/bwd
+into one scanned program — host programs per step drop from ``2*S*m``
+to ``2*S*ceil(m/c)``, printed from the actual ``last_schedule`` event
+count) and a SUPERSTEP A/B (k pipeline steps dispatched back-to-back
+under ONE ``jax.device_get`` fence, ``Trainer._fit_superstep_pipeline``
+semantics timed inline).  Acceptance: S=4 mb=8 c=mb 1f1b beats the
+round-5 1f1b number (981 ms) by >= 1.2x on the 8-dev virtual CPU mesh.
+
+The virtual mesh multiplexes ONE core, so these numbers isolate host
+dispatch + boundary transfer cost, exactly as in rounds 3/5.
 
 Usage: python tools/measure_pipeline.py [--width 1024 --batch 512]
 """
@@ -52,6 +56,33 @@ def time_step(ex, batch, iters=30, warmup=5):
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 
+def time_superstep(ex, batch, k, iters=32, warmup=4):
+    """k steps dispatched back-to-back, ONE device_get of all k
+    metrics per superstep — the pipeline-superstep fence pattern."""
+    import jax
+
+    params, opt_state, state = ex.init(seed=0)
+    placed = ex.shard_batch(batch)
+    ms = []
+    for _ in range(warmup):
+        params, opt_state, state, m = ex.train_step(
+            params, opt_state, state, placed)
+        ms.append(m)
+    jax.device_get(ms)
+    t0 = time.perf_counter()
+    done = 0
+    while done < iters:
+        n = min(k, iters - done)
+        ms = []
+        for _ in range(n):
+            params, opt_state, state, m = ex.train_step(
+                params, opt_state, state, placed)
+            ms.append(m)
+        jax.device_get(ms)
+        done += n
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--width", type=int, default=1024)
@@ -92,17 +123,40 @@ def main():
             store.set(name, ParallelConfig(n=per, device_ids=ids))
         return store
 
+    def make_pipe(S, mb, sched, c):
+        return PipelineExecutor(
+            ff, pipe_store(S), optimizer=opt(),
+            microbatches=mb, schedule=sched, chunk=c,
+        )
+
     for S in (2, 4):
         for mb in (1, 4, 8):
+            # Both schedules at c=1 (round-3/5 comparability), then the
+            # chunk sweep on 1f1b: c in {2, mb}.
+            chunks = [1] if mb == 1 else [1, 2, mb]
             for sched in ("gpipe", "1f1b"):
-                pipe = PipelineExecutor(
-                    ff, pipe_store(S), optimizer=opt(),
-                    microbatches=mb, schedule=sched,
-                )
-                t = time_step(pipe, batch, args.iters)
-                flag = " <= plain" if t <= t_plain else ""
-                print(f"pipeline S={S} mb={mb} {sched}: {t:.1f} ms{flag}",
-                      flush=True)
+                for c in (chunks if sched == "1f1b" else [1]):
+                    pipe = make_pipe(S, mb, sched, c)
+                    t = time_step(pipe, batch, args.iters)
+                    progs = len(pipe.last_schedule)
+                    flag = " <= plain" if t <= t_plain else ""
+                    print(
+                        f"pipeline S={S} mb={mb} c={c} {sched}: "
+                        f"{t:.1f} ms  ({progs} programs/step){flag}",
+                        flush=True,
+                    )
+
+    # Superstep-over-pipeline A/B: one fence per k=8 steps at the
+    # dispatch-minimal chunk (and at c=1 for the fence-only delta).
+    for c in (1, 8):
+        pipe = make_pipe(4, 8, "1f1b", c)
+        t1 = time_superstep(pipe, batch, k=1, iters=args.iters)
+        t8 = time_superstep(pipe, batch, k=8, iters=args.iters)
+        print(
+            f"superstep S=4 mb=8 c={c} 1f1b: k=1 {t1:.1f} ms -> "
+            f"k=8 {t8:.1f} ms/step ({t1 / t8:.2f}x)",
+            flush=True,
+        )
     return 0
 
 

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # Fast pre-commit smoke: the targeted suites from CLAUDE.md covering
-# ops/oracles, strategy numerics, the pipeline runtime, superstep
-# execution, and the resilience/checkpoint subsystem — <4 min on the
-# 8-dev virtual CPU mesh, vs ~14 min for the full tier-1 run.  Single
-# core box: no pytest-xdist.
+# ops/oracles, strategy numerics, the pipeline runtime (incl. the
+# chunked-scan dispatch + pipeline-superstep numerics,
+# test_pipeline_chunk.py), superstep execution, and the resilience/
+# checkpoint subsystem — ~4 min on the 8-dev virtual CPU mesh, vs
+# ~14 min+ for the full tier-1 run.  Single core box: no pytest-xdist.
 #
 # Usage: ./tools/tier1_smoke.sh [extra pytest args]
 set -euo pipefail
@@ -12,6 +13,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ops.py \
     tests/test_sharding_equivalence.py \
     tests/test_pipeline.py \
+    tests/test_pipeline_chunk.py \
     tests/test_superstep.py \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
