@@ -486,6 +486,89 @@ def bench_pipeline(n_chips: int, on_tpu: bool):
     return out
 
 
+def bench_telemetry(n_chips: int, on_tpu: bool):
+    """Run-telemetry summary leg: the dispatch-bound MLP trained with
+    run telemetry enabled (in-memory — counters/percentiles, no JSONL)
+    so the round artifact carries the observability layer's headline
+    numbers: fences/step, host-side step-time p50/p95/max, pipeline
+    programs/step, and the measured enabled-vs-off per-step overhead
+    (the < 2% acceptance bar, OBSERVABILITY.md)."""
+    import numpy as np
+
+    import jax
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.telemetry import Telemetry
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    batch = 64 * n_chips if on_tpu else 32
+    width = 256 if on_tpu else 64
+    iters = 32 if on_tpu else 16
+
+    def build():
+        ff = FFModel(FFConfig(batch_size=batch, seed=7))
+        x = ff.create_tensor((batch, width), name="x")
+        lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+        t = ff.dense(x, width, activation="relu", name="fc1")
+        t = ff.dense(t, 8, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        return Executor(ff, optimizer=SGDOptimizer(lr=0.01, momentum=0.9))
+
+    # Pin the baseline leg genuinely OFF: FF_TELEMETRY_DIR (e.g. from
+    # tools/tpu_watcher.sh) would otherwise install file-backed
+    # telemetry on the "off" fit and corrupt the overhead A/B.
+    env_dir = os.environ.pop("FF_TELEMETRY_DIR", None)
+    try:
+        off = Trainer(build()).fit(iterations=iters, warmup=1)
+        with Telemetry() as tel:
+            on = Trainer(build()).fit(iterations=iters, warmup=1)
+    finally:
+        if env_dir is not None:
+            os.environ["FF_TELEMETRY_DIR"] = env_dir
+    t = on["telemetry"]
+    out = {
+        "batch_size": batch,
+        "iterations": iters,
+        "fences_per_step": t.get("fences_per_step"),
+        "step_ms_p50": t.get("step_ms_p50"),
+        "step_ms_p95": t.get("step_ms_p95"),
+        "step_ms_max": t.get("step_ms_max"),
+        "overhead_pct": round(
+            (on["elapsed_s"] - off["elapsed_s"]) / off["elapsed_s"] * 100, 2
+        ),
+    }
+    nd = len(jax.devices())
+    if nd >= 2:
+        # Pipeline programs/step: a 2-stage layer-wise run whose
+        # folded last_schedule counters audit 2*S*ceil(m/c).
+        from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+        from flexflow_tpu.runtime.pipeline import PipelineExecutor
+
+        ff = FFModel(FFConfig(batch_size=batch, seed=7))
+        x = ff.create_tensor((batch, width), name="x")
+        lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+        t2 = ff.dense(x, width, activation="relu", name="fc0")
+        t2 = ff.dense(t2, 8, name="head")
+        ff.softmax(t2, lbl, name="softmax")
+        per = nd // 2
+        st = StrategyStore(nd)
+        st.set("fc0", ParallelConfig(n=per, device_ids=tuple(range(per))))
+        for name in ("head", "softmax"):
+            st.set(name, ParallelConfig(
+                n=per, device_ids=tuple(range(per, 2 * per))))
+        pipe = PipelineExecutor(
+            ff, st, optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+            microbatches=4, chunk=4,
+        )
+        with Telemetry() as ptel:
+            Trainer(pipe).fit(iterations=4, warmup=1)
+        out["programs_per_step"] = ptel.step_summary().get("programs_per_step")
+    return out
+
+
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
     speedup (the ICML'18 headline claims it for AlexNet/VGG/Inception;
@@ -641,6 +724,12 @@ def main():
             extra["pipeline"] = bench_pipeline(n_chips, on_tpu)
     except Exception as e:
         extra["pipeline_error"] = f"{type(e).__name__}: {e}"
+    checkpoint_result(per_chip)
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["telemetry"] = bench_telemetry(n_chips, on_tpu)
+    except Exception as e:
+        extra["telemetry_error"] = f"{type(e).__name__}: {e}"
     checkpoint_result(per_chip)
     try:
         with contextlib.redirect_stdout(sys.stderr):

@@ -58,7 +58,9 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)
   --resilient (detection + checkpoint rollback + SIGTERM emergency save)
-  --save-every N   --ckpt-dir PATH   --max-restarts N   --sync-ckpt"""
+  --save-every N   --ckpt-dir PATH   --max-restarts N   --sync-ckpt
+  --telemetry DIR (JSONL run telemetry + heartbeat + stall watchdog,
+                   OBSERVABILITY.md)   --stall-deadline S (0 = no watchdog)"""
 
 
 def check_help(argv, doc: Optional[str]) -> None:
@@ -352,6 +354,8 @@ def _run_resilient(
             "loss": out["loss"],
             "restarts": out["restarts"],
         }
+        if "telemetry" in out:
+            stats["telemetry"] = out["telemetry"]
         if cfg.eval_iters > 0 and rt.executor is not None:
             stats["eval"] = _run_eval(
                 Trainer(rt.executor), out["params"], out["state"], cfg,
@@ -376,7 +380,27 @@ def run_training(
     ``arrays`` is an app-loaded dataset (``-d``); otherwise synthetic
     arrays are generated when ``num_samples`` is set, else one fixed
     device-resident synthetic batch (the reference's syntheticInput).
+
+    With ``--telemetry DIR`` the whole run — executor build, training,
+    checkpoint I/O, the resilient loop's faults/rollbacks — reports
+    into one run-scoped JSONL event stream (OBSERVABILITY.md).
     """
+    from flexflow_tpu.runtime import telemetry as _telemetry
+
+    with _telemetry.maybe_run(cfg, meta={"app": label}):
+        return _run_training(ff, cfg, strategy, int_high, label,
+                             num_samples, arrays)
+
+
+def _run_training(
+    ff: FFModel,
+    cfg: FFConfig,
+    strategy: Optional[StrategyStore],
+    int_high: Optional[Dict[str, int]],
+    label: str,
+    num_samples: Optional[int],
+    arrays: Optional[Dict[str, np.ndarray]],
+) -> Dict[str, float]:
     ndev = cfg.resolve_num_devices()
     if strategy is None:
         strategy = load_strategy(cfg, ndev)

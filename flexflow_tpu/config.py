@@ -165,6 +165,25 @@ class FFConfig:
     # train loop until durable; default is non-blocking background
     # writes with a flush fence at restore/exit).
     async_checkpointing: bool = True
+    # --telemetry DIR: structured run telemetry (runtime/telemetry.py;
+    # OBSERVABILITY.md) — one JSONL event stream per run under DIR
+    # (per-step/superstep wall time + loss, fences, pipeline
+    # host-program counts, checkpoint I/O, faults/rollbacks/replays),
+    # step-time percentiles folded into the fit stats under
+    # "telemetry", a heartbeat file (DIR/heartbeat, or
+    # FF_HEARTBEAT_FILE, shared with tools/tpu_watcher.sh) and the
+    # stall watchdog.  None = off: zero overhead, no extra fences,
+    # stats/numerics bit-identical.  FF_TELEMETRY_DIR in the
+    # environment enables it without touching flags.
+    telemetry_dir: Optional[str] = None
+    # --stall-deadline S: watchdog deadline in seconds — a gap between
+    # telemetry heartbeats (every completed step and fence edge)
+    # exceeding it logs ONE loud last-known-event warning + a `stall`
+    # event (the relay-wedge failure mode is a silent never-returning
+    # device_get).  Observe-and-warn only, NEVER kills (killing a
+    # TPU-claim holder wedges the tunnel).  0 disables the monitor
+    # thread; only active when telemetry is on.
+    stall_deadline_s: float = 300.0
     # --zero-opt: ZeRO-1-style optimizer-state sharding — each
     # parameter's optimizer moments (Adam m/v, SGD momentum) shard
     # their leading dim across the mesh axes the op's strategy assigns
@@ -299,6 +318,15 @@ class FFConfig:
                 cfg.max_restarts = int(_next())
             elif a == "--sync-ckpt":
                 cfg.async_checkpointing = False
+            elif a == "--telemetry":
+                cfg.telemetry_dir = _next()
+            elif a == "--stall-deadline":
+                cfg.stall_deadline_s = float(_next())
+                if cfg.stall_deadline_s < 0:
+                    raise SystemExit(
+                        f"--stall-deadline must be >= 0, got "
+                        f"{cfg.stall_deadline_s}"
+                    )
             i += 1
         return cfg
 

@@ -1,6 +1,7 @@
 from flexflow_tpu.runtime.checkpoint import CheckpointManager, TornCheckpointError
 from flexflow_tpu.runtime.executor import Executor
 from flexflow_tpu.runtime.profiler import profile_ops, report, trace
+from flexflow_tpu.runtime.telemetry import Telemetry
 from flexflow_tpu.runtime.resilience import (
     FailurePolicy,
     FaultInjector,
@@ -20,6 +21,7 @@ __all__ = [
     "PreemptionHandler",
     "ResilientTrainer",
     "StepFailure",
+    "Telemetry",
     "profile_ops",
     "report",
     "trace",

@@ -33,9 +33,12 @@ import logging
 import os
 import re
 import shutil
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+
+from flexflow_tpu.runtime import telemetry as _telemetry
 
 _log = logging.getLogger("ff.checkpoint")
 
@@ -162,7 +165,22 @@ class CheckpointManager:
         save-interval gating and — when the step already exists —
         replaces the stale snapshot crash-safely (a run resumed from an
         *older* step may legitimately re-save a step with different
-        state)."""
+        state).
+
+        Emits a ``ckpt_save`` run-telemetry event with the host-side
+        I/O seconds (async saves return after the copy-out, so ``io_s``
+        is what the train loop actually paid, not the disk write)."""
+        t0 = time.perf_counter()
+        saved = self._save(step, params, opt_state, state, force)
+        _telemetry.current().emit(
+            "ckpt_save", step=int(step),
+            io_s=round(time.perf_counter() - t0, 6),
+            saved=bool(saved), force=bool(force),
+            **{"async": self.async_save},
+        )
+        return saved
+
+    def _save(self, step: int, params, opt_state, state, force: bool) -> bool:
         ocp = _ocp()
         items = self._items(params, opt_state, state)
         if step in self._mgr.all_steps():
@@ -278,7 +296,23 @@ class CheckpointManager:
         tried instead — a crash mid-delete must never strand a job that
         still has an older intact snapshot.  An explicit ``step``
         restores exactly that step or raises.
+
+        Emits ``ckpt_restore`` (with I/O seconds, flush included) on
+        success and ``ckpt_torn`` for every skipped unreadable step.
         """
+        t0 = time.perf_counter()
+        out = self._restore(templates, step)
+        _telemetry.current().emit(
+            "ckpt_restore", step=int(out[0]),
+            io_s=round(time.perf_counter() - t0, 6),
+        )
+        return out
+
+    def _restore(
+        self,
+        templates: Tuple[Any, Any, Any],
+        step: Optional[int] = None,
+    ) -> Tuple[int, Any, Any, Any]:
         self.wait_until_finished()  # async saves must be durable & visible
         if step is not None:
             return self._restore_step(step, templates)
@@ -300,6 +334,10 @@ class CheckpointManager:
                     "checkpoint step %d unreadable (%s: %s); "
                     "falling back to the previous step",
                     s, type(e).__name__, e,
+                )
+                _telemetry.current().emit(
+                    "ckpt_torn", step=int(s),
+                    error=f"{type(e).__name__}: {e}",
                 )
                 last_err = e
         # NOT FileNotFoundError: snapshots exist but none is readable —

@@ -46,6 +46,7 @@ from flexflow_tpu.graph import FFModel
 from flexflow_tpu.ops.base import Op, TensorSpec
 from flexflow_tpu.optim import SGDOptimizer
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime import telemetry as _telemetry
 from flexflow_tpu.runtime.executor import Executor, _merge_metrics, mean_metrics
 
 _log = logging.getLogger("ff.pipeline")
@@ -774,6 +775,9 @@ class PipelineExecutor:
 
         events = self.build_schedule(S, m)
         self.last_schedule = events
+        # Run telemetry folds the schedule into host-programs-per-step
+        # counters (len(events) == 2*S*m fwd/bwd programs this step).
+        _telemetry.current().add_programs(len(events))
         for kind, si, mi in events:
             st = self.stages[si]
             if kind == "F":
@@ -855,6 +859,8 @@ class PipelineExecutor:
 
         events = self.build_schedule(S, n_chunks)
         self.last_schedule = events
+        # len(events) == 2*S*ceil(m/c) scan programs this step.
+        _telemetry.current().add_programs(len(events))
         for kind, si, ci in events:
             st = self.stages[si]
             if kind == "F":
@@ -910,8 +916,9 @@ class PipelineExecutor:
         # is ONE device_get of all S squared norms (each separate fetch
         # is a ~1.5-16 ms round-trip through the relay).
         if self.config.clip_norm > 0.0:
-            sqs = jax.device_get(
-                [self._grad_sq_fns[si](grads[si]) for si in range(S)]
+            sqs = _telemetry.current().fence(
+                [self._grad_sq_fns[si](grads[si]) for si in range(S)],
+                "clip_norm",
             )
             total = sum(float(x) for x in sqs)
             c = self.config.clip_norm
@@ -1016,7 +1023,9 @@ class PipelineExecutor:
         # is invalid), so they are summed host-side — but fetching
         # inside the loop serialized every stage on a device_get
         # (pipeline-overhead finding, PIPELINE_OVERHEAD.md).
-        losses, mets_list = jax.device_get((losses, mets_list))
+        losses, mets_list = _telemetry.current().fence(
+            (losses, mets_list), "eval"
+        )
         metrics: Dict[str, Any] = {}
         for mets in mets_list:
             metrics = _merge_metrics(metrics, mets)

@@ -75,19 +75,28 @@ def profile_ops(
     ops) so the times include the op's own collectives.
     """
     if _on_axon_relay():
+        # ONE warning (the old warnings.warn + logging pair fired the
+        # same message twice), routed through the telemetry logger, and
+        # a structured ``profile_skipped`` event — the per-op numbers
+        # would be dispatch-dominated and MEANINGLESS, so they are not
+        # measured at all rather than silently returned.
         import warnings
+
+        from flexflow_tpu.runtime import telemetry as _telemetry
 
         msg = (
             "profile_ops: the backend is the axon TPU relay, where every "
             "eager dispatch costs ~16 ms regardless of compute — per-op "
-            "times below are dispatch-dominated and MEANINGLESS.  Profile "
-            "the fused jitted step instead (Trainer.fit throughput, or an "
-            "XProf trace via --trace DIR / runtime.profiler.trace)."
+            "times would be dispatch-dominated and MEANINGLESS; skipping "
+            "the per-op profile.  Profile the fused jitted step instead "
+            "(Trainer.fit throughput, or an XProf trace via --trace DIR "
+            "/ runtime.profiler.trace)."
         )
         warnings.warn(msg, RuntimeWarning, stacklevel=2)
-        import logging
-
-        logging.getLogger("ff.profiler").warning(msg)
+        _telemetry.current().emit(
+            "profile_skipped", reason="axon-relay-dispatch-dominated"
+        )
+        return []
     env: Dict[str, jax.Array] = {}
     for t in ex.model.input_tensors:
         env[t.name] = jax.device_put(batch[t.name], ex.input_sharding(t))
@@ -431,8 +440,19 @@ def measured_cost_table(
     so the table normalizes back to whole-op time by multiplying with
     the profiled strategy's shard count (exact on a single-device
     executor, a collective-inclusive approximation on a parallel one).
+
+    On the axon relay ``profile_ops`` skips (dispatch-dominated
+    numbers); an empty table would silently degrade measured-mode
+    search to the roofline, so that case raises instead — the caller
+    asked for MEASURED costs.
     """
     profiles = profile_ops(ex, params, state, batch, reps=reps)
+    if not profiles and ex.model.layers:
+        raise RuntimeError(
+            "measured_cost_table: per-op profiling skipped on the axon "
+            "relay (dispatch-dominated); run on CPU/a direct backend, "
+            "or use measured_degree_table / the roofline cost model"
+        )
     return {
         op.name: p.time_us * ex._pc(op).num_parts
         for op, p in zip(ex.model.layers, profiles)

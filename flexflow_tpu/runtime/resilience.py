@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, Iterable, Optional, Union
 import jax
 import numpy as np
 
+from flexflow_tpu.runtime import telemetry as _telemetry
 from flexflow_tpu.runtime.checkpoint import CheckpointManager
 from flexflow_tpu.runtime.executor import Executor
 from flexflow_tpu.runtime.trainer import MAX_STEPS_PER_CALL
@@ -170,24 +171,30 @@ class FaultInjector:
         #: Log of (mode, step) pairs actually fired, for assertions.
         self.fired = []
 
+    def _fire(self, mode: str, step: int) -> None:
+        """Record one fired fault — and report it to run telemetry, so
+        a chaos run's JSONL carries fault→rollback→replay in order."""
+        self.fired.append((mode, step))
+        _telemetry.current().emit("fault", mode=mode, step=int(step))
+
     # -- hooks the resilient loop drives -----------------------------------
 
     def before_step(self, step: int) -> None:
         """Host-side, before the step's batch is assembled."""
         if step in self.preempt_at:
             self.preempt_at.discard(step)
-            self.fired.append(("preempt", step))
+            self._fire("preempt", step)
             os.kill(os.getpid(), signal.SIGTERM)
         if step in self.raise_at:
             exc = self.raise_at.pop(step)
-            self.fired.append(("raise", step))
+            self._fire("raise", step)
             raise exc
 
     def poison_batch(self, step: int, batch: Dict[str, Any]) -> Dict[str, Any]:
         if step not in self.nan_batch_at:
             return batch
         self.nan_batch_at.discard(step)
-        self.fired.append(("nan_batch", step))
+        self._fire("nan_batch", step)
         return {
             k: np.full_like(v, np.nan)
             if isinstance(v, np.ndarray) and np.issubdtype(v.dtype, np.floating)
@@ -199,7 +206,7 @@ class FaultInjector:
         if step not in self.nan_loss_at:
             return loss
         self.nan_loss_at.discard(step)
-        self.fired.append(("nan_loss", step))
+        self._fire("nan_loss", step)
         return float("nan")
 
     def after_save(self, step: int, checkpoint: CheckpointManager) -> None:
@@ -209,7 +216,7 @@ class FaultInjector:
         if not due:
             return
         self.corrupt_checkpoint_at -= due
-        self.fired.append(("corrupt", step))
+        self._fire("corrupt", step)
         self.corrupt(checkpoint)
 
     @staticmethod
@@ -302,6 +309,11 @@ class ResilientTrainer:
             "step failure (%s); restart %d/%d",
             why, self.restarts, self.policy.max_restarts,
         )
+        _telemetry.current().emit(
+            "rollback", restart=self.restarts,
+            reason=f"{type(why).__name__}: {why}",
+            rebuild_executor=ex is None or not isinstance(why, StepFailure),
+        )
         if self.policy.backoff_s:
             time.sleep(self.policy.backoff_s * self.restarts)
         # A silent failure (bad loss) leaves the backend healthy: keep
@@ -310,6 +322,7 @@ class ResilientTrainer:
         if ex is None or not isinstance(why, StepFailure):
             ex = self.executor_factory()
         step, params, opt_state, state = self._fresh_state(ex, seed)
+        _telemetry.current().emit("replay", from_step=int(step))
         return ex, step, params, opt_state, state
 
     # -- the loop ----------------------------------------------------------
@@ -350,7 +363,28 @@ class ResilientTrainer:
         Returns step/restarts/params/opt_state/state/loss as before,
         plus ``losses`` — ``{step: validated host loss}`` for every
         step this process ran — and ``preempted``.
+
+        Like ``Trainer.fit``, the run self-installs telemetry from the
+        executor's config (``telemetry_dir`` / ``FF_TELEMETRY_DIR``)
+        when no run telemetry is already current, so a direct
+        ``ResilientTrainer(...).fit()`` gets the same JSONL stream as
+        an app-routed one.
         """
+        ex = self.executor_factory()
+        with _telemetry.maybe_run(getattr(ex, "config", None)):
+            return self._fit(ex, iterations, batch_fn, save_every, seed,
+                             steps_per_call, check_every)
+
+    def _fit(
+        self,
+        ex,
+        iterations: int,
+        batch_fn: Callable[[int], Dict[str, Any]],
+        save_every: int,
+        seed: int,
+        steps_per_call: int,
+        check_every: Optional[int],
+    ) -> Dict[str, Any]:
         injector = FaultInjector.wrap(self.fault_injector)
         k = max(1, steps_per_call)
         if k > MAX_STEPS_PER_CALL:
@@ -364,7 +398,6 @@ class ResilientTrainer:
         # superstep length (an unfenced dependent dispatch chain):
         # clamp it to the same cap.
         check_every = min(check_every or save_every or 1, MAX_STEPS_PER_CALL)
-        ex = self.executor_factory()
         if k > 1 and not hasattr(ex, "build_superstep"):
             # Layer-wise (pipeline) executors have no fused superstep;
             # the k=1 path composes fully (per-stage {si: ...} trees
@@ -395,7 +428,9 @@ class ResilientTrainer:
             nonlocal pending
             if not pending:
                 return
-            host = jax.device_get([m for _, m in pending])
+            host = _telemetry.current().fence(
+                [m for _, m in pending], "validate"
+            )
             todo, pending = pending, []
             for (s, _), v in zip(todo, host):
                 self._record(losses, injector, s, float(v))
@@ -445,7 +480,9 @@ class ResilientTrainer:
                         # ONE host fence per superstep: the stacked
                         # per-step metrics, scanned for the first
                         # non-finite step.
-                        host = jax.device_get(ms["train_loss"])
+                        host = _telemetry.current().fence(
+                            ms["train_loss"], "superstep"
+                        )
                         # Read the preemption flag AFTER the fence —
                         # nearly all wall time is inside the dispatch,
                         # so a signal landing there still exits at THIS
@@ -465,6 +502,9 @@ class ResilientTrainer:
                             self.restarts = 0
                     if trig:
                         preempted = True
+                        _telemetry.current().emit(
+                            "preempt", step=int(step), signum=preempt.signum
+                        )
                         logger.warning(
                             "preempted: emergency checkpoint at step %d, "
                             "exiting cleanly", step,
@@ -486,7 +526,7 @@ class ResilientTrainer:
             self.checkpoint.save(step, params, opt_state, state, force=True)
         self.checkpoint.wait_until_finished()
         self.executor = ex
-        return {
+        return _telemetry.current().fold_stats({
             "step": step,
             "restarts": self.total_restarts,
             "params": params,
@@ -495,7 +535,7 @@ class ResilientTrainer:
             "loss": losses.get(step - 1, math.nan),
             "losses": losses,
             "preempted": preempted,
-        }
+        })
 
     def _record(self, losses, injector, s: int, v: float, where: str = ""):
         """Validate one host loss at the fence; record it or raise."""
@@ -503,3 +543,4 @@ class ResilientTrainer:
         if self.policy.rollback_on_nonfinite and not math.isfinite(v):
             raise StepFailure(f"non-finite loss at step {s}{where}: {v}")
         losses[s] = v
+        _telemetry.current().record_step(s, loss=v)

@@ -1,0 +1,433 @@
+"""Structured run telemetry: JSONL event stream, dispatch/fence
+counters, step-time percentiles, and a stall watchdog.
+
+The reference could always answer "where did this step's time go" —
+per-task cudaEvent timing under ``--profiling`` plus Legion trace
+capture (``conv_2d.cu:515-546``, ``dlrm.cc:151-163``).  This rebuild
+has grown three dispatch regimes (per-step, fused superstep,
+fence-amortized pipeline) and a resilience layer whose behavior used
+to be visible only through scattered prints; the PIPELINE_OVERHEAD.md
+round-6 incident (an unexplained ~1.5x box-state drift untangled by
+hand-rerun A/Bs) is exactly what a durable, structured per-run record
+exists to prevent.
+
+Design (OBSERVABILITY.md has the full event schema):
+
+- ONE :class:`Telemetry` object per run; components report into
+  :func:`current` (installed by the context manager), so the trainer,
+  executors, checkpoint manager and resilience layer all write into
+  the same stream without threading a handle through every call.
+- Events are JSON lines ``{"ts": wall-clock s, "seq": n, "ev": type,
+  ...}``.  Rare events (fences, checkpoints, faults, rollbacks,
+  stalls) flush immediately; high-rate ``step`` events buffer and
+  flush at the next rare event or after ``FLUSH_EVERY_S`` — so a
+  crashed run's log is complete to within a flush interval of the
+  instant it died, and the per-step cost stays a buffered ``write``,
+  not a syscall (the < 2% overhead bar, OBSERVABILITY.md).
+- **Zero overhead when off**: the :data:`NULL` singleton's hooks are
+  no-op attribute calls and :meth:`_NullTelemetry.fence` is *exactly*
+  ``jax.device_get`` — instrumentation wraps the fences the trainer
+  already had and NEVER adds one (fences/step is pinned unchanged by
+  tests/test_telemetry.py; trainer numerics and stats are bit-identical
+  with telemetry off).
+- The **stall watchdog** is a daemon thread fed by in-process
+  heartbeats (every completed step and both edges of every fence); a
+  gap exceeding the deadline logs ONE loud last-known-event warning —
+  the relay-wedge failure mode in CLAUDE.md is a silent
+  never-returning ``device_get``, completely invisible until now —
+  and emits a ``stall`` event.  Observe-and-warn only: it never kills
+  the process (killing a TPU-claim holder wedges the tunnel for
+  hours).  Heartbeats also touch a file (``DIR/heartbeat``, or
+  ``FF_HEARTBEAT_FILE``) so an external watcher
+  (``tools/tpu_watcher.sh``) shares the same liveness signal as the
+  in-process monitor.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+_log = logging.getLogger("ff.telemetry")
+
+#: The run-scoped telemetry components report into (None = disabled).
+_current: Optional["Telemetry"] = None
+
+#: Watchdog deadline (s) used when a config carries no override.
+DEFAULT_STALL_DEADLINE_S = 300.0
+
+#: Max age of buffered ``step`` events before a time-based flush.
+FLUSH_EVERY_S = 0.5
+
+#: Min spacing of heartbeat-FILE touches (the in-process timestamp
+#: updates on every beat; the file is for the external watcher, whose
+#: liveness resolution is seconds — syscalls per step are not).
+HEARTBEAT_FILE_EVERY_S = 1.0
+
+#: High-rate event types that may buffer; everything else flushes
+#: immediately (fences, checkpoints, faults, rollbacks, stalls are
+#: exactly the events a postmortem cannot afford to lose).
+_BUFFERED_EVENTS = frozenset({"step"})
+
+#: Per-process run counter: strftime has one-second resolution, so two
+#: quick fits in one process would otherwise append-interleave into the
+#: same JSONL file (breaking the one-file-per-run contract).
+_RUN_COUNTER = itertools.count()
+
+
+class _NullTelemetry:
+    """The disabled singleton: every hook is a no-op, and ``fence`` is
+    exactly ``jax.device_get`` — so instrumentation sites stay
+    unconditional with zero measurable cost and zero extra fences."""
+
+    enabled = False
+    path = None
+
+    def fence(self, value, label: str = "fence"):
+        return jax.device_get(value)
+
+    def emit(self, ev: str, **fields) -> None:
+        pass
+
+    def record_step(self, step, loss=None, wall_s=None, **fields) -> None:
+        pass
+
+    def add_programs(self, n: int) -> None:
+        pass
+
+    def heartbeat(self, label: str = "beat") -> None:
+        pass
+
+    def step_summary(self) -> Dict[str, Any]:
+        return {}
+
+    def fold_stats(self, stats: Dict[str, Any]) -> Dict[str, Any]:
+        return stats
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NullTelemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL = _NullTelemetry()
+
+
+def current():
+    """The active run's :class:`Telemetry`, or :data:`NULL` when no run
+    telemetry is installed."""
+    return _current if _current is not None else NULL
+
+
+def maybe_run(config=None, meta: Optional[Dict[str, Any]] = None):
+    """Context manager for an optionally-telemetered run: a fresh
+    :class:`Telemetry` when ``config.telemetry_dir`` (or the
+    ``FF_TELEMETRY_DIR`` environment variable) names a directory AND no
+    run telemetry is already installed; otherwise :data:`NULL` (which
+    leaves an enclosing run's telemetry in place — nested ``fit`` calls
+    report into the outer stream)."""
+    if current().enabled:
+        return NULL
+    d = getattr(config, "telemetry_dir", None) or os.environ.get(
+        "FF_TELEMETRY_DIR"
+    )
+    if not d:
+        return NULL
+    deadline = getattr(config, "stall_deadline_s", DEFAULT_STALL_DEADLINE_S)
+    return Telemetry(d, stall_deadline_s=deadline, meta=meta)
+
+
+def _json_default(o):
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+def _jnum(v: float) -> str:
+    """JSON fragment for one float: repr round-trips finite values
+    exactly; non-finite spell NaN/Infinity the way json.dumps does
+    (json.loads accepts both)."""
+    v = float(v)
+    if v == v and v not in (float("inf"), float("-inf")):
+        return repr(v)
+    return json.dumps(v)
+
+
+class Telemetry:
+    """Run-scoped telemetry collector.
+
+    ``directory=None`` keeps everything in-process (counters +
+    percentiles + watchdog, no JSONL) — what bench.py uses to fold a
+    telemetry summary into its JSON without touching disk.
+
+    As a context manager it installs itself as :func:`current` so every
+    runtime component (trainer fences, pipeline program counters,
+    checkpoint I/O, resilience faults/rollbacks) reports into this run.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        run_id: Optional[str] = None,
+        heartbeat_path: Optional[str] = None,
+        stall_deadline_s: float = 0.0,
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        self.run_id = run_id or (
+            time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            + f"-{os.getpid()}-{next(_RUN_COUNTER)}"
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._f = None
+        self.path: Optional[str] = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            self.path = os.path.join(directory, f"run-{self.run_id}.jsonl")
+            self._f = open(self.path, "a")
+        #: Dispatch/fence counters: ``fences`` and ``steps`` feed
+        #: fences/step; ``host_programs``/``program_steps`` hold the
+        #: pipeline's folded ``last_schedule`` lengths (programs/step).
+        self.counts: Dict[str, int] = {
+            "fences": 0, "steps": 0, "host_programs": 0, "program_steps": 0,
+        }
+        #: Host-side per-step wall times (s) — percentile source.  In
+        #: the unfenced per-step regime these are DISPATCH times (the
+        #: loop never blocks on the device); on fenced paths
+        #: (superstep) they include device execution.  Either way they
+        #: are measured host-side and add no ``device_get``.
+        self.step_times: List[float] = []
+        self._hb_path = (
+            heartbeat_path
+            or os.environ.get("FF_HEARTBEAT_FILE")
+            or (os.path.join(directory, "heartbeat") if directory else None)
+        )
+        self._hb_warned = False
+        self._hb_created = False
+        self._last_flush = time.monotonic()
+        self._last_file_touch = time.monotonic()
+        self._last_beat = time.monotonic()
+        self._last_label = "run_start"
+        self._stall_deadline = float(stall_deadline_s or 0.0)
+        self._stalled = False
+        self._closed = False
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self._prev_current: Optional[Telemetry] = None
+        if self._hb_path:
+            self._touch_heartbeat()
+        self.emit("run_start", run_id=self.run_id, pid=os.getpid(),
+                  **(meta or {}))
+        if self._stall_deadline > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="ff-telemetry-watchdog", daemon=True
+            )
+            self._watchdog.start()
+
+    # -- event stream -------------------------------------------------------
+
+    def emit(self, ev: str, **fields) -> None:
+        """Append one event to the JSONL stream.  ``step`` events
+        buffer (flushed at the next rare event or ``FLUSH_EVERY_S``);
+        everything else flushes immediately."""
+        with self._lock:
+            self._seq += 1
+            rec: Dict[str, Any] = {
+                "ts": round(time.time(), 6), "seq": self._seq, "ev": ev,
+            }
+            rec.update(fields)
+            if self._f is not None and not self._closed:
+                self._f.write(json.dumps(rec, default=_json_default) + "\n")
+                now = time.monotonic()
+                if (ev not in _BUFFERED_EVENTS
+                        or now - self._last_flush >= FLUSH_EVERY_S):
+                    self._f.flush()
+                    self._last_flush = now
+            self._last_label = ev
+
+    def record_step(self, step, loss=None, wall_s=None, **fields) -> None:
+        """One completed training step: a ``step`` event plus the
+        counters/percentile feed, plus a heartbeat.  On a rollback
+        replay the same step index is recorded again — reconstruction
+        takes the LAST event per index (OBSERVABILITY.md).
+
+        This is the per-step hot path (the whole point is < 2%
+        overhead on dispatch-bound steps), so the JSON line is built by
+        hand instead of ``json.dumps`` — measured ~2x faster."""
+        step = int(step)
+        self.counts["steps"] += 1
+        if wall_s is not None:
+            self.step_times.append(float(wall_s))
+        with self._lock:
+            self._seq += 1
+            if self._f is not None and not self._closed:
+                line = (f'{{"ts": {time.time():.6f}, "seq": {self._seq}, '
+                        f'"ev": "step", "step": {step}')
+                if wall_s is not None:
+                    line += f', "wall_s": {float(wall_s):.6f}'
+                if loss is not None:
+                    line += f', "loss": {_jnum(loss)}'
+                for k, v in fields.items():
+                    line += f', {json.dumps(k)}: ' \
+                            f'{json.dumps(v, default=_json_default)}'
+                self._f.write(line + "}\n")
+                now = time.monotonic()
+                if now - self._last_flush >= FLUSH_EVERY_S:
+                    self._f.flush()
+                    self._last_flush = now
+            self._last_label = "step"
+        self.heartbeat(f"step:{step}")
+
+    def fence(self, value, label: str = "fence"):
+        """Host-readback fence: heartbeats on both edges (so the
+        watchdog knows a fence is in flight while ``device_get``
+        blocks), times it, emits a ``fence`` event, and returns the
+        host value.  This WRAPS the fences the trainer already had —
+        it never adds a ``device_get`` the un-telemetered path lacks."""
+        self.heartbeat(f"fence:{label}:in-flight")
+        t0 = time.perf_counter()
+        host = jax.device_get(value)
+        dt = time.perf_counter() - t0
+        self.counts["fences"] += 1
+        self.emit("fence", label=label, wall_s=round(dt, 6))
+        self.heartbeat(f"fence:{label}:done")
+        return host
+
+    def add_programs(self, n: int) -> None:
+        """Fold one step's host-program count (the pipeline's
+        ``len(last_schedule)``) into the programs/step counter."""
+        self.counts["host_programs"] += int(n)
+        self.counts["program_steps"] += 1
+
+    # -- heartbeat / watchdog ----------------------------------------------
+
+    def heartbeat(self, label: str = "beat") -> None:
+        now = time.monotonic()
+        self._last_beat = now
+        self._last_label = label
+        if self._stalled:
+            self._stalled = False
+            _log.warning(
+                "telemetry watchdog: heartbeat resumed (%s) — the stall "
+                "cleared on its own", label,
+            )
+            self.emit("stall_recovered", last=label)
+        if self._hb_path and (
+            now - self._last_file_touch >= HEARTBEAT_FILE_EVERY_S
+        ):
+            self._last_file_touch = now
+            self._touch_heartbeat()
+
+    def _touch_heartbeat(self) -> None:
+        # utime-only on the hot path (one syscall per beat); the file
+        # is created once here, re-created if something removes it.
+        try:
+            if self._hb_created:
+                try:
+                    os.utime(self._hb_path, None)
+                    return
+                except FileNotFoundError:
+                    pass
+            with open(self._hb_path, "a"):
+                pass
+            os.utime(self._hb_path, None)
+            self._hb_created = True
+        except OSError as e:
+            if not self._hb_warned:
+                self._hb_warned = True
+                _log.warning("cannot touch heartbeat file %s: %s",
+                             self._hb_path, e)
+
+    def _watch(self) -> None:
+        period = min(max(self._stall_deadline / 4.0, 0.05), 30.0)
+        while not self._stop.wait(period):
+            idle = time.monotonic() - self._last_beat
+            if idle >= self._stall_deadline and not self._stalled:
+                self._stalled = True
+                _log.warning(
+                    "telemetry watchdog: NO heartbeat for %.1fs (deadline "
+                    "%.1fs); last known event: %s.  If that event is a "
+                    "fence in flight, this is the relay-wedge signature "
+                    "(CLAUDE.md: a device_get that never returns) — or a "
+                    "long first-call compile.  Observe-and-warn only: "
+                    "NOT killing anything (killing a TPU-claim holder "
+                    "wedges the tunnel for hours).",
+                    idle, self._stall_deadline, self._last_label,
+                )
+                self.emit("stall", idle_s=round(idle, 1),
+                          deadline_s=self._stall_deadline,
+                          last=self._last_label)
+
+    # -- summaries ----------------------------------------------------------
+
+    def step_summary(self) -> Dict[str, Any]:
+        """Counters + host-side step-time percentiles (p50/p95/max ms,
+        nearest-rank) — the block folded into fit stats and bench.py."""
+        out: Dict[str, Any] = {
+            "steps": self.counts["steps"],
+            "fences": self.counts["fences"],
+        }
+        steps = max(self.counts["steps"], 1)
+        out["fences_per_step"] = round(self.counts["fences"] / steps, 4)
+        if self.counts["program_steps"]:
+            out["programs_per_step"] = round(
+                self.counts["host_programs"] / self.counts["program_steps"], 4
+            )
+        if self.step_times:
+            ts = sorted(self.step_times)
+
+            def pct(p: float) -> float:
+                return ts[min(len(ts) - 1, int(round(p * (len(ts) - 1))))]
+
+            out["step_ms_p50"] = round(pct(0.50) * 1e3, 3)
+            out["step_ms_p95"] = round(pct(0.95) * 1e3, 3)
+            out["step_ms_max"] = round(ts[-1] * 1e3, 3)
+        return out
+
+    def fold_stats(self, stats: Dict[str, Any]) -> Dict[str, Any]:
+        """Fold the telemetry summary into a fit stats dict (under the
+        ``"telemetry"`` key, so the existing keys stay bit-identical)."""
+        stats["telemetry"] = self.step_summary()
+        return stats
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+        self.emit("run_end", summary=self.step_summary())
+        with self._lock:
+            self._closed = True
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "Telemetry":
+        global _current
+        self._prev_current = _current
+        _current = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _current
+        if _current is self:
+            _current = self._prev_current
+        self._prev_current = None
+        self.close()

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from flexflow_tpu.metrics import PerfMetrics
+from flexflow_tpu.runtime import telemetry as _telemetry
 from flexflow_tpu.runtime.executor import Executor
 from flexflow_tpu.runtime.pipeline import PipelineExecutor
 
@@ -109,22 +110,50 @@ class Trainer:
         With ``checkpoint`` (a ``CheckpointManager``) the run resumes
         from the latest saved step when ``resume`` and saves every
         ``save_every`` steps plus once at the end — the crash-recovery
-        subsystem the reference lacks entirely (SURVEY.md §5)."""
-        if steps_per_call > 1:
-            if isinstance(self.ex, PipelineExecutor):
-                # Layer-wise strategies cannot FUSE k steps into one
-                # scan (per-stage host dispatch), but the host fence
-                # amortizes the same way: k steps dispatch back-to-back
-                # with ONE device_get per superstep.
-                return self._fit_superstep_pipeline(
+        subsystem the reference lacks entirely (SURVEY.md §5).
+
+        With ``config.telemetry_dir`` (``--telemetry DIR``) the run
+        writes a JSONL event stream — per-step/superstep wall time,
+        fences, losses, checkpoint I/O — and a telemetry summary
+        (fences/step, step-time p50/p95/max) folds into the returned
+        stats under ``"telemetry"`` (OBSERVABILITY.md).  Off = zero
+        overhead, stats and numerics bit-identical."""
+        with _telemetry.maybe_run(self.ex.config):
+            if steps_per_call > 1:
+                if isinstance(self.ex, PipelineExecutor):
+                    # Layer-wise strategies cannot FUSE k steps into one
+                    # scan (per-stage host dispatch), but the host fence
+                    # amortizes the same way: k steps dispatch
+                    # back-to-back with ONE device_get per superstep.
+                    return self._fit_superstep_pipeline(
+                        iterations, batches, warmup, log_every, checkpoint,
+                        save_every, resume, accum_steps, prefetch,
+                        steps_per_call,
+                    )
+                return self._fit_superstep(
                     iterations, batches, warmup, log_every, checkpoint,
                     save_every, resume, accum_steps, prefetch,
                     steps_per_call,
                 )
-            return self._fit_superstep(
+            return self._fit_plain(
                 iterations, batches, warmup, log_every, checkpoint,
-                save_every, resume, accum_steps, prefetch, steps_per_call,
+                save_every, resume, accum_steps, prefetch,
             )
+
+    def _fit_plain(
+        self,
+        iterations: int,
+        batches,
+        warmup: int,
+        log_every: int,
+        checkpoint,
+        save_every: int,
+        resume: bool,
+        accum_steps: int,
+        prefetch: int,
+    ) -> Dict[str, float]:
+        """The per-step (k=1) training loop — see :meth:`fit`."""
+        tel = _telemetry.current()
         ex = self.ex
         if accum_steps > 1:
             accum_fn = ex.accum_train_step(accum_steps)
@@ -164,7 +193,8 @@ class Trainer:
                 params, opt_state, state, m = step_fn(params, opt_state, state, batch)
             start_step += warmup
             if m is not None:
-                jax.device_get(m)  # host readback: the only reliable fence on the relay
+                # host readback: the only reliable fence on the relay
+                tel.fence(m, "warmup")
 
             assert iterations > 0, "fit() needs at least one iteration"
             trace_ctx = contextlib.nullcontext()
@@ -181,19 +211,31 @@ class Trainer:
                 # start_trace spin-up nor stop_trace serialization is
                 # billed to the timed loop.
                 start = time.perf_counter()
+                t_prev = start
                 for it in range(iterations):
                     batch = next(batches)
                     params, opt_state, state, m = step_fn(
                         params, opt_state, state, batch
                     )
+                    if tel.enabled:
+                        # Host-side per-step wall time: in this unfenced
+                        # regime it is the DISPATCH time (the loop never
+                        # blocks on the device) — the percentile feed,
+                        # no extra device_get.
+                        now = time.perf_counter()
+                        tel.record_step(start_step + it, wall_s=now - t_prev)
+                        t_prev = now
                     if log_every and (it + 1) % log_every == 0:
-                        self.metrics.update(jax.device_get(m))
+                        self.metrics.update(tel.fence(m, "log"))
                         print(f"iter {it+1}: {self.metrics.report()}")
+                        t_prev = time.perf_counter()  # drain not a step time
                     if checkpoint is not None and save_every and (it + 1) % save_every == 0:
-                        jax.device_get(m)  # fence: don't bill queued compute to I/O
+                        # fence: don't bill queued compute to I/O
+                        tel.fence(m, "pre_save")
                         t0 = time.perf_counter()
                         checkpoint.save(start_step + it + 1, params, opt_state, state)
                         ckpt_s += time.perf_counter() - t0
+                        t_prev = time.perf_counter()  # I/O not a step time
                     if preempt.triggered:
                         break  # emergency save below, then clean exit
                 completed = it + 1
@@ -202,7 +244,7 @@ class Trainer:
                 # through params.  elapsed is taken here, INSIDE the trace
                 # context, so stop_trace's xplane serialization is not
                 # billed to the timed loop.
-                final_m = jax.device_get(m)
+                final_m = tel.fence(m, "final")
                 elapsed = time.perf_counter() - start - ckpt_s
 
             self.metrics.update(final_m)
@@ -219,7 +261,10 @@ class Trainer:
                 if isinstance(ex, Executor):
                     from flexflow_tpu.runtime.profiler import profile_ops, report
 
-                    print(report(profile_ops(ex, params, state, batch)))
+                    profiles = profile_ops(ex, params, state, batch)
+                    print(report(profiles) if profiles else
+                          "profiling: per-op profile skipped on the axon "
+                          "relay (dispatch-dominated; see telemetry)")
                 else:
                     print("profiling: per-op breakdown unavailable for "
                           "pipeline executors")
@@ -240,9 +285,11 @@ class Trainer:
                 "loss": float(self.metrics.avg_loss),
             }
             if preempt.triggered:
+                tel.emit("preempt", step=start_step + completed,
+                         signum=preempt.signum)
                 stats["preempted"] = True
                 stats["checkpoint_step"] = start_step + completed
-            return stats
+            return tel.fold_stats(stats)
         finally:
             preempt.__exit__(None, None, None)
             if owned_prefetch is not None:
@@ -283,6 +330,7 @@ class Trainer:
         ``iterations`` tail runs as one shorter superstep (a second
         compile — prefer ``iterations % k == 0``).
         """
+        tel = _telemetry.current()
         ex = self.ex
         if not isinstance(ex, Executor):
             raise ValueError(
@@ -380,7 +428,7 @@ class Trainer:
                 )
             start_step += warm_calls * k
             if ms is not None:
-                jax.device_get(ms)  # fence: compile outside the timed loop
+                tel.fence(ms, "warmup")  # compile outside the timed loop
 
             trace_ctx = contextlib.nullcontext()
             if ex.config.trace_dir:
@@ -396,6 +444,7 @@ class Trainer:
                 for n in timed:
                     if n not in step_fns:
                         step_fns[n] = ex.build_superstep(n, accum_steps)
+                    t_call = time.perf_counter()
                     superbatch = next(batches)
                     params, opt_state, state, ms = step_fns[n](
                         params, opt_state, state, superbatch
@@ -403,9 +452,22 @@ class Trainer:
                     # ONE host readback per superstep: the execution
                     # fence AND the stacked per-step metrics, unstacked
                     # so the loss curve is bit-identical to k=1.
-                    host_ms = jax.device_get(ms)
+                    host_ms = tel.fence(ms, "superstep")
+                    wall = time.perf_counter() - t_call
+                    if tel.enabled:
+                        tel.emit("superstep", k=n, mode="fused",
+                                 wall_s=round(wall, 6),
+                                 first_step=start_step + steps_done)
                     for j in range(n):
-                        self.metrics.update(Executor.metrics_row(host_ms, j))
+                        row = Executor.metrics_row(host_ms, j)
+                        if tel.enabled:
+                            loss = row.get("train_loss")
+                            tel.record_step(
+                                start_step + steps_done,
+                                loss=None if loss is None else float(loss),
+                                wall_s=wall / n,
+                            )
+                        self.metrics.update(row)
                         steps_done += 1
                         if log_every and steps_done % log_every == 0:
                             print(f"iter {steps_done}: {self.metrics.report()}")
@@ -442,7 +504,10 @@ class Trainer:
                     )
                     for key, v in superbatch.items()
                 }
-                print(report(profile_ops(ex, params, state, one)))
+                profiles = profile_ops(ex, params, state, one)
+                print(report(profiles) if profiles else
+                      "profiling: per-op profile skipped on the axon "
+                      "relay (dispatch-dominated; see telemetry)")
             batch_size = ex.model.input_tensors[0].shape[0]
             throughput = steps_done * batch_size / elapsed
             print(f"time = {elapsed:.4f}s")
@@ -458,9 +523,11 @@ class Trainer:
                 "supersteps": len(timed),
             }
             if preempt.triggered:
+                tel.emit("preempt", step=start_step + steps_done,
+                         signum=preempt.signum)
                 stats["preempted"] = True
                 stats["checkpoint_step"] = start_step + steps_done
-            return stats
+            return tel.fold_stats(stats)
         finally:
             preempt.__exit__(None, None, None)
             if owned_prefetch is not None:
@@ -503,6 +570,7 @@ class Trainer:
         timed region), so finite ``batches`` keep the k=1 contract:
         ``warmup + iterations`` batches.
         """
+        tel = _telemetry.current()
         ex = self.ex
         assert iterations > 0, "fit() needs at least one iteration"
         if accum_steps > 1:
@@ -549,7 +617,7 @@ class Trainer:
                 )
             start_step += warmup
             if m is not None:
-                jax.device_get(m)  # fence: compiles outside the timed loop
+                tel.fence(m, "warmup")  # compiles outside the timed loop
 
             trace_ctx = contextlib.nullcontext()
             if ex.config.trace_dir:
@@ -563,22 +631,38 @@ class Trainer:
                 start = time.perf_counter()
                 while steps_done < iterations:
                     n = min(k, iterations - steps_done)
+                    t_call = time.perf_counter()
                     ms = []
+                    walls = []
                     for _ in range(n):
+                        t_disp = time.perf_counter()
                         batch = next(batches)
                         params, opt_state, state, m = ex.train_step(
                             params, opt_state, state, batch
                         )
+                        walls.append(time.perf_counter() - t_disp)
                         ms.append(m)
                     # ONE host readback per superstep: all n steps'
                     # metrics — the fence AND the amortization.
-                    host_ms = jax.device_get(ms)
+                    host_ms = tel.fence(ms, "superstep")
+                    if tel.enabled:
+                        tel.emit("superstep", k=n, mode="amortized",
+                                 wall_s=round(time.perf_counter() - t_call, 6),
+                                 first_step=start_step + steps_done,
+                                 programs_per_step=len(ex.last_schedule))
                     supersteps += 1
                     # Read the preemption flag AFTER the fence, so a
                     # signal landing mid-superstep still exits at THIS
                     # boundary.
                     trig = preempt.triggered
-                    for hm in host_ms:
+                    for i, hm in enumerate(host_ms):
+                        if tel.enabled:
+                            loss = hm.get("train_loss")
+                            tel.record_step(
+                                start_step + steps_done,
+                                loss=None if loss is None else float(loss),
+                                wall_s=walls[i],
+                            )
                         self.metrics.update(hm)
                         steps_done += 1
                         if log_every and steps_done % log_every == 0:
@@ -626,9 +710,11 @@ class Trainer:
                 "supersteps": supersteps,
             }
             if preempt.triggered:
+                tel.emit("preempt", step=start_step + steps_done,
+                         signum=preempt.signum)
                 stats["preempted"] = True
                 stats["checkpoint_step"] = start_step + steps_done
-            return stats
+            return tel.fold_stats(stats)
         finally:
             preempt.__exit__(None, None, None)
             if owned_prefetch is not None:

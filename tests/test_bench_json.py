@@ -68,6 +68,14 @@ def stubbed_bench(monkeypatch):
         }),
     )
     monkeypatch.setattr(
+        bench, "bench_telemetry",
+        lambda n, t: chatty({
+            "fences_per_step": 1.06, "programs_per_step": 8.0,
+            "step_ms_p50": 2.0, "step_ms_p95": 3.0, "step_ms_max": 4.0,
+            "overhead_pct": 0.5,
+        }),
+    )
+    monkeypatch.setattr(
         bench, "bench_op_parallel_speedup",
         lambda n: {"op_parallel_speedup_sim": 1.5},
     )
@@ -94,6 +102,16 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert pipe["s2_mb4_c4_programs"] == 4
     assert pipe["chunk_amortization"] == 2.0
     assert pipe["superstep_k8_ms_per_step"] == 1.5
+    # The telemetry summary block: dispatch/fence counters + host-side
+    # step-time percentiles (the observability layer's headline
+    # numbers, OBSERVABILITY.md).
+    tele = record["extra"]["telemetry"]
+    assert tele["fences_per_step"] == 1.06
+    assert tele["programs_per_step"] == 8.0
+    assert tele["step_ms_p50"] == 2.0
+    assert tele["step_ms_p95"] == 3.0
+    assert tele["step_ms_max"] == 4.0
+    assert tele["overhead_pct"] == 0.5
     # The chatter landed on stderr, not stdout.
     assert "tp = " in err.getvalue()
 
@@ -106,6 +124,7 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     monkeypatch.setattr(stubbed_bench, "bench_dlrm", boom)
     monkeypatch.setattr(stubbed_bench, "bench_superstep", boom)
     monkeypatch.setattr(stubbed_bench, "bench_pipeline", boom)
+    monkeypatch.setattr(stubbed_bench, "bench_telemetry", boom)
     out, err = io.StringIO(), io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     monkeypatch.setattr(sys, "stderr", err)
@@ -116,3 +135,4 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     assert "leg exploded" in record["extra"]["dlrm_error"]
     assert "leg exploded" in record["extra"]["superstep_error"]
     assert "leg exploded" in record["extra"]["pipeline_error"]
+    assert "leg exploded" in record["extra"]["telemetry_error"]

@@ -89,19 +89,30 @@ def test_profiling_flag_prints_breakdown(capsys):
     assert "tp = " in out  # the reference throughput printout
 
 
-def test_relay_guard_warns_on_axon_backend(monkeypatch):
+def test_relay_guard_skips_on_axon_backend(monkeypatch):
     """profile_ops on the axon relay is dispatch-dominated (~16 ms/call
-    floor) and must warn loudly, pointing at the fused-step paths."""
+    floor): ONE warning (the old warnings+logging pair fired twice), a
+    `profile_skipped` telemetry event, and NO meaningless numbers."""
+    import warnings as _warnings
+
     from flexflow_tpu.runtime import profiler
+    from flexflow_tpu.runtime.telemetry import Telemetry
 
     monkeypatch.setattr(profiler, "_on_axon_relay", lambda: True)
     ff = _model()
     ex = Executor(ff)
     params, _, state = ex.init()
-    with pytest.warns(RuntimeWarning, match="dispatch-dominated"):
-        profiles = profile_ops(ex, params, state, _batch(ex), reps=1,
-                               warmup=0)
-    assert profiles  # guard warns but does not block the measurement
+    with Telemetry() as tel:
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            profiles = profile_ops(ex, params, state, _batch(ex), reps=1,
+                                   warmup=0)
+        skipped = tel._last_label == "profile_skipped"
+    relay_warnings = [w for w in caught
+                      if "dispatch-dominated" in str(w.message)]
+    assert len(relay_warnings) == 1  # deduped: exactly one warning
+    assert profiles == []  # skipped, not silently dispatch-dominated
+    assert skipped  # the structured profile_skipped event fired
 
 
 def test_relay_detection():

@@ -1,0 +1,378 @@
+"""Structured run telemetry (runtime/telemetry.py; OBSERVABILITY.md).
+
+Pins the observability layer's four contracts:
+
+- **Event schema**: a run's JSONL stream opens with ``run_start``,
+  closes with ``run_end``, every event carries ``ts``/``seq``/``ev``,
+  ``seq`` is strictly increasing and ``ts`` non-decreasing.
+- **Dispatch audit**: the pipeline's host-programs-per-step counter
+  equals ``len(last_schedule)`` across chunk settings.
+- **Chaos reconstruction**: a resilient run's log contains
+  fault → rollback → replay (and checkpoint save/restore) in order,
+  and replaying the step events yields the same step count and final
+  loss as the live run's stats dict.
+- **Off-path purity**: telemetry off leaves trainer numerics and the
+  stats dict bit-identical (and enabled telemetry adds no fences —
+  fences/step is exactly the un-telemetered ``device_get`` count).
+"""
+
+import json
+import logging
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+from flexflow_tpu.runtime import telemetry
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.pipeline import PipelineExecutor
+from flexflow_tpu.runtime.telemetry import NULL, Telemetry
+from flexflow_tpu.runtime.trainer import Trainer
+
+
+def _model(batch=8, depth=2, seed=11):
+    ff = FFModel(FFConfig(batch_size=batch, seed=seed))
+    x = ff.create_tensor((batch, 16), name="x")
+    lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+    t = x
+    for i in range(depth):
+        t = ff.dense(t, 32, activation="relu", name=f"fc{i}")
+    t = ff.dense(t, 4, name="head")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+def _executor(seed=11):
+    return Executor(_model(seed=seed), optimizer=SGDOptimizer(lr=0.1))
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _batch(rng, batch=8):
+    return {
+        "x": rng.standard_normal((batch, 16)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(batch,)).astype(np.int32),
+    }
+
+
+# -- event schema ----------------------------------------------------------
+
+
+def test_event_schema_golden(tmp_path):
+    with Telemetry(str(tmp_path)) as tel:
+        stats = Trainer(_executor()).fit(iterations=4, warmup=1, log_every=2)
+    events = _events(tel.path)
+    assert events[0]["ev"] == "run_start"
+    assert events[-1]["ev"] == "run_end"
+    for e in events:
+        assert {"ts", "seq", "ev"} <= set(e)
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    tss = [e["ts"] for e in events]
+    assert tss == sorted(tss)  # monotonic timestamps
+    steps = [e for e in events if e["ev"] == "step"]
+    assert [e["step"] for e in steps] == [1, 2, 3, 4]  # warmup offsets
+    assert all(e["wall_s"] > 0 for e in steps)
+    fences = [e for e in events if e["ev"] == "fence"]
+    # The k=1 loop's real fences, wrapped not added: warmup, the two
+    # log_every readbacks, and the final execution fence.
+    assert [e["label"] for e in fences] == ["warmup", "log", "log", "final"]
+    # run_end embeds the same summary fit folded into its stats.
+    assert events[-1]["summary"] == stats["telemetry"]
+    assert stats["telemetry"]["fences_per_step"] == 1.0
+    assert (stats["telemetry"]["step_ms_p50"]
+            <= stats["telemetry"]["step_ms_p95"]
+            <= stats["telemetry"]["step_ms_max"])
+
+
+def test_superstep_one_fence_per_superstep(tmp_path):
+    with Telemetry(str(tmp_path)) as tel:
+        stats = Trainer(_executor()).fit(iterations=8, warmup=2,
+                                         steps_per_call=4)
+    events = _events(tel.path)
+    ss = [e for e in events if e["ev"] == "superstep"]
+    assert len(ss) == 2 and all(e["k"] == 4 and e["mode"] == "fused"
+                                for e in ss)
+    timed_fences = [e for e in events
+                    if e["ev"] == "fence" and e["label"] == "superstep"]
+    assert len(timed_fences) == 2  # the amortization, visible in the log
+    steps = [e for e in events if e["ev"] == "step"]
+    assert len(steps) == 8 and all("loss" in e for e in steps)
+    assert stats["telemetry"]["steps"] == 8
+
+
+# -- pipeline dispatch audit ----------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 4])
+def test_programs_per_step_equals_last_schedule(chunk):
+    import jax
+
+    ff = _model(batch=16, depth=2)
+    st = StrategyStore(8)
+    st.set("fc0", ParallelConfig(n=4, device_ids=(0, 1, 2, 3)))
+    for name in ("fc1", "head", "softmax"):
+        st.set(name, ParallelConfig(n=4, device_ids=(4, 5, 6, 7)))
+    pipe = PipelineExecutor(
+        ff, st, optimizer=SGDOptimizer(lr=0.1), microbatches=4, chunk=chunk,
+    )
+    params, opt_state, state = pipe.init()
+    batch = pipe.shard_batch(_batch(np.random.default_rng(0), batch=16))
+    with Telemetry() as tel:
+        for _ in range(2):
+            params, opt_state, state, m = pipe.train_step(
+                params, opt_state, state, batch
+            )
+        jax.device_get(m)
+    expected = 2 * 2 * -(-4 // chunk)  # 2*S*ceil(m/c)
+    assert len(pipe.last_schedule) == expected
+    assert tel.counts["host_programs"] == 2 * expected
+    assert tel.step_summary()["programs_per_step"] == expected
+
+
+# -- chaos reconstruction --------------------------------------------------
+
+
+def test_chaos_log_reconstructs_run(tmp_path):
+    from flexflow_tpu.runtime.chaos import chaos_batch_fn, tiny_factory
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    from flexflow_tpu.runtime.resilience import (
+        FailurePolicy,
+        FaultInjector,
+        ResilientTrainer,
+    )
+
+    iters = 16
+    with Telemetry(str(tmp_path / "tel")) as tel:
+        with CheckpointManager(str(tmp_path / "ck"), async_save=True) as ck:
+            rt = ResilientTrainer(
+                tiny_factory(), ck, policy=FailurePolicy(max_restarts=3),
+                fault_injector=FaultInjector(nan_loss_at=(11,)),
+            )
+            out = rt.fit(iterations=iters, batch_fn=chaos_batch_fn,
+                         save_every=8, steps_per_call=8)
+    assert out["restarts"] == 1
+    events = _events(tel.path)
+    tss = [e["ts"] for e in events]
+    assert tss == sorted(tss)  # monotonic across fault/rollback/replay
+    kinds = [e["ev"] for e in events]
+    # fault -> rollback -> (restore) -> replay, in order.
+    i_fault = kinds.index("fault")
+    i_roll = kinds.index("rollback")
+    i_replay = kinds.index("replay")
+    assert i_fault < i_roll < i_replay
+    assert events[i_fault]["mode"] == "nan_loss"
+    assert events[i_fault]["step"] == 11
+    assert events[i_roll]["restart"] == 1
+    assert "StepFailure" in events[i_roll]["reason"]
+    # The rollback restored the step-8 snapshot and replayed from it.
+    restores = [e for e in events if e["ev"] == "ckpt_restore"]
+    assert any(e["step"] == 8 for e in restores)
+    assert events[i_replay]["from_step"] == 8
+    saves = [e for e in events if e["ev"] == "ckpt_save"]
+    assert {e["step"] for e in saves} >= {8, 16}
+    assert all(e["io_s"] >= 0 for e in saves + restores)
+    assert all(e["async"] for e in saves)
+    # Replaying the log alone reproduces the live run: last step event
+    # per index IS the validated loss (replays overwrite).
+    replayed = {}
+    for e in events:
+        if e["ev"] == "step":
+            replayed[e["step"]] = e["loss"]
+    assert sorted(replayed) == list(range(iters))
+    assert replayed == out["losses"]
+    assert replayed[iters - 1] == out["loss"]
+    assert out["telemetry"]["steps"] == len(
+        [e for e in events if e["ev"] == "step"]
+    )
+
+
+# -- off-path purity -------------------------------------------------------
+
+
+def test_telemetry_off_is_bit_identical():
+    stats_off = Trainer(_executor(seed=3)).fit(iterations=4, warmup=1)
+    with Telemetry() as tel:
+        stats_on = Trainer(_executor(seed=3)).fit(iterations=4, warmup=1)
+    # Off: the pre-PR stats surface, nothing folded in.
+    assert sorted(stats_off) == [
+        "batch_size", "elapsed_s", "iterations", "loss", "samples_per_s",
+    ]
+    # Numerics identical bit for bit; only the "telemetry" key differs.
+    assert stats_on["loss"] == stats_off["loss"]
+    assert stats_on["iterations"] == stats_off["iterations"]
+    assert "telemetry" in stats_on
+    # The enabled run added NO fences: one warmup + one final readback,
+    # exactly the device_get count the un-telemetered loop performs.
+    assert tel.counts["fences"] == 2
+
+
+def test_null_telemetry_fence_is_device_get():
+    import jax.numpy as jnp
+
+    assert telemetry.current() is NULL
+    host = NULL.fence({"a": jnp.float32(2.0)}, "anything")
+    assert float(host["a"]) == 2.0
+    NULL.record_step(0, loss=1.0)
+    NULL.emit("x", y=1)
+    NULL.add_programs(3)
+    assert NULL.fold_stats({"k": 1}) == {"k": 1}
+
+
+# -- watchdog / heartbeat --------------------------------------------------
+
+
+def test_watchdog_warns_and_recovers(caplog):
+    with caplog.at_level(logging.WARNING, logger="ff.telemetry"):
+        with Telemetry(stall_deadline_s=0.1) as tel:
+            time.sleep(0.45)
+            assert tel._stalled  # fired while no heartbeats arrived
+            tel.heartbeat("step:0")  # the stall clears on its own
+            assert not tel._stalled
+    msgs = [r.message for r in caplog.records]
+    assert any("NO heartbeat" in m and "NOT killing" in m for m in msgs)
+    assert any("resumed" in m for m in msgs)
+
+
+def test_watchdog_warns_once_per_stall(caplog):
+    with caplog.at_level(logging.WARNING, logger="ff.telemetry"):
+        with Telemetry(stall_deadline_s=0.1):
+            time.sleep(0.6)
+    stalls = [r for r in caplog.records if "NO heartbeat" in r.message]
+    assert len(stalls) == 1  # loud once, not a warning storm
+
+
+def test_heartbeat_file(tmp_path, monkeypatch):
+    hb = tmp_path / "heartbeat"
+    with Telemetry(str(tmp_path)) as tel:
+        assert hb.exists()
+        t0 = hb.stat().st_mtime
+        time.sleep(0.02)
+        tel.heartbeat()
+        assert hb.stat().st_mtime >= t0
+    # FF_HEARTBEAT_FILE relocates it (the tpu_watcher.sh wiring).
+    alt = tmp_path / "alt_beat"
+    monkeypatch.setenv("FF_HEARTBEAT_FILE", str(alt))
+    with Telemetry():
+        pass
+    assert alt.exists()
+
+
+# -- config / flags --------------------------------------------------------
+
+
+def test_resilient_trainer_self_installs_from_config(tmp_path):
+    from flexflow_tpu.runtime.chaos import chaos_batch_fn, tiny_factory
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    from flexflow_tpu.runtime.resilience import ResilientTrainer
+
+    make = tiny_factory()
+
+    def factory():
+        ex = make()
+        ex.config.telemetry_dir = str(tmp_path / "tel")
+        ex.config.stall_deadline_s = 0.0
+        return ex
+
+    with CheckpointManager(str(tmp_path / "ck")) as ck:
+        out = ResilientTrainer(factory, ck).fit(
+            iterations=4, batch_fn=chaos_batch_fn, save_every=4,
+        )
+    assert "telemetry" in out and out["telemetry"]["steps"] == 4
+    logs = [p for p in os.listdir(tmp_path / "tel") if p.endswith(".jsonl")]
+    assert len(logs) == 1
+
+
+def test_pipeline_clip_norm_fence_is_instrumented():
+    import jax
+
+    ff = _model(batch=16, depth=2)
+    ff.config.clip_norm = 1.0
+    st = StrategyStore(8)
+    st.set("fc0", ParallelConfig(n=4, device_ids=(0, 1, 2, 3)))
+    for name in ("fc1", "head", "softmax"):
+        st.set(name, ParallelConfig(n=4, device_ids=(4, 5, 6, 7)))
+    pipe = PipelineExecutor(ff, st, optimizer=SGDOptimizer(lr=0.1),
+                            microbatches=2)
+    params, opt_state, state = pipe.init()
+    batch = pipe.shard_batch(_batch(np.random.default_rng(0), batch=16))
+    with Telemetry() as tel:
+        params, opt_state, state, m = pipe.train_step(
+            params, opt_state, state, batch
+        )
+        jax.device_get(m)
+        # The per-step clip-norm device_get is a REAL fence; the
+        # watchdog/counters must see it (the relay-wedge signature).
+        assert tel.counts["fences"] == 1
+
+
+def test_two_runs_same_second_get_distinct_files(tmp_path):
+    # strftime has 1 s resolution; the per-process run counter keeps
+    # back-to-back fits from append-interleaving into one JSONL file.
+    with Telemetry(str(tmp_path)) as a:
+        pass
+    with Telemetry(str(tmp_path)) as b:
+        pass
+    assert a.path != b.path
+    assert len([p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]) == 2
+
+
+def test_cli_flags(tmp_path):
+    cfg = FFConfig.parse_args(
+        ["--telemetry", str(tmp_path), "--stall-deadline", "7.5"]
+    )
+    assert cfg.telemetry_dir == str(tmp_path)
+    assert cfg.stall_deadline_s == 7.5
+    assert FFConfig().telemetry_dir is None  # off by default
+
+
+def test_config_wires_trainer(tmp_path):
+    ex = _executor()
+    ex.config.telemetry_dir = str(tmp_path)
+    ex.config.stall_deadline_s = 0.0
+    stats = Trainer(ex).fit(iterations=2, warmup=1)
+    assert "telemetry" in stats
+    logs = [p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]
+    assert len(logs) == 1
+    events = _events(os.path.join(str(tmp_path), logs[0]))
+    assert events[0]["ev"] == "run_start" and events[-1]["ev"] == "run_end"
+
+
+def test_nested_fit_reports_into_outer_run(tmp_path):
+    ex = _executor()
+    ex.config.telemetry_dir = str(tmp_path)  # would self-install...
+    with Telemetry() as outer:  # ...but an installed run wins
+        Trainer(ex).fit(iterations=2, warmup=1)
+    assert outer.counts["steps"] == 2
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]
+
+
+# -- PerfMetrics extras (satellite) ---------------------------------------
+
+
+def test_perfmetrics_extras_and_report():
+    from flexflow_tpu.metrics import PerfMetrics
+
+    pm = PerfMetrics()
+    pm.update({"train_loss": 1.0, "train_correct": 3, "train_all": 4})
+    base = pm.report()
+    assert base == "[Metrics] loss=1.000000 accuracy=75.00% (3/4)"
+    pm2 = PerfMetrics()
+    pm2.update({"train_loss": 1.0, "train_correct": 3, "train_all": 4,
+                "grad_norm": 2.0})
+    pm2.update({"train_loss": 1.0, "train_correct": 3, "train_all": 4,
+                "grad_norm": 4.0})
+    assert pm2.avg_extra("grad_norm") == 3.0
+    # Reference-format prefix bit-identical; extras append after it.
+    assert pm2.report().startswith(
+        "[Metrics] loss=1.000000 accuracy=75.00% (6/8)"
+    )
+    assert "grad_norm=3.000000" in pm2.report()

@@ -2,9 +2,10 @@
 # Fast pre-commit smoke: the targeted suites from CLAUDE.md covering
 # ops/oracles, strategy numerics, the pipeline runtime (incl. the
 # chunked-scan dispatch + pipeline-superstep numerics,
-# test_pipeline_chunk.py), superstep execution, and the resilience/
-# checkpoint subsystem — ~4 min on the 8-dev virtual CPU mesh, vs
-# ~14 min+ for the full tier-1 run.  Single core box: no pytest-xdist.
+# test_pipeline_chunk.py), superstep execution, the resilience/
+# checkpoint subsystem, and the run-telemetry layer — ~4 min on the
+# 8-dev virtual CPU mesh, vs ~14 min+ for the full tier-1 run.
+# Single core box: no pytest-xdist.
 #
 # Usage: ./tools/tier1_smoke.sh [extra pytest args]
 set -euo pipefail
@@ -17,4 +18,5 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_superstep.py \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
+    tests/test_telemetry.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"

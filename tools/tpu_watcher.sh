@@ -10,12 +10,35 @@
 # hard timeout, tools/probe_tpu.py); the sequence steps are never
 # timeout-killed.
 #
+# Liveness: this watcher and the in-process telemetry stall watchdog
+# (runtime/telemetry.py; OBSERVABILITY.md) share ONE signal — the
+# heartbeat file.  FF_HEARTBEAT_FILE below points every telemetry-
+# enabled run at $OUT/heartbeat, which the run touches on each
+# completed step and fence edge; FF_TELEMETRY_DIR turns telemetry on
+# for the whole sequence so the heartbeat actually flows (and every
+# run leaves a JSONL event log for the postmortem).  On an aborted
+# sequence the watcher reports the heartbeat age: a FRESH heartbeat
+# with a dead sequence means the wedge hit between runs; a STALE one
+# names how long ago the last in-process progress happened — the same
+# number the in-process watchdog warned about.
+#
 # Usage: bash tools/tpu_watcher.sh [interval_s]
 set -u
 cd "$(dirname "$0")/.."
 OUT="${FF_MEASURED_DIR:-MEASURED_r5}"
 mkdir -p "$OUT"
 INTERVAL="${1:-360}"
+
+export FF_HEARTBEAT_FILE="${FF_HEARTBEAT_FILE:-$OUT/heartbeat}"
+export FF_TELEMETRY_DIR="${FF_TELEMETRY_DIR:-$OUT/telemetry}"
+
+hb_age() {
+  if [ -f "$FF_HEARTBEAT_FILE" ]; then
+    echo "$(( $(date +%s) - $(stat -c %Y "$FF_HEARTBEAT_FILE") ))"
+  else
+    echo "-1"
+  fi
+}
 
 while true; do
   if python tools/probe_tpu.py --timeout 120 >> "$OUT/watcher.log" 2>&1; then
@@ -25,6 +48,12 @@ while true; do
     echo "sequence exited rc=$rc at $(date -u +%FT%TZ)" | tee -a "$OUT/watcher.log"
     if [ "$rc" -eq 0 ]; then
       exit 0
+    fi
+    age="$(hb_age)"
+    if [ "$age" -ge 0 ]; then
+      echo "last in-process heartbeat ${age}s ago ($FF_HEARTBEAT_FILE)" | tee -a "$OUT/watcher.log"
+    else
+      echo "no heartbeat file yet ($FF_HEARTBEAT_FILE): sequence died before any telemetry-enabled step" | tee -a "$OUT/watcher.log"
     fi
     echo "sequence aborted (tunnel died mid-run?) — re-arming watcher" | tee -a "$OUT/watcher.log"
   fi
