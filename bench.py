@@ -466,14 +466,35 @@ def bench_pipeline(n_chips: int, on_tpu: bool):
                     stats["elapsed_s"] / iters * 1e3, 3
                 )
                 out[f"{key}_programs"] = len(pipe.last_schedule)
-    # Amortization headline: dispatch-minimal chunk vs per-microbatch
-    # at the deepest swept config.
+            # Compiled whole-step column: the SAME schedule as ONE
+            # jitted program (host programs per step: 2*S*ceil(m/c)
+            # -> 1; numerics bit-identical to the host columns,
+            # tests/test_pipeline_chunk.py).
+            pipe = PipelineExecutor(
+                ff, store(S),
+                optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                microbatches=mb, compiled=True,
+            )
+            stats = Trainer(pipe).fit(iterations=iters, warmup=1)
+            out[f"s{S}_mb{mb}_compiled_ms_per_step"] = round(
+                stats["elapsed_s"] / iters * 1e3, 3
+            )
+            out[f"s{S}_mb{mb}_compiled_programs"] = len(pipe.last_schedule)
+    # Amortization headlines at the deepest swept config:
+    # dispatch-minimal chunk vs per-microbatch, and the compiled
+    # whole-step program vs that chunked host floor.
     S, mb = sweep_S[-1], 8
     out["chunk_amortization"] = round(
         out[f"s{S}_mb{mb}_c1_ms_per_step"]
         / out[f"s{S}_mb{mb}_c{mb}_ms_per_step"], 3
     )
-    # Pipeline superstep: k=8 steps under one device_get fence.
+    out["compiled_speedup"] = round(
+        out[f"s{S}_mb{mb}_c{mb}_ms_per_step"]
+        / out[f"s{S}_mb{mb}_compiled_ms_per_step"], 3
+    )
+    # Pipeline supersteps: k=8 steps under one device_get fence —
+    # host-driven (fence-amortized) vs compiled (ONE fused dispatch:
+    # 1/k host programs per step).
     pipe = PipelineExecutor(
         ff, store(sweep_S[0]),
         optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
@@ -481,6 +502,15 @@ def bench_pipeline(n_chips: int, on_tpu: bool):
     )
     stats = Trainer(pipe).fit(iterations=iters, warmup=1, steps_per_call=8)
     out["superstep_k8_ms_per_step"] = round(
+        stats["elapsed_s"] / iters * 1e3, 3
+    )
+    pipe = PipelineExecutor(
+        ff, store(sweep_S[0]),
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+        microbatches=4, compiled=True,
+    )
+    stats = Trainer(pipe).fit(iterations=iters, warmup=8, steps_per_call=8)
+    out["superstep_k8_compiled_ms_per_step"] = round(
         stats["elapsed_s"] / iters * 1e3, 3
     )
     return out

@@ -51,9 +51,14 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --profiling   --dry-run   --remat   --trace DIR   --ones-init   --zc-dataset
   --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
   --pipeline-chunk C (scan C microbatches per stage program)
+  --pipeline-compiled (ONE jitted program per pipeline step: fence-free
+                       compiled IR; makes --steps-per-call fuse and
+                       --resilient compose at K>1 on layer-wise
+                       strategies)
   --granules N   --zero-opt
-  --steps-per-call K (superstep: fused scan on full-mesh strategies,
-                      one-fence-per-K amortization on pipeline ones)
+  --steps-per-call K (superstep: fused scan on full-mesh strategies
+                      and compiled pipelines, one-fence-per-K
+                      amortization on host-driven pipeline ones)
   --eval-iters N (held-out eval after training)   --clip-norm F
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)
@@ -289,12 +294,13 @@ def _run_resilient(
     from flexflow_tpu.runtime.checkpoint import CheckpointManager
     from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer
 
-    if isinstance(first_ex, PipelineExecutor) and cfg.steps_per_call > 1:
+    if (isinstance(first_ex, PipelineExecutor) and cfg.steps_per_call > 1
+            and not first_ex.superstep_fused):
         raise SystemExit(
-            "--resilient --steps-per-call K>1 requires full-mesh "
-            "strategies (ResilientTrainer's superstep path drives "
-            "Executor.build_superstep); layer-wise strategies compose "
-            "with --resilient at steps-per-call 1"
+            "--resilient --steps-per-call K>1 requires a fused "
+            "superstep (full-mesh strategies, or a layer-wise one "
+            "with --pipeline-compiled); host-driven layer-wise "
+            "strategies compose with --resilient at steps-per-call 1"
         )
     if cfg.accum_steps > 1:
         raise SystemExit(
@@ -434,13 +440,10 @@ def _run_training(
         microbatches=cfg.microbatches,
         schedule=cfg.pipeline_schedule,
         chunk=cfg.pipeline_chunk,
+        compiled=cfg.pipeline_compiled,
+        accum_steps=cfg.accum_steps,
     )
     if isinstance(ex, PipelineExecutor):
-        if cfg.accum_steps > 1:
-            raise SystemExit(
-                "--accum-steps composes with full-mesh strategies only; "
-                "pipeline strategies microbatch via --microbatches"
-            )
         if mesh_plan is not None:
             raise SystemExit(
                 "--granules (hybrid mesh) and device-subset placement "
@@ -471,6 +474,8 @@ def _run_training(
                 ff, strategy, config=cfg, optimizer=make_optimizer(cfg),
                 mesh_plan=mesh_plan, microbatches=cfg.microbatches,
                 schedule=cfg.pipeline_schedule, chunk=cfg.pipeline_chunk,
+                compiled=cfg.pipeline_compiled,
+                accum_steps=cfg.accum_steps,
             )
 
         return _run_resilient(ff, cfg, executor_factory, ex, arrays,

@@ -98,6 +98,17 @@ class FFConfig:
     # Memory: the 1F1B live-activation bound becomes chunk-granular
     # ((S-si)*C microbatches per stage).
     pipeline_chunk: int = 1
+    # --pipeline-compiled: compile the WHOLE multi-stage pipeline step
+    # into ONE jitted program on a shared stage mesh (every stage's
+    # microbatch scan, the boundary exchange, clip-norm and the
+    # optimizer updates — fence-free compiled IR; host programs per
+    # step drop from 2*S*ceil(m/C) to 1).  Makes layer-wise strategies
+    # genuinely superstep-capable: --steps-per-call K then fuses K
+    # steps into one dispatch + one device_get (superstep_mode
+    # "fused"), and --resilient composes at K>1.  Numerics are
+    # bit-identical to the host-driven path (the fallback + numerics
+    # oracle, kept; unsupported combinations fall back loudly).
+    pipeline_compiled: bool = False
     # Compute-free graph/shape validation (the reference's
     # DISABLE_COMPUTATION build, ``ops.h:19``): trace the full train
     # step under jax.eval_shape and print the op/param table, running
@@ -292,6 +303,8 @@ class FFConfig:
                         f"--pipeline-chunk must be >= 1, got "
                         f"{cfg.pipeline_chunk}"
                     )
+            elif a == "--pipeline-compiled":
+                cfg.pipeline_compiled = True
             elif a == "--search":
                 cfg.search_iters = cfg.search_iters or 20_000
             elif a == "--search-iters":

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding
 
 from flexflow_tpu.initializers import GlorotUniform, ZeroInitializer
 from flexflow_tpu.ops.activations import apply_activation, check_activation
@@ -66,6 +68,28 @@ class Linear(Op):
 
     def forward(self, params, xs, state, training):
         (x,) = xs
+        plan = getattr(self, "_plan", None)
+        if plan is not None and plan.assign(self._pc).get("c"):
+            # Pin the input REPLICATED along its contraction dim before
+            # the dot.  Under a c-split the input arrives feature-
+            # sharded, and GSPMD then has two algebraically-equal
+            # lowerings: all-gather + full-K dot (this op's documented
+            # design, the reference's aliased input partition,
+            # ``linear.cu:100-138``) or partial-K dot + all-reduce.
+            # Its cost model picks PER MESH LAYOUT — measured: the
+            # compiled-pipeline mesh flipped to partial-K while the
+            # stand-alone submesh gathers, a 1-ulp gradient drift that
+            # breaks the compiled-pipeline bit-identity gate.  The
+            # constraint removes the partial-K option, making Linear's
+            # reduction order mesh-invariant.
+            spec = plan.spec(
+                self._pc,
+                tuple(self.inputs[0].dim_axes[:-1]) + (None,),
+                x.shape,
+            )
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(plan.mesh, spec)
+            )
         # bf16 operands accumulate in f32 on the MXU by default.
         y = jnp.dot(x, params["kernel"].T)
         if self.attrs["use_bias"]:

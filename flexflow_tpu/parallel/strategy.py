@@ -114,39 +114,47 @@ class StrategyStore:
     def __contains__(self, op_name: str) -> bool:
         return op_name in self.table
 
-    def superstep_mode(self) -> str:
+    def superstep_mode(self, compiled: bool = False) -> str:
         """How ``steps_per_call > 1`` (superstep execution) realizes
         this strategy — every strategy family supports supersteps, in
         one of two forms:
 
-        - ``"fused"``: every op spans the full mesh, so
-          ``Executor.build_superstep`` compiles K train steps into ONE
-          ``lax.scan`` dispatch (dispatch AND fence both amortize).
+        - ``"fused"``: K train steps compile into ONE ``lax.scan``
+          dispatch (dispatch AND fence both amortize).  Full-mesh
+          strategies get this through ``Executor.build_superstep``;
+          layer-wise strategies get it when the COMPILED pipeline step
+          runs (``compiled=True``: ``PipelineExecutor`` with
+          ``--pipeline-compiled`` folds the whole multi-stage step
+          into one program on the shared stage mesh, which the same
+          donated-carry scan then fuses —
+          ``PipelineExecutor.build_superstep``).
         - ``"amortized"``: layer-wise placement (``device_ids`` naming
           a proper device subset, the reference's per-op ``gpu[]``
-          lists) runs through ``PipelineExecutor``, whose per-stage
-          host dispatch a single scan cannot fuse — K steps instead
-          dispatch back-to-back sharing ONE ``jax.device_get`` fence
-          per superstep (``Trainer._fit_superstep_pipeline``), and the
-          per-step dispatch count is cut separately by the pipeline
-          ``chunk`` factor.
+          lists) on the HOST-DRIVEN ``PipelineExecutor`` path, whose
+          per-stage dispatch a single scan cannot fuse — K steps
+          instead dispatch back-to-back sharing ONE ``jax.device_get``
+          fence per superstep (``Trainer._fit_superstep_pipeline``),
+          and the per-step dispatch count is cut separately by the
+          pipeline ``chunk`` factor.
         """
         layer_wise = any(
             pc.device_ids is not None
             and len(set(pc.device_ids)) < self.num_devices
             for pc in self.table.values()
         )
-        return "amortized" if layer_wise else "fused"
+        return "amortized" if layer_wise and not compiled else "fused"
 
-    def superstep_capable(self) -> bool:
-        """Whether ``Executor.build_superstep`` (the FUSED superstep:
-        K train steps in one compiled dispatch) can realize this
-        strategy.  False means layer-wise placement — supersteps still
-        exist but only as the fence-amortized pipeline form (see
-        :meth:`superstep_mode`); ``build_superstep`` callers must
-        refuse loudly rather than silently fall back to per-step
-        dispatch."""
-        return self.superstep_mode() == "fused"
+    def superstep_capable(self, compiled: bool = False) -> bool:
+        """Whether the FUSED superstep (K train steps in one compiled
+        dispatch) can realize this strategy — ``Executor.build_superstep``
+        for full-mesh strategies, ``PipelineExecutor.build_superstep``
+        for layer-wise ones on the compiled-step path
+        (``compiled=True``).  False means host-driven layer-wise
+        placement — supersteps still exist but only as the
+        fence-amortized pipeline form (see :meth:`superstep_mode`);
+        ``build_superstep`` callers must refuse loudly rather than
+        silently fall back to per-step dispatch."""
+        return self.superstep_mode(compiled=compiled) == "fused"
 
     # -- (de)serialization ------------------------------------------------
 

@@ -56,6 +56,34 @@ def tiny_factory() -> Callable[[], Executor]:
     return make
 
 
+def pipeline_factory() -> Callable[[], "Executor"]:
+    """Executor factory for the pipeline chaos scenario: the same tiny
+    MLP split layer-wise over two 4-device stages, on the COMPILED
+    whole-step path (``compiled=True``) — the fused k=8 superstep the
+    k>1 rollback machinery needs (host-driven pipelines refuse k>1)."""
+
+    def make():
+        from flexflow_tpu.runtime.pipeline import PipelineExecutor
+
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 16), name="x")
+        lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+        t = ff.dense(x, 32, activation="relu", name="fc1")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        store = StrategyStore(8, {
+            "fc1": ParallelConfig(n=4, device_ids=tuple(range(4))),
+            "fc2": ParallelConfig(n=4, device_ids=tuple(range(4, 8))),
+            "softmax": ParallelConfig(n=4, device_ids=tuple(range(4, 8))),
+        })
+        return PipelineExecutor(
+            ff, store, optimizer=SGDOptimizer(lr=0.1),
+            microbatches=2, compiled=True,
+        )
+
+    return make
+
+
 def chaos_batch_fn(step: int) -> Dict[str, np.ndarray]:
     """Deterministic per-step batches: replayed steps see identical
     data, which is what pins the recovered trajectory bit-identical."""
@@ -72,11 +100,12 @@ def fit_once(
     k: int = K,
     iters: int = ITERS,
     save_every: int = SAVE_EVERY,
+    factory: Optional[Callable[[], Callable]] = None,
 ) -> Dict:
     """One ResilientTrainer run against ``ck_dir`` (async saves on)."""
     with CheckpointManager(ck_dir, async_save=True) as ck:
         rt = ResilientTrainer(
-            tiny_factory(), ck,
+            (factory or tiny_factory)(), ck,
             policy=FailurePolicy(max_restarts=3),
             fault_injector=injector,
         )
@@ -92,17 +121,21 @@ def trajectory(losses: Dict[int, float], iters: int) -> np.ndarray:
     return np.array([losses[i] for i in range(iters)])
 
 
-_BASELINES: Dict[Tuple[int, int, int], np.ndarray] = {}
+_BASELINES: Dict[Tuple[str, int, int, int], np.ndarray] = {}
 
 
 def baseline(root: str, k: int = K, iters: int = ITERS,
-             save_every: int = SAVE_EVERY) -> np.ndarray:
+             save_every: int = SAVE_EVERY,
+             factory: Optional[Callable] = None,
+             tag: str = "tiny") -> np.ndarray:
     """The unfaulted ``steps_per_call=k`` trajectory (cached per shape
-    — it is deterministic, so one compute serves every scenario)."""
-    key = (k, iters, save_every)
+    and factory — it is deterministic, so one compute serves every
+    scenario)."""
+    key = (tag, k, iters, save_every)
     if key not in _BASELINES:
-        out = fit_once(os.path.join(root, f"baseline_k{k}_{iters}"),
-                       k=k, iters=iters, save_every=save_every)
+        out = fit_once(os.path.join(root, f"baseline_{tag}_k{k}_{iters}"),
+                       k=k, iters=iters, save_every=save_every,
+                       factory=factory)
         assert out["restarts"] == 0 and not out["preempted"]
         _BASELINES[key] = trajectory(out["losses"], iters)
     return _BASELINES[key]
@@ -230,6 +263,27 @@ def scenario_force_save_kill(root: str) -> Tuple[bool, str]:
                   "checkpoint (write-new-then-retire)")
 
 
+def scenario_pipeline_superstep_nan(root: str) -> Tuple[bool, str]:
+    """ResilientTrainer x COMPILED pipeline at k=8: a silent NaN loss
+    inside the second fused pipeline superstep is caught at its single
+    fence (the stacked per-step metrics scan), rolled back to the
+    step-8 checkpoint — per-stage ``{si: ...}`` trees through orbax —
+    and replayed bit-identically.  Host-driven pipelines refuse k>1;
+    the compiled whole-step path is what makes this composition exist
+    at all (ISSUE 5)."""
+    inj = FaultInjector(nan_loss_at=(11,))
+    out = fit_once(os.path.join(root, "pipe_nan"), inj,
+                   factory=pipeline_factory)
+    if out["restarts"] != 1:
+        return False, (f"pipeline_superstep_nan: expected 1 restart, "
+                       f"got {out['restarts']}")
+    return _compare(
+        "pipeline_superstep_nan",
+        baseline(root, factory=pipeline_factory, tag="pipeline"),
+        trajectory(out["losses"], ITERS), out,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "raised_fault": scenario_raised_fault,
     "nan_batch": scenario_nan_batch,
@@ -237,6 +291,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "sigterm": scenario_sigterm,
     "corrupt_checkpoint": scenario_corrupt_checkpoint,
     "force_save_kill": scenario_force_save_kill,
+    "pipeline_superstep_nan": scenario_pipeline_superstep_nan,
 }
 
 

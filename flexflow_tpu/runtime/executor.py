@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from flexflow_tpu.config import FFConfig
@@ -72,8 +73,16 @@ def mean_metrics(
     accumulator): integer-dtype metrics are COUNTS (samples, correct
     predictions) and sum across microbatches; float metrics are means
     and average.  ``stacked=True`` reduces a leading microbatch axis;
-    otherwise ``metrics`` are already summed and ``count`` divides the
-    float entries."""
+    otherwise ``metrics`` are already summed and the float entries are
+    averaged by an EXPLICIT reciprocal multiply, not a division: the
+    count path runs both eagerly (host pipeline ``_finish_step``) and
+    inside the compiled whole-step pipeline program, and XLA's
+    algebraic simplifier rewrites an in-program division by a non-
+    power-of-two literal into multiply-by-reciprocal while the eager
+    dispatch keeps the true (1-ulp-different) division — writing the
+    multiply ourselves makes the two runtimes share one formula
+    (``optimization_barrier`` cannot pin it: this XLA vintage strips
+    barriers before the simplifier runs, measured 2026-08-04)."""
     if stacked:
         return {
             k: jnp.sum(v, axis=0)
@@ -81,8 +90,9 @@ def mean_metrics(
             else jnp.mean(v, axis=0)
             for k, v in metrics.items()
         }
+    inv = np.float32(1.0) / np.float32(count)
     return {
-        k: v if jnp.issubdtype(v.dtype, jnp.integer) else v / count
+        k: v if jnp.issubdtype(v.dtype, jnp.integer) else v * inv
         for k, v in metrics.items()
     }
 
@@ -656,6 +666,15 @@ class Executor:
         return out
 
     # -- superstep execution -------------------------------------------------
+
+    @property
+    def superstep_fused(self) -> bool:
+        """Whether ``steps_per_call > 1`` fuses into one compiled
+        dispatch here — always true for this executor (its constructor
+        rejects layer-wise placement).  ``PipelineExecutor`` exposes
+        the same property (true on the compiled-step path); the
+        trainer and resilience layer route on it."""
+        return self.strategy.superstep_capable()
 
     def build_superstep(self, k: int, accum_steps: int = 1):
         """K full train steps compiled into ONE jitted dispatch.

@@ -39,12 +39,18 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.ops.base import Op, TensorSpec
+from flexflow_tpu.ops.linear import Linear
 from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.parallel.mesh import (
+    InfeasibleStrategyError,
+    build_stage_mesh_plan,
+)
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 from flexflow_tpu.runtime import telemetry as _telemetry
 from flexflow_tpu.runtime.executor import Executor, _merge_metrics, mean_metrics
@@ -56,6 +62,12 @@ class PlacementError(ValueError):
     pass
 
 
+class CompiledPipelineUnsupported(PlacementError):
+    """The compiled whole-step path cannot realize this model/strategy
+    combination; callers (``make_executor``) fall back LOUDLY to the
+    host-driven pipeline, which supports everything."""
+
+
 @dataclasses.dataclass
 class Stage:
     index: int
@@ -65,6 +77,35 @@ class Stage:
     in_names: List[str]
     #: tensors this stage produces that later stages consume
     out_names: List[str]
+
+
+def _clip_scale_f32(total_sq, clip: float):
+    """Clip-norm scale from the summed per-stage squared norms, all in
+    float32 (traced form).  ``_clip_scale_f32_host`` is the bit-exact
+    numpy mirror the host-driven path applies after its fence — one
+    formula, two runtimes, so the compiled step (which folds this into
+    the program, fence-free) stays bit-identical to the host path.
+    sqrt/divide/min are correctly-rounded IEEE f32 in both numpy and
+    XLA:CPU, which is what makes the mirror exact; ``rsqrt`` (an
+    approximate op) is deliberately avoided."""
+    return jnp.minimum(
+        jnp.float32(1.0),
+        jnp.float32(clip)
+        / jnp.maximum(jnp.sqrt(total_sq), jnp.float32(1e-15)),
+    )
+
+
+def _clip_scale_f32_host(sqs, clip: float) -> float:
+    """Host mirror of :func:`_clip_scale_f32`: fold the fenced per-stage
+    squared norms in stage order with f32 arithmetic."""
+    total = np.float32(sqs[0])
+    for x in sqs[1:]:
+        total = total + np.float32(x)
+    return float(np.minimum(
+        np.float32(1.0),
+        np.float32(clip)
+        / np.maximum(np.sqrt(total), np.float32(1e-15)),
+    ))
 
 
 class _StageModel:
@@ -215,6 +256,50 @@ class PipelineExecutor:
     The memory tradeoff is explicit: the 1F1B live-activation bound
     becomes chunk-granular (at most ``(S-si)*c`` microbatch
     activations live per stage instead of ``S-si``).
+
+    ``compiled=True`` (``--pipeline-compiled``) replaces the
+    host-orchestrated event loop with ONE jitted whole-step program on
+    a shared stage-shaped mesh (:func:`~flexflow_tpu.parallel.mesh.
+    build_stage_mesh_plan`): every stage's microbatch ``lax.scan``
+    (forward AND remat backward), the boundary activation/cotangent
+    exchange, global clip-norm, and the per-stage optimizer updates
+    are a single compiled dispatch — host programs per step drop from
+    ``2*S*ceil(m/c)`` to 1, and the step becomes fence-free compiled
+    IR, which is what lets :meth:`build_superstep` wrap it in the
+    donated-carry ``lax.scan`` (one dispatch + one ``device_get`` per
+    k steps; ``StrategyStore.superstep_mode(compiled=True)`` ==
+    ``"fused"``).  Numerics are BIT-identical to the host-driven path:
+    the compiled trace reuses the exact per-stage chunked-scan bodies
+    at ``c=m`` — same accumulation carries, same microbatch order,
+    same cotangent-summation order — and every stage keeps the exact
+    submesh axis factorization (and thus reduction orders) of the
+    host path via the shared stage plan
+    (tests/test_pipeline_chunk.py pins parity incl. dropout, clip-norm
+    and skip connections).  Tradeoffs, stated honestly: ALL stages'
+    params/grads/compute live on ONE stage-group-sized mesh (per-device
+    memory = the sum of every stage's shard — identical to replicating
+    along a stage axis, which each device of a stage-major mesh also
+    pays), and the whole-step program sequences stages as data
+    dependencies rather than overlapping them across device subsets.
+    A manual ``shard_map`` over a stage axis with ``lax.ppermute``
+    boundary exchange would confine each stage's compute to its own
+    devices, but on the baked-in jax 0.4.37/XLA the required
+    partial-auto mode hard-crashes the SPMD partitioner
+    (CollectivePermute/AllGather with manual subgroups:
+    ``spmd_partitioner.cc:512 Check failed:
+    target.IsManualSubgroup()``; reading back a scan-carried remat
+    stash: ``hlo_sharding_util.cc:2750``) — measured 2026-08-04,
+    revisit on the next jax upgrade (ROADMAP; the interim stage-major
+    GSPMD form was measured S x slower — see build_stage_mesh_plan).
+
+    ``accum_steps > 1`` (``--accum-steps`` on layer-wise strategies)
+    lowers gradient accumulation onto the same microbatch machinery:
+    accumulating ``a`` groups of ``m`` microbatches IS the pipeline
+    loop over ``a*m`` microbatches (mean-reduction losses make the
+    microbatch-mean gradient the full-batch gradient either way), so
+    the executor simply multiplies the microbatch count and every
+    execution path — event loop, chunked scan, compiled step —
+    composes unchanged.
     """
 
     def __init__(
@@ -227,6 +312,8 @@ class PipelineExecutor:
         microbatches: int = 1,
         schedule: str = "1f1b",
         chunk: int = 1,
+        compiled: bool = False,
+        accum_steps: int = 1,
     ):
         self.model = model
         self.config = config or model.config
@@ -236,13 +323,31 @@ class PipelineExecutor:
             # re-pin them (Executor.__init__ rejects unrealizable
             # placements the same way).
             raise PlacementError(
-                "--zero-opt supports the full-mesh Executor only; "
-                "layer-wise (device-subset) strategies keep replicated "
-                "optimizer state"
+                "--zero-opt supports the full-mesh Executor only: ZeRO "
+                "moment sharding is per-op over the op's data-parallel "
+                "mesh axes, and layer-wise strategies would need it "
+                "PER-SUBMESH (each stage's moments split over that "
+                "stage's own devices) — not implemented; layer-wise "
+                "strategies keep replicated optimizer state"
             )
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = accum_steps
+        if accum_steps > 1:
+            # Lowering, not a separate path: accumulating a groups of m
+            # microbatches == the microbatch loop over a*m microbatches
+            # (see class docstring).
+            _log.info(
+                "accum_steps=%d on a layer-wise strategy: lowered onto "
+                "the microbatch loop (%d x %d = %d microbatches per "
+                "optimizer step)",
+                accum_steps, accum_steps, microbatches,
+                accum_steps * microbatches,
+            )
+            microbatches = accum_steps * microbatches
         self.microbatches = microbatches
         if chunk < 1:
             raise ValueError(f"pipeline chunk must be >= 1, got {chunk}")
@@ -254,6 +359,7 @@ class PipelineExecutor:
             )
             chunk = microbatches
         self.chunk = chunk
+        self.compiled = bool(compiled)
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.schedule = schedule
@@ -279,7 +385,6 @@ class PipelineExecutor:
             t.name: op for op in model.layers for t in op.outputs
         }
 
-        self.stage_ex: List[Executor] = []
         for st in self.stages:
             for d in st.device_ids:
                 if d >= len(all_devices):
@@ -287,6 +392,58 @@ class PipelineExecutor:
                         f"stage {st.index} places on device {d} but only "
                         f"{len(all_devices)} devices exist"
                     )
+
+        self._stage_plan = None
+        if self.compiled:
+            # Eligibility gate for the compiled whole-step path; every
+            # refusal names the blocker so make_executor can fall back
+            # loudly to the host-driven runtime.
+            if any(
+                strategy.find(op.name).s > 1
+                for st in self.stages for op in st.ops
+            ):
+                raise CompiledPipelineUnsupported(
+                    "compiled pipeline step does not support s-degree "
+                    "(explicit-collective sequence ops) inside stages yet"
+                )
+            for st in self.stages:
+                for op in st.ops:
+                    pc = strategy.find(op.name)
+                    if pc.h > 1 or pc.w > 1:
+                        # Spatial partials reduce across devices; their
+                        # reduction order on the shared stage mesh is
+                        # unverified against the submesh (the c-degree
+                        # needed an explicit pin in Linear.forward —
+                        # same hazard class).
+                        raise CompiledPipelineUnsupported(
+                            f"compiled pipeline step: spatial (h/w) "
+                            f"degree on {op.name!r} is unverified "
+                            f"against the host path's submesh numerics"
+                        )
+                    if pc.c > 1 and not isinstance(op, Linear):
+                        # Linear pins its contraction operand so the
+                        # dot lowers identically on both meshes
+                        # (ops/linear.py); other c-sharded ops keep
+                        # partitioner-chosen reduction orders.
+                        raise CompiledPipelineUnsupported(
+                            f"compiled pipeline step: c-degree on "
+                            f"non-Linear op {op.name!r} is unverified "
+                            f"against the host path's submesh numerics"
+                        )
+            try:
+                self._stage_plan = build_stage_mesh_plan(
+                    [st.device_ids for st in self.stages],
+                    devices=all_devices,
+                )
+            except InfeasibleStrategyError as e:
+                raise CompiledPipelineUnsupported(
+                    f"compiled pipeline step: {e}"
+                ) from e
+            self._compiled_step_fn = None
+            self._compiled_superstep_cache: Dict[int, Any] = {}
+
+        self.stage_ex: List[Executor] = []
+        for st in self.stages:
             sub_devices = [all_devices[d] for d in st.device_ids]
             # Intra-stage strategy: same degrees, no placement, DP
             # fallback sized to the submesh.
@@ -307,7 +464,14 @@ class PipelineExecutor:
                     config=self.config,
                     strategy=sub_store,
                     optimizer=self.optimizer,
-                    devices=sub_devices,
+                    # Compiled mode: every stage compiles against the
+                    # SAME compact stage-shaped mesh, with the exact
+                    # axis factorization a stand-alone submesh gets —
+                    # the per-op strategy mapping is preserved, only
+                    # the device identity changes.  Host mode keeps
+                    # the per-stage submeshes.
+                    mesh_plan=self._stage_plan if self.compiled else None,
+                    devices=None if self.compiled else sub_devices,
                 )
             )
 
@@ -484,29 +648,38 @@ class PipelineExecutor:
             )(params_si)
         return z
 
+    def _abstract_zero_metrics(self, si: int, params_si, prestates, inputs):
+        """Zero metrics tree for stage ``si``'s backward-scan carry:
+        structure from an eval_shape of the stage forward at microbatch
+        shapes (leading chunk dim stripped) — no device compute, and
+        trace-safe (``jax.eval_shape`` only reads shapes/dtypes, so the
+        compiled step can call this on tracers)."""
+        elem = lambda v: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+        p_avals = jax.tree.map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params_si
+        )
+        s_avals = jax.tree.map(elem, prestates)
+        x_avals = jax.tree.map(elem, inputs)
+
+        def f(p, s, xs):
+            _, metrics, _, _ = self.stage_ex[si].forward(
+                p, s, xs, training=True
+            )
+            return metrics
+
+        m_avals = jax.eval_shape(f, p_avals, s_avals, x_avals)
+        return {
+            k: jnp.zeros(a.shape, a.dtype) for k, a in m_avals.items()
+        }
+
     def _zero_metrics(self, si: int, params_si, prestates, inputs):
-        """Cached zero metrics tree (last stage only): structure from
-        an eval_shape of the stage forward at microbatch shapes — no
-        device compute, computed once."""
+        """Cached device-resident zero metrics (host chunked path) —
+        computed once per stage, never donated."""
         z = self._zero_metrics_cache.get(si)
         if z is None:
-            elem = lambda v: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
-            p_avals = jax.tree.map(
-                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params_si
+            z = self._zero_metrics_cache[si] = self._abstract_zero_metrics(
+                si, params_si, prestates, inputs
             )
-            s_avals = jax.tree.map(elem, prestates)
-            x_avals = jax.tree.map(elem, inputs)
-
-            def f(p, s, xs):
-                _, metrics, _, _ = self.stage_ex[si].forward(
-                    p, s, xs, training=True
-                )
-                return metrics
-
-            m_avals = jax.eval_shape(f, p_avals, s_avals, x_avals)
-            z = self._zero_metrics_cache[si] = {
-                k: jnp.zeros(a.shape, a.dtype) for k, a in m_avals.items()
-            }
         return z
 
     @functools.cached_property
@@ -737,7 +910,13 @@ class PipelineExecutor:
         the host fence over ``steps_per_call`` pipeline steps; with
         ``clip_norm > 0`` one batched fence per step remains (the
         global norm couples all stages host-side — the documented
-        one-fence-per-step floor)."""
+        one-fence-per-step floor).  ``compiled=True`` replaces all of
+        this with ONE jitted whole-step program (clip-norm included,
+        no fence floor at all)."""
+        if self.compiled:
+            fn = self.build_compiled_step()
+            self.note_fused_dispatch()
+            return fn(params, opt_state, state, batch)
         if self.chunk > 1:
             grads, stage_state, metrics_acc = self._run_chunked(
                 params, state, batch
@@ -909,20 +1088,19 @@ class PipelineExecutor:
         m = self.microbatches
         S = len(self.stages)
         # --clip-norm: the global L2 norm spans ALL stages' gradients;
-        # per-stage squared norms combine on the host (the pipeline
-        # step is host-orchestrated anyway), then each stage scales —
-        # numerically identical to Executor._clip_grads, keeping the
-        # DP≡strategy invariant under layer-wise placement.  The fetch
-        # is ONE device_get of all S squared norms (each separate fetch
-        # is a ~1.5-16 ms round-trip through the relay).
+        # per-stage squared norms combine on the host (the per-stage
+        # grads live on different submeshes), then each stage scales.
+        # The combine is the shared f32 formula (_clip_scale_f32_host),
+        # bit-identical to the compiled step's in-program hierarchical
+        # clip — and the fetch is ONE device_get of all S squared norms
+        # (each separate fetch is a ~1.5-16 ms round-trip through the
+        # relay).  The compiled path has no fence here at all.
         if self.config.clip_norm > 0.0:
             sqs = _telemetry.current().fence(
                 [self._grad_sq_fns[si](grads[si]) for si in range(S)],
                 "clip_norm",
             )
-            total = sum(float(x) for x in sqs)
-            c = self.config.clip_norm
-            scale = min(1.0, c / max(total ** 0.5, 1e-15))
+            scale = _clip_scale_f32_host(sqs, self.config.clip_norm)
             if scale < 1.0:
                 s_arr = jnp.float32(scale)
                 for si in range(S):
@@ -936,6 +1114,278 @@ class PipelineExecutor:
             )
         m_out = mean_metrics(metrics_acc, count=m)
         return new_params, new_opt, stage_state, m_out
+
+    # -- compiled whole-step path --------------------------------------------
+    #
+    # ONE jitted program per train step on the shared stage mesh: the
+    # exact _run_chunked structure at c=m — per-stage forward scans in
+    # stage order, per-stage remat-backward scans in reverse with the
+    # same cotangent-summation order, the same gradient/metric carries
+    # — plus the clip-norm combine and per-stage optimizer updates,
+    # all inside the trace.  Bit-identity to the host-driven path is
+    # BY CONSTRUCTION (same op sequence through the same stage-fn
+    # bodies; sharding differs only in mesh layout, which the
+    # DP≡strategy invariant — and tests/test_pipeline_chunk.py's
+    # parity suite — pin as numerics-neutral).
+
+    @property
+    def superstep_fused(self) -> bool:
+        """Whether ``steps_per_call > 1`` fuses into one compiled
+        dispatch here (``Executor`` exposes the same property; the
+        trainer and resilience layer route on it)."""
+        return self.compiled
+
+    def note_fused_dispatch(self, steps: int = 1) -> None:
+        """Record ONE compiled host program covering ``steps`` train
+        steps: the ``("C", 0, 0)`` sentinel is the compiled analogue of
+        the ``2*S*ceil(m/c)`` event list, and the telemetry counter
+        makes programs/step honestly read ``1/k`` on the fused
+        superstep path.  Single owner of both pieces — ``train_step``
+        calls it with the default, ``Trainer._fit_superstep`` after
+        each fused k-step dispatch."""
+        self.last_schedule = [("C", 0, 0)]
+        _telemetry.current().add_programs(1, steps=steps)
+
+    def _require_compiled(self, what: str) -> None:
+        if not self.compiled:
+            raise ValueError(
+                f"{what} requires the compiled pipeline step "
+                f"(PipelineExecutor(compiled=True) / --pipeline-compiled); "
+                f"the host-driven pipeline amortizes the fence instead "
+                f"(Trainer._fit_superstep_pipeline)"
+            )
+
+    def build_compiled_step(self):
+        """The whole multi-stage train step as ONE jitted program —
+        donated ``(params, opt_state, state)``, same signature and
+        numerics as :meth:`train_step`.  Host programs per step drop
+        from ``2*S*ceil(m/c)`` to 1, and the program is fence-free
+        (clip-norm included), which is what makes layer-wise
+        strategies genuinely superstep-capable
+        (:meth:`build_superstep`)."""
+        self._require_compiled("build_compiled_step")
+        if self._compiled_step_fn is None:
+            self._compiled_step_fn = jax.jit(
+                self._compiled_step_impl, donate_argnums=(0, 1, 2)
+            )
+            _telemetry.current().emit(
+                "compiled_step", mode="compiled", S=len(self.stages),
+                m=self.microbatches, k=1,
+            )
+        return self._compiled_step_fn
+
+    def _compiled_step_impl(self, params, opt_state, state, batch):
+        """The traced whole-step body (see section comment: mirrors
+        ``_run_chunked`` at ``c=m`` exactly, with ``_finish_step``'s
+        tail folded in)."""
+        m = self.microbatches
+        S = len(self.stages)
+        graph_inputs = {t.name for t in self.model.input_tensors}
+
+        stacked: Dict[str, Any] = {}
+        for name in graph_inputs:
+            if name not in batch:
+                continue
+            v = jnp.asarray(batch[name])
+            if v.shape[0] % m:
+                raise PlacementError(
+                    f"batch dim {v.shape[0]} of input {name!r} is not "
+                    f"divisible by microbatches={m}"
+                )
+            # Row-major reshape == _split_micro's row slices.
+            stacked[name] = v.reshape((m, v.shape[0] // m) + v.shape[1:])
+
+        stage_state = dict(state)
+        boundary: Dict[str, Any] = {}
+        stage_inputs: List[Any] = [None] * S
+        pre_states: List[Any] = [None] * S
+        for si, st in enumerate(self.stages):
+            # Pin each stage's stacked inputs to EXACTLY the host
+            # path's placement (_put_stage_many_chunk): GSPMD's
+            # propagation through the in-trace reshape is otherwise
+            # free to leave a microbatch replicated where the host path
+            # shards it, and a replicated mean reduces in a different
+            # tree order than a sharded one — a 1-ulp loss drift the
+            # bit-identity gate forbids (observed; the constraint is
+            # the fix, not a nicety).
+            sh = self._chunk_in_shardings[si]
+            vals = {
+                n: jax.lax.with_sharding_constraint(
+                    stacked[n] if n in graph_inputs else boundary[n],
+                    sh[n],
+                )
+                for n in st.in_names
+            }
+            stage_inputs[si] = vals
+            # optimization_barrier at every stage-program boundary is a
+            # best-effort isolation HINT only: this XLA vintage strips
+            # barriers before the algebraic simplifier runs (stablehlo
+            # carries 8, the optimized HLO zero — measured 2026-08-04),
+            # so bit-identity does NOT rest on them.  It rests on the
+            # explicit sharding pins here, the mesh-invariant Linear
+            # contraction (ops/linear.py), and mean_metrics' explicit
+            # reciprocal multiply (executor.py).  Kept because a TPU
+            # backend that honors barriers only gets safer.
+            outs, pres, new_state = jax.lax.optimization_barrier(
+                self._fwd_chunk_fns[si](params[si], stage_state[si], vals)
+            )
+            pre_states[si] = pres
+            stage_state[si] = new_state
+            boundary.update(outs)
+
+        dloss_seed = jnp.float32(1.0 / m)
+        dout_back: Dict[str, List[Any]] = {}
+        grads: Dict[int, Any] = {}
+        metrics_acc = None
+        for si in range(S - 1, -1, -1):
+            st = self.stages[si]
+            douts = {}
+            for n in st.out_names:
+                # The producer's stacked output placement — the
+                # compiled mirror of _collect_douts' device_put (same
+                # reasoning as the forward constraints above).
+                sh = self._stacked(self.stage_ex[si].output_sharding(
+                    self._producer[n], self._spec_of[n]
+                ))
+                contribs = dout_back.pop(n, None)
+                if contribs:
+                    # Same summation order as _collect_douts: reverse
+                    # consumer-stage order (later stages' backwards
+                    # appended first), each contribution pinned to the
+                    # producer's placement before the sum.
+                    parts = [
+                        jax.lax.with_sharding_constraint(g, sh)
+                        for g in contribs
+                    ]
+                    total = parts[0]
+                    for p in parts[1:]:
+                        total = total + p
+                    douts[n] = total
+                else:
+                    ref = boundary[n]
+                    douts[n] = jax.lax.with_sharding_constraint(
+                        jnp.zeros(ref.shape, ref.dtype), sh
+                    )
+            g_acc = jax.tree.map(jnp.zeros_like, params[si])
+            m_acc = None
+            if si == S - 1:
+                m_acc = self._abstract_zero_metrics(
+                    si, params[si], pre_states[si], stage_inputs[si]
+                )
+            g, mets, dxs = jax.lax.optimization_barrier(
+                self._bwd_chunk_fns[si](
+                    params[si], pre_states[si], stage_inputs[si],
+                    douts, dloss_seed, g_acc, m_acc,
+                )
+            )
+            grads[si] = g
+            if si == S - 1:
+                metrics_acc = mets
+            for n, gx in dxs.items():
+                dout_back.setdefault(n, []).append(gx)
+
+        # Device-side hierarchical clip-norm: per-stage squared norms
+        # (the same _grad_sq_fns bodies) combined in stage order with
+        # the shared f32 formula — the host path's one-fence-per-step
+        # floor simply does not exist here.
+        if self.config.clip_norm > 0.0:
+            total = self._grad_sq_fns[0](grads[0])
+            for si in range(1, S):
+                total = total + self._grad_sq_fns[si](grads[si])
+            scale = _clip_scale_f32(total, self.config.clip_norm)
+            for si in range(S):
+                grads[si] = self._scale_fns[si](grads[si], scale)
+
+        new_params, new_opt = {}, {}
+        for si in range(S):
+            new_params[si], new_opt[si] = self.optimizer.update(
+                params[si], opt_state[si], grads[si]
+            )
+        m_out = mean_metrics(metrics_acc or {}, count=m)
+        return new_params, new_opt, stage_state, m_out
+
+    def build_superstep(self, k: int, accum_steps: int = 1):
+        """K whole pipeline steps in ONE compiled dispatch: the
+        compiled step wrapped in the donated-carry ``lax.scan`` over a
+        stacked ``(k,) + batch`` queue (:meth:`stack_steps`) — exactly
+        ``Executor.build_superstep``'s shape, so ``Trainer
+        ._fit_superstep`` and ``ResilientTrainer`` drive layer-wise
+        strategies through the same fused path as full-mesh ones (one
+        dispatch + one ``jax.device_get`` per k steps; host programs
+        per step = 1/k)."""
+        self._require_compiled("build_superstep (fused pipeline supersteps)")
+        if k < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {k}")
+        if accum_steps != 1:
+            raise ValueError(
+                "pipeline gradient accumulation is lowered at "
+                "construction (PipelineExecutor(accum_steps=...)); "
+                "build_superstep composes with it at accum_steps=1"
+            )
+        if self._compiled_superstep_cache.get(k) is None:
+            step = self._compiled_step_impl
+
+            def superstep(params, opt_state, state, stacked):
+                def body(carry, batch):
+                    p, o, s = carry
+                    p, o, s, m = step(p, o, s, batch)
+                    return (p, o, s), m
+
+                (p, o, s), ms = jax.lax.scan(
+                    body, (params, opt_state, state), stacked
+                )
+                return p, o, s, ms
+
+            self._compiled_superstep_cache[k] = jax.jit(
+                superstep, donate_argnums=(0, 1, 2)
+            )
+            _telemetry.current().emit(
+                "compiled_step", mode="compiled", S=len(self.stages),
+                m=self.microbatches, k=k,
+            )
+        return self._compiled_superstep_cache[k]
+
+    @functools.cached_property
+    def _compiled_batch_shardings(self) -> Dict[str, NamedSharding]:
+        """Graph-input shardings on the shared stage mesh (each input's
+        consuming stage's placement) — the superstep stacking analogue
+        of ``Executor._batch_shardings``."""
+        graph_inputs = {t.name for t in self.model.input_tensors}
+        out: Dict[str, NamedSharding] = {}
+        for si, st in enumerate(self.stages):
+            for n in st.in_names:
+                if n in graph_inputs and n not in out:
+                    out[n] = self._in_shardings[si][n]
+        return out
+
+    def stack_steps(self, batches: Sequence[Dict[str, Any]],
+                    accum_steps: int = 1):
+        """Stack k per-step host batches into the device-resident
+        ``(k, ...)`` queue :meth:`build_superstep` scans over (mirrors
+        ``Executor.stack_steps``; the leading step dim is unsharded,
+        everything else takes the consuming stage's placement)."""
+        self._require_compiled("stack_steps")
+        if accum_steps != 1:
+            raise ValueError(
+                "pipeline gradient accumulation is lowered at "
+                "construction (PipelineExecutor(accum_steps=...)); "
+                "stack_steps composes with it at accum_steps=1"
+            )
+        sh = self._compiled_batch_shardings
+        out = {}
+        for name in batches[0]:
+            vals = [b[name] for b in batches]
+            if all(isinstance(v, np.ndarray) for v in vals):
+                stacked = np.stack(vals)
+            else:
+                stacked = jnp.stack([jnp.asarray(v) for v in vals])
+            if name in sh:
+                spec = PartitionSpec(None, *sh[name].spec)
+                stacked = jax.device_put(
+                    stacked, NamedSharding(sh[name].mesh, spec)
+                )
+            out[name] = stacked
+        return out
 
     # -- compute-free mode ---------------------------------------------------
 
@@ -1002,7 +1452,37 @@ class PipelineExecutor:
             )
         return params, opt_state, state, metrics
 
+    @functools.cached_property
+    def _compiled_eval_fn(self):
+        """Compiled-mode eval: the whole read-only pass as ONE jitted
+        program (per-stage losses/metrics combine in stage order inside
+        the trace — no per-stage fetches at all)."""
+        graph_inputs = {t.name for t in self.model.input_tensors}
+
+        def ev(params, state, batch):
+            boundary: Dict[str, Any] = {}
+            total = jnp.float32(0.0)
+            metrics: Dict[str, Any] = {}
+            for si, st in enumerate(self.stages):
+                inputs = {
+                    n: (batch[n] if n in graph_inputs else boundary[n])
+                    for n in st.in_names
+                }
+                loss, mets, _, env = self.stage_ex[si].forward(
+                    params[si], state[si], inputs, training=False
+                )
+                total = total + loss
+                metrics = _merge_metrics(metrics, mets)
+                boundary.update({n: env[n] for n in st.out_names})
+            return total, metrics
+
+        return jax.jit(ev)
+
     def eval_step(self, params, state, batch):
+        if self.compiled:
+            loss, mets = self._compiled_eval_fn(params, state, batch)
+            loss, mets = _telemetry.current().fence((loss, mets), "eval")
+            return float(loss), mets
         graph_inputs = {t.name for t in self.model.input_tensors}
         boundary: Dict[str, Any] = {}
         losses: List[Any] = []
@@ -1054,7 +1534,11 @@ def make_executor(
 ):
     """Choose the runtime for a strategy: plain Executor when every op
     spans the whole mesh, PipelineExecutor when ``device_ids`` carve
-    out proper subsets (the reference's layer-wise placement)."""
+    out proper subsets (the reference's layer-wise placement).
+    ``compiled=True`` (--pipeline-compiled) requests the compiled
+    whole-step pipeline; combinations it cannot realize fall back
+    LOUDLY to the host-driven pipeline (the numerics oracle, which
+    supports everything)."""
     if strategy is not None and any(
         pc.device_ids is not None for pc in strategy.table.values()
     ):
@@ -1068,10 +1552,25 @@ def make_executor(
             mb = kwargs.pop("microbatches", 1)
             sched = kwargs.pop("schedule", "1f1b")
             chunk = kwargs.pop("chunk", 1)
+            compiled = kwargs.pop("compiled", False)
+            accum = kwargs.pop("accum_steps", 1)
             kwargs.pop("mesh_plan", None)
+            if compiled:
+                try:
+                    return PipelineExecutor(
+                        model, strategy, microbatches=mb, schedule=sched,
+                        chunk=chunk, compiled=True, accum_steps=accum,
+                        **kwargs
+                    )
+                except CompiledPipelineUnsupported as e:
+                    _log.warning(
+                        "--pipeline-compiled unavailable for this "
+                        "model/strategy (%s); falling back to the "
+                        "host-driven pipeline", e,
+                    )
             return PipelineExecutor(
                 model, strategy, microbatches=mb, schedule=sched,
-                chunk=chunk, **kwargs
+                chunk=chunk, accum_steps=accum, **kwargs
             )
         _log.warning(
             "strategy device_ids span the full mesh; explicit ordering is "
@@ -1080,4 +1579,6 @@ def make_executor(
     kwargs.pop("microbatches", None)
     kwargs.pop("schedule", None)
     kwargs.pop("chunk", None)
+    kwargs.pop("compiled", None)
+    kwargs.pop("accum_steps", None)
     return Executor(model, strategy=strategy, **kwargs)

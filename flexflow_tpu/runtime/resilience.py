@@ -398,15 +398,20 @@ class ResilientTrainer:
         # superstep length (an unfenced dependent dispatch chain):
         # clamp it to the same cap.
         check_every = min(check_every or save_every or 1, MAX_STEPS_PER_CALL)
-        if k > 1 and not hasattr(ex, "build_superstep"):
-            # Layer-wise (pipeline) executors have no fused superstep;
-            # the k=1 path composes fully (per-stage {si: ...} trees
-            # checkpoint/restore through orbax like any pytree).
+        if k > 1 and not getattr(ex, "superstep_fused", False):
+            # Host-driven layer-wise (pipeline) executors have no fused
+            # superstep; the k=1 path composes fully (per-stage
+            # {si: ...} trees checkpoint/restore through orbax like any
+            # pytree).  The COMPILED pipeline step has one — its
+            # stacked per-step metrics come back at the single
+            # superstep fence, so the same first-non-finite-step scan +
+            # rollback/replay machinery applies unchanged.
             raise ValueError(
-                "steps_per_call > 1 in ResilientTrainer requires the "
-                "full-mesh Executor (build_superstep); layer-wise "
-                "(device-subset) strategies compose with resilience at "
-                "steps_per_call=1"
+                "steps_per_call > 1 in ResilientTrainer requires a "
+                "fused superstep (the full-mesh Executor, or a "
+                "PipelineExecutor on the compiled-step path: "
+                "--pipeline-compiled); host-driven layer-wise "
+                "strategies compose with resilience at steps_per_call=1"
             )
         step, params, opt_state, state = self._fresh_state(ex, seed)
         if step >= iterations:

@@ -99,7 +99,7 @@ class _NullTelemetry:
     def record_step(self, step, loss=None, wall_s=None, **fields) -> None:
         pass
 
-    def add_programs(self, n: int) -> None:
+    def add_programs(self, n: int, steps: int = 1) -> None:
         pass
 
     def heartbeat(self, label: str = "beat") -> None:
@@ -307,11 +307,15 @@ class Telemetry:
         self.heartbeat(f"fence:{label}:done")
         return host
 
-    def add_programs(self, n: int) -> None:
-        """Fold one step's host-program count (the pipeline's
-        ``len(last_schedule)``) into the programs/step counter."""
+    def add_programs(self, n: int, steps: int = 1) -> None:
+        """Fold ``n`` host programs covering ``steps`` train steps into
+        the programs/step counter: the host-driven pipeline reports one
+        step's ``len(last_schedule)`` per call (``steps=1``); the fused
+        compiled-pipeline superstep reports ONE program covering k
+        steps (``n=1, steps=k``), so programs/step honestly reads
+        ``1/k``."""
         self.counts["host_programs"] += int(n)
-        self.counts["program_steps"] += 1
+        self.counts["program_steps"] += int(steps)
 
     # -- heartbeat / watchdog ----------------------------------------------
 

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
 import numpy as np
+from jax.profiler import StepTraceAnnotation
 
 from flexflow_tpu.metrics import PerfMetrics
 from flexflow_tpu.runtime import telemetry as _telemetry
@@ -119,12 +120,29 @@ class Trainer:
         stats under ``"telemetry"`` (OBSERVABILITY.md).  Off = zero
         overhead, stats and numerics bit-identical."""
         with _telemetry.maybe_run(self.ex.config):
+            if isinstance(self.ex, PipelineExecutor) and accum_steps > 1:
+                # Pipeline gradient accumulation is lowered at executor
+                # construction (accum groups x m microbatches == a*m
+                # microbatches); the trainer must not stack again.
+                if accum_steps != self.ex.accum_steps:
+                    raise ValueError(
+                        f"accum_steps={accum_steps} on a layer-wise "
+                        f"strategy must be lowered at construction: "
+                        f"build the PipelineExecutor (or make_executor) "
+                        f"with accum_steps={accum_steps} (this one has "
+                        f"accum_steps={self.ex.accum_steps})"
+                    )
+                accum_steps = 1
             if steps_per_call > 1:
-                if isinstance(self.ex, PipelineExecutor):
-                    # Layer-wise strategies cannot FUSE k steps into one
-                    # scan (per-stage host dispatch), but the host fence
-                    # amortizes the same way: k steps dispatch
-                    # back-to-back with ONE device_get per superstep.
+                if (isinstance(self.ex, PipelineExecutor)
+                        and not self.ex.superstep_fused):
+                    # Host-driven layer-wise strategies cannot FUSE k
+                    # steps into one scan (per-stage host dispatch), but
+                    # the host fence amortizes the same way: k steps
+                    # dispatch back-to-back with ONE device_get per
+                    # superstep.  The compiled pipeline step
+                    # (--pipeline-compiled) takes the fused path below
+                    # instead.
                     return self._fit_superstep_pipeline(
                         iterations, batches, warmup, log_every, checkpoint,
                         save_every, resume, accum_steps, prefetch,
@@ -214,9 +232,15 @@ class Trainer:
                 t_prev = start
                 for it in range(iterations):
                     batch = next(batches)
-                    params, opt_state, state, m = step_fn(
-                        params, opt_state, state, batch
-                    )
+                    # StepTraceAnnotation: XProf device timelines group
+                    # by train step, so --trace captures correlate with
+                    # the telemetry JSONL's step events (no-op unless a
+                    # profiler trace is active).
+                    with StepTraceAnnotation("train",
+                                             step_num=start_step + it):
+                        params, opt_state, state, m = step_fn(
+                            params, opt_state, state, batch
+                        )
                     if tel.enabled:
                         # Host-side per-step wall time: in this unfenced
                         # regime it is the DISPATCH time (the loop never
@@ -332,12 +356,13 @@ class Trainer:
         """
         tel = _telemetry.current()
         ex = self.ex
-        if not isinstance(ex, Executor):
+        if not getattr(ex, "superstep_fused", False):
             raise ValueError(
-                "steps_per_call > 1 requires the full-mesh Executor; "
-                "pipeline (layer-wise device-subset) strategies dispatch "
-                "per-stage programs the superstep scan cannot fuse — "
-                "run them with steps_per_call=1"
+                "fused steps_per_call > 1 requires the full-mesh "
+                "Executor or the compiled pipeline step "
+                "(--pipeline-compiled); host-driven layer-wise "
+                "strategies dispatch per-stage programs the superstep "
+                "scan cannot fuse — they take the fence-amortized path"
             )
         assert iterations > 0, "fit() needs at least one iteration"
         if k > MAX_STEPS_PER_CALL:
@@ -426,6 +451,8 @@ class Trainer:
                 params, opt_state, state, ms = step_fns[k](
                     params, opt_state, state, superbatch
                 )
+                if isinstance(ex, PipelineExecutor):
+                    ex.note_fused_dispatch(k)
             start_step += warm_calls * k
             if ms is not None:
                 tel.fence(ms, "warmup")  # compile outside the timed loop
@@ -446,14 +473,21 @@ class Trainer:
                         step_fns[n] = ex.build_superstep(n, accum_steps)
                     t_call = time.perf_counter()
                     superbatch = next(batches)
-                    params, opt_state, state, ms = step_fns[n](
-                        params, opt_state, state, superbatch
-                    )
-                    # ONE host readback per superstep: the execution
-                    # fence AND the stacked per-step metrics, unstacked
-                    # so the loss curve is bit-identical to k=1.
-                    host_ms = tel.fence(ms, "superstep")
+                    with StepTraceAnnotation("superstep",
+                                             step_num=start_step + steps_done):
+                        params, opt_state, state, ms = step_fns[n](
+                            params, opt_state, state, superbatch
+                        )
+                        # ONE host readback per superstep: the execution
+                        # fence AND the stacked per-step metrics,
+                        # unstacked so the loss curve is bit-identical
+                        # to k=1.
+                        host_ms = tel.fence(ms, "superstep")
                     wall = time.perf_counter() - t_call
+                    if isinstance(ex, PipelineExecutor):
+                        # Compiled pipeline: ONE host program covered n
+                        # steps — programs/step honestly reads 1/k.
+                        ex.note_fused_dispatch(n)
                     if tel.enabled:
                         tel.emit("superstep", k=n, mode="fused",
                                  wall_s=round(wall, 6),
@@ -495,19 +529,26 @@ class Trainer:
                     print(f"preempted: emergency checkpoint at step "
                           f"{start_step + steps_done}, exiting cleanly")
             if ex.config.profiling:
-                from flexflow_tpu.runtime.profiler import profile_ops, report
-
-                one = {
-                    key: (
-                        v[0].reshape((-1,) + v.shape[3:])
-                        if accum_steps > 1 else v[0]
+                if isinstance(ex, PipelineExecutor):
+                    print("profiling: per-op breakdown unavailable for "
+                          "pipeline executors")
+                else:
+                    from flexflow_tpu.runtime.profiler import (
+                        profile_ops,
+                        report,
                     )
-                    for key, v in superbatch.items()
-                }
-                profiles = profile_ops(ex, params, state, one)
-                print(report(profiles) if profiles else
-                      "profiling: per-op profile skipped on the axon "
-                      "relay (dispatch-dominated; see telemetry)")
+
+                    one = {
+                        key: (
+                            v[0].reshape((-1,) + v.shape[3:])
+                            if accum_steps > 1 else v[0]
+                        )
+                        for key, v in superbatch.items()
+                    }
+                    profiles = profile_ops(ex, params, state, one)
+                    print(report(profiles) if profiles else
+                          "profiling: per-op profile skipped on the axon "
+                          "relay (dispatch-dominated; see telemetry)")
             batch_size = ex.model.input_tensors[0].shape[0]
             throughput = steps_done * batch_size / elapsed
             print(f"time = {elapsed:.4f}s")
@@ -634,12 +675,15 @@ class Trainer:
                     t_call = time.perf_counter()
                     ms = []
                     walls = []
-                    for _ in range(n):
+                    for i in range(n):
                         t_disp = time.perf_counter()
                         batch = next(batches)
-                        params, opt_state, state, m = ex.train_step(
-                            params, opt_state, state, batch
-                        )
+                        with StepTraceAnnotation(
+                            "train", step_num=start_step + steps_done + i
+                        ):
+                            params, opt_state, state, m = ex.train_step(
+                                params, opt_state, state, batch
+                            )
                         walls.append(time.perf_counter() - t_disp)
                         ms.append(m)
                     # ONE host readback per superstep: all n steps'

@@ -63,8 +63,12 @@ def stubbed_bench(monkeypatch):
         lambda n, t: chatty({
             "s2_mb4_c1_ms_per_step": 4.0, "s2_mb4_c1_programs": 16,
             "s2_mb4_c4_ms_per_step": 2.0, "s2_mb4_c4_programs": 4,
+            "s2_mb4_compiled_ms_per_step": 1.0,
+            "s2_mb4_compiled_programs": 1,
             "chunk_amortization": 2.0,
+            "compiled_speedup": 2.0,
             "superstep_k8_ms_per_step": 1.5,
+            "superstep_k8_compiled_ms_per_step": 0.75,
         }),
     )
     monkeypatch.setattr(
@@ -102,6 +106,12 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert pipe["s2_mb4_c4_programs"] == 4
     assert pipe["chunk_amortization"] == 2.0
     assert pipe["superstep_k8_ms_per_step"] == 1.5
+    # The compiled whole-step column (ONE program per step) and its
+    # A/B headlines vs the chunked host path.
+    assert pipe["s2_mb4_compiled_programs"] == 1
+    assert pipe["s2_mb4_compiled_ms_per_step"] == 1.0
+    assert pipe["compiled_speedup"] == 2.0
+    assert pipe["superstep_k8_compiled_ms_per_step"] == 0.75
     # The telemetry summary block: dispatch/fence counters + host-side
     # step-time percentiles (the observability layer's headline
     # numbers, OBSERVABILITY.md).

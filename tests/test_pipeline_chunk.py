@@ -324,3 +324,350 @@ def test_pipeline_chunk_app_end_to_end():
         "-ll:tpu", "8", "--microbatches", "2", "--pipeline-chunk", "2",
         "--steps-per-call", "2",
     ]) == 0
+
+
+# -- compiled whole-step path (ISSUE 5) ---------------------------------------
+#
+# PipelineExecutor(compiled=True): the whole multi-stage step is ONE
+# jitted program on the shared stage mesh.  The HOST-DRIVEN path above is
+# the numerics oracle: loss AND param trajectories must be BIT-IDENTICAL
+# for the same schedule, across stage counts, non-divisible m, dropout,
+# nested n/c inside stages, skip connections, and clip-norm (which runs
+# device-side here — no fence floor).
+
+
+@functools.lru_cache(maxsize=None)
+def _pipe_c(microbatches=4, clip=0.0, dropout=0.0, compiled=True,
+            accum_steps=1):
+    cfg = FFConfig(batch_size=16, clip_norm=clip)
+    return PipelineExecutor(
+        _model(dropout=dropout), _store(with_dropout=dropout > 0.0),
+        config=cfg, optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+        microbatches=microbatches, compiled=compiled,
+        accum_steps=accum_steps,
+    )
+
+
+@pytest.mark.parametrize(
+    "dropout,clip",
+    [(0.0, 0.0), (0.5, 0.0), (0.0, 0.5)],
+    ids=["plain", "dropout", "clip_norm"],
+)
+def test_compiled_bit_identical_to_host(dropout, clip):
+    """The headline gate: one compiled program per step, trajectories
+    bit-identical to the host-driven event loop — incl. the dropout RNG
+    chain and the device-side hierarchical clip-norm (vs the host
+    path's fenced combine)."""
+    batches = _batches(3, seed=3 if clip else 0)
+    ref = _run(_pipe(chunk=1, clip=clip, dropout=dropout), batches)
+    got = _run(_pipe_c(clip=clip, dropout=dropout), batches)
+    _assert_bit_identical(ref, got, f"compiled dropout={dropout} clip={clip}")
+
+
+def _deep_model(batch=16):
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, 12), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    t = x
+    for i in range(4):
+        t = ff.dense(t, 16, activation="relu", name=f"fc{i}")
+    t = ff.dense(t, 4, activation=None, name="head")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+def _s4_store():
+    st = StrategyStore(8)
+    groups = [(0, 1), (2, 3), (4, 5), (6, 7)]
+    assign = [["fc0"], ["fc1"], ["fc2"], ["fc3", "head", "softmax"]]
+    for g, ns in zip(groups, assign):
+        for n in ns:
+            st.set(n, ParallelConfig(n=2, device_ids=g))
+    return st
+
+
+def _nc_store():
+    st = StrategyStore(8)
+    for n in ("fc0", "fc1"):
+        st.set(n, ParallelConfig(n=2, c=2, device_ids=(0, 1, 2, 3)))
+    for n in ("fc2", "fc3", "head"):
+        st.set(n, ParallelConfig(n=2, c=2, device_ids=(4, 5, 6, 7)))
+    st.set("softmax", ParallelConfig(n=4, device_ids=(4, 5, 6, 7)))
+    return st
+
+
+@pytest.mark.parametrize(
+    "store_fn,mb,batch",
+    [(_s4_store, 4, 16), (_s4_store, 3, 24), (_nc_store, 4, 16)],
+    ids=["S4_n2", "S4_odd_m", "S2_nested_n2c2"],
+)
+def test_compiled_parity_corners(store_fn, mb, batch):
+    """S=4 stage chains, m=3 (non-divisible 1f1b fill), and nested
+    n/c sharding inside stages (the Linear contraction pin,
+    ops/linear.py) — all bit-identical to the host path."""
+    ff = _deep_model(batch)
+    batches = _batches(2, batch=batch)
+
+    def go(compiled):
+        pipe = PipelineExecutor(
+            ff, store_fn(), config=FFConfig(batch_size=batch),
+            optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+            microbatches=mb, compiled=compiled,
+        )
+        return _run(pipe, batches)
+
+    _assert_bit_identical(go(False), go(True),
+                          f"{store_fn.__name__} m={mb}")
+
+
+def test_compiled_skip_connection(rng):
+    """A stage-0 output consumed by TWO later stages: in-trace cotangent
+    summation order matches _collect_douts'."""
+    batch = 8
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, 12), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    t0 = ff.dense(x, 8, activation="relu", name="s0")
+    t1 = ff.dense(t0, 8, activation="relu", name="s1")
+    t2 = ff.concat([t0, t1], axis=1, name="s2cat")
+    t3 = ff.dense(t2, 4, activation=None, name="s2fc")
+    ff.softmax(t3, lbl, name="softmax")
+    store = StrategyStore(6)
+    store.set("s0", ParallelConfig(n=2, device_ids=(0, 1)))
+    store.set("s1", ParallelConfig(n=2, device_ids=(2, 3)))
+    for name in ("s2cat", "s2fc", "softmax"):
+        store.set(name, ParallelConfig(n=2, device_ids=(4, 5)))
+    batch_data = {
+        "x": rng.standard_normal((batch, 12)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(batch,)).astype(np.int32),
+    }
+
+    def run(compiled):
+        pipe = PipelineExecutor(
+            ff, store, optimizer=SGDOptimizer(lr=0.1),
+            microbatches=2, compiled=compiled,
+        )
+        p, o, s = pipe.init(seed=0)
+        p2, _, _, m = pipe.train_step(p, o, s, pipe.shard_batch(batch_data))
+        return np.array(jax.device_get(m["train_loss"])), jax.device_get(p2)
+
+    _assert_bit_identical(run(False), run(True), "skip connection compiled")
+
+
+def test_compiled_eval_parity():
+    """Compiled eval (one program, in-trace stage-order combine) matches
+    the host path's fenced host-side sum bit-for-bit."""
+    b = _batches(1)[0]
+    host, comp = _pipe(chunk=4), _pipe_c()
+    p, o, s = host.init(seed=0)
+    pc, oc, sc = comp.init(seed=0)
+    loss_h, mets_h = host.eval_step(p, s, host.shard_batch(b))
+    loss_c, mets_c = comp.eval_step(pc, sc, comp.shard_batch(b))
+    assert loss_h == loss_c
+    assert set(mets_h) == set(mets_c)
+    for k in mets_h:
+        np.testing.assert_array_equal(np.asarray(mets_h[k]),
+                                      np.asarray(mets_c[k]))
+
+
+def test_compiled_accum_lowering():
+    """--accum-steps on a layer-wise strategy lowers onto the microbatch
+    loop: accumulating a groups of m microbatches IS the pipeline over
+    a*m microbatches, on both runtimes."""
+    batches = _batches(2)
+    ref = _run(_pipe(microbatches=4, chunk=1), batches)
+    for compiled in (False, True):
+        got = _run(_pipe_c(microbatches=2, accum_steps=2,
+                           compiled=compiled), batches)
+        _assert_bit_identical(ref, got, f"accum lowered compiled={compiled}")
+
+
+def test_compiled_zero_opt_refused():
+    """--zero-opt stays refused on layer-wise strategies, naming the
+    per-submesh moment-sharding blocker."""
+    from flexflow_tpu.runtime.pipeline import PlacementError
+
+    cfg = FFConfig(batch_size=16, zero_sharded_optimizer=True)
+    with pytest.raises(PlacementError, match="PER-SUBMESH"):
+        PipelineExecutor(_model(), _store(), config=cfg, microbatches=4)
+
+
+def test_trainer_accum_requires_construction_lowering():
+    """Trainer.fit(accum_steps=a) on a pipeline must match the
+    executor's construction-time lowering — mismatches raise instead of
+    silently double-stacking."""
+    from flexflow_tpu.runtime.trainer import Trainer as Tr
+
+    pipe = _pipe_c(microbatches=2, accum_steps=2)
+    with pytest.raises(ValueError, match="lowered at construction"):
+        Tr(pipe).fit(iterations=1, warmup=0, accum_steps=4)
+    stats = Tr(pipe).fit(iterations=2, warmup=1, accum_steps=2)
+    assert stats["iterations"] == 2
+
+
+# -- fused pipeline supersteps ------------------------------------------------
+
+
+def test_compiled_superstep_mode_promoted():
+    """StrategyStore.superstep_mode: layer-wise stays "amortized" on the
+    host path and promotes to "fused" on the compiled path; the
+    executors expose the same split via superstep_fused."""
+    store = _store()
+    assert store.superstep_mode() == "amortized"
+    assert store.superstep_mode(compiled=True) == "fused"
+    assert not store.superstep_capable()
+    assert store.superstep_capable(compiled=True)
+    assert not _pipe(chunk=4).superstep_fused
+    assert _pipe_c().superstep_fused
+
+
+def test_compiled_superstep_bit_identical_and_counters(tmp_path):
+    """--steps-per-call k on the compiled path: ONE dispatch + ONE
+    fence per k steps (telemetry fence/programs counters audit it) and
+    trajectories bit-identical to the k=1 host-driven run.  Warmup is
+    sized to whole supersteps so both runs apply the same updates."""
+    import json
+
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    k, iters, warmup = 3, 6, 3
+    batches = _batches(warmup + iters)
+
+    def fit(pipe, steps_per_call):
+        tr = Trainer(pipe)
+        with Telemetry(str(tmp_path / f"k{steps_per_call}")) as tel:
+            stats = tr.fit(
+                iterations=iters, warmup=warmup,
+                steps_per_call=steps_per_call, batches=iter(batches),
+                prefetch=0,
+            )
+        with open(tel.path) as f:
+            events = [json.loads(line) for line in f]
+        return stats, jax.device_get(tr.final[0]), events
+
+    s1, p1, _ = fit(_pipe(chunk=1), 1)
+    sk, pk, events = fit(_pipe_c(), k)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Fused-path accounting: programs/step == 1/k, one superstep fence
+    # per k steps, and the compiled_step event names the fusion.
+    assert sk["telemetry"]["programs_per_step"] == round(1 / k, 4)
+    ss = [e for e in events if e["ev"] == "superstep"]
+    assert len(ss) == 2 and all(e["k"] == k and e["mode"] == "fused"
+                                for e in ss)
+    fences = [e for e in events if e["ev"] == "fence"
+              and e["label"] == "superstep"]
+    assert len(fences) == 2
+    compiled_evs = [e for e in events if e["ev"] == "compiled_step"]
+    assert any(e["k"] == k and e["S"] == 2 and e["m"] == 4
+               for e in compiled_evs)
+    # No clip fence, no per-step fence: the step is fence-free IR.
+    assert not [e for e in events if e["ev"] == "fence"
+                and e["label"] == "clip_norm"]
+
+
+def test_compiled_superstep_clip_norm_fence_free(tmp_path):
+    """clip_norm > 0 on the compiled path keeps the fused superstep:
+    NO per-step fence (the host path's loudly-warned floor is gone) and
+    numerics bit-identical to the host-driven clipped run."""
+    import json
+
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    k, iters = 2, 4
+    batches = _batches(k + iters, seed=3)
+
+    def fit(pipe, steps_per_call):
+        tr = Trainer(pipe)
+        with Telemetry(str(tmp_path / f"clip{steps_per_call}")) as tel:
+            tr.fit(iterations=iters, warmup=k,
+                   steps_per_call=steps_per_call, batches=iter(batches),
+                   prefetch=0)
+        with open(tel.path) as f:
+            events = [json.loads(line) for line in f]
+        return jax.device_get(tr.final[0]), events
+
+    p1, ev1 = fit(_pipe(chunk=1, clip=0.5), 1)
+    pk, evk = fit(_pipe_c(clip=0.5), k)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert [e for e in ev1 if e["ev"] == "fence"
+            and e["label"] == "clip_norm"]  # host floor, still there
+    assert not [e for e in evk if e["ev"] == "fence"
+                and e["label"] == "clip_norm"]  # compiled: gone
+
+
+def test_compiled_train_step_program_accounting():
+    """One host program covers the whole compiled step: last_schedule
+    records the single compiled event (vs 2*S*ceil(m/c) host events)."""
+    pipe = _pipe_c()
+    p, o, s = pipe.init(seed=0)
+    pipe.train_step(p, o, s, pipe.shard_batch(_batches(1)[0]))
+    assert pipe.last_schedule == [("C", 0, 0)]
+
+
+# -- loud fallback ------------------------------------------------------------
+
+
+def _fallback_warns(store, caplog, **kwargs):
+    import logging
+
+    from flexflow_tpu.runtime.pipeline import make_executor
+
+    with caplog.at_level(logging.WARNING, logger="ff.pipeline"):
+        ex = make_executor(_model(), store, config=FFConfig(batch_size=16),
+                           optimizer=SGDOptimizer(lr=0.1),
+                           microbatches=4, compiled=True, **kwargs)
+    assert isinstance(ex, PipelineExecutor) and not ex.compiled
+    assert any("--pipeline-compiled unavailable" in r.message
+               for r in caplog.records)
+
+
+def test_compiled_fallback_unequal_stages(caplog):
+    """Unequal stage sizes have no shared stage mesh: loud fallback
+    to the host-driven pipeline (the numerics oracle supports it)."""
+    store = StrategyStore(8)
+    for n in ("enc0", "enc1"):
+        store.set(n, ParallelConfig(n=2, device_ids=(0, 1)))
+    for n in ("dec0", "dec1", "softmax"):
+        store.set(n, ParallelConfig(n=6, device_ids=(2, 3, 4, 5, 6, 7)))
+    _fallback_warns(store, caplog)
+
+
+def test_compiled_fallback_unverified_degrees(caplog):
+    """Spatial (h/w) degrees and c on non-Linear ops are unverified
+    against the submesh numerics: loud fallback, not silent 1-ulp
+    drift."""
+    store = _store()
+    store.set("enc0", ParallelConfig(n=2, h=2, device_ids=(0, 1, 2, 3)))
+    _fallback_warns(store, caplog)
+
+    store = _store(with_dropout=True)
+    store.set("drop", ParallelConfig(
+        n=2, c=2, device_ids=tuple(range(4, 8))))
+    ff = _model(dropout=0.5)
+    import logging
+
+    from flexflow_tpu.runtime.pipeline import make_executor
+
+    with caplog.at_level(logging.WARNING, logger="ff.pipeline"):
+        ex = make_executor(ff, store, config=FFConfig(batch_size=16),
+                           optimizer=SGDOptimizer(lr=0.1),
+                           microbatches=4, compiled=True)
+    assert isinstance(ex, PipelineExecutor) and not ex.compiled
+
+
+def test_compiled_cli_and_app_end_to_end():
+    """--pipeline-compiled parses and drives the fused superstep path
+    through the shared app harness."""
+    assert FFConfig.parse_args(["--pipeline-compiled"]).pipeline_compiled
+    assert not FFConfig.parse_args([]).pipeline_compiled
+
+    from flexflow_tpu.apps import nmt
+
+    assert nmt.main([
+        "-b", "16", "-i", "4", "--hidden", "8", "--vocab", "32",
+        "--src-len", "4", "--tgt-len", "4", "--pipeline",
+        "-ll:tpu", "8", "--microbatches", "2", "--pipeline-compiled",
+        "--steps-per-call", "2",
+    ]) == 0

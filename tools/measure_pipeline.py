@@ -9,6 +9,15 @@ under ONE ``jax.device_get`` fence, ``Trainer._fit_superstep_pipeline``
 semantics timed inline).  Acceptance: S=4 mb=8 c=mb 1f1b beats the
 round-5 1f1b number (981 ms) by >= 1.2x on the 8-dev virtual CPU mesh.
 
+Round 7 (ISSUE 5) adds the COMPILED whole-step rows (``--pipeline-
+compiled``: the entire multi-stage step as ONE jitted program on the
+shared stage mesh, 1 host program per step) and the FUSED pipeline
+superstep A/B (``build_superstep(k)``: one dispatch + one fence per k
+steps, 1/k programs per step) — both same-day against the unchanged
+host path per the round-6 box-drift caveat.  Acceptance: compiled
+beats the chunked host path per-step in the dispatch-bound regime
+(``--batch 64 --width 256``, S=4 mb=8).
+
 The virtual mesh multiplexes ONE core, so these numbers isolate host
 dispatch + boundary transfer cost, exactly as in rounds 3/5.
 
@@ -83,6 +92,26 @@ def time_superstep(ex, batch, k, iters=32, warmup=4):
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 
+def time_fused_superstep(pipe, batch, k, iters=32, warmup=1):
+    """k whole pipeline steps as ONE compiled dispatch + ONE fence
+    (``PipelineExecutor.build_superstep`` on the compiled-step path)."""
+    import jax
+
+    params, opt_state, state = pipe.init(seed=0)
+    fn = pipe.build_superstep(k)
+    stacked = pipe.stack_steps([batch] * k)
+    for _ in range(warmup):
+        params, opt_state, state, ms = fn(params, opt_state, state, stacked)
+    jax.device_get(ms)
+    t0 = time.perf_counter()
+    done = 0
+    while done < iters:
+        params, opt_state, state, ms = fn(params, opt_state, state, stacked)
+        jax.device_get(ms)
+        done += k
+    return (time.perf_counter() - t0) / done * 1e3  # ms/step
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--width", type=int, default=1024)
@@ -123,16 +152,18 @@ def main():
             store.set(name, ParallelConfig(n=per, device_ids=ids))
         return store
 
-    def make_pipe(S, mb, sched, c):
+    def make_pipe(S, mb, sched, c, compiled=False):
         return PipelineExecutor(
             ff, pipe_store(S), optimizer=opt(),
-            microbatches=mb, schedule=sched, chunk=c,
+            microbatches=mb, schedule=sched, chunk=c, compiled=compiled,
         )
 
     for S in (2, 4):
         for mb in (1, 4, 8):
             # Both schedules at c=1 (round-3/5 comparability), then the
-            # chunk sweep on 1f1b: c in {2, mb}.
+            # chunk sweep on 1f1b: c in {2, mb}, then the compiled
+            # whole-step row (ONE program; schedule is moot — the
+            # trace sequences stages by data dependency).
             chunks = [1] if mb == 1 else [1, 2, mb]
             for sched in ("gpipe", "1f1b"):
                 for c in (chunks if sched == "1f1b" else [1]):
@@ -145,9 +176,19 @@ def main():
                         f"{t:.1f} ms  ({progs} programs/step){flag}",
                         flush=True,
                     )
+            pipe = make_pipe(S, mb, "1f1b", 1, compiled=True)
+            t = time_step(pipe, batch, args.iters)
+            flag = " <= plain" if t <= t_plain else ""
+            print(
+                f"pipeline S={S} mb={mb} compiled: {t:.1f} ms  "
+                f"(1 program/step){flag}",
+                flush=True,
+            )
 
     # Superstep-over-pipeline A/B: one fence per k=8 steps at the
-    # dispatch-minimal chunk (and at c=1 for the fence-only delta).
+    # dispatch-minimal chunk (and at c=1 for the fence-only delta),
+    # then the FUSED compiled superstep (one dispatch + one fence per
+    # k steps — 1/k programs per step).
     for c in (1, 8):
         pipe = make_pipe(4, 8, "1f1b", c)
         t1 = time_superstep(pipe, batch, k=1, iters=args.iters)
@@ -157,6 +198,14 @@ def main():
             f"k=8 {t8:.1f} ms/step ({t1 / t8:.2f}x)",
             flush=True,
         )
+    pipe = make_pipe(4, 8, "1f1b", 1, compiled=True)
+    t1 = time_superstep(pipe, batch, k=1, iters=args.iters)
+    t8 = time_fused_superstep(pipe, batch, k=8, iters=args.iters)
+    print(
+        f"superstep S=4 mb=8 compiled: k=1 {t1:.1f} ms -> "
+        f"k=8 fused {t8:.1f} ms/step ({t1 / t8:.2f}x)",
+        flush=True,
+    )
     return 0
 
 
