@@ -599,6 +599,77 @@ def bench_telemetry(n_chips: int, on_tpu: bool):
     return out
 
 
+def bench_search(n_chips: int, on_tpu: bool):
+    """Execution-autotuner leg (``-s auto``'s engine,
+    search/execution.py): the dispatch-bound MLP trained under the
+    default config (DP, per-step dispatch) vs the auto-chosen execution
+    config — the search calibrated from the default leg's OWN in-memory
+    telemetry (dispatch/fence constants + compute scale), exactly the
+    apps' ``--calibration`` flow.  Reports measured default/auto
+    ms/step, the chosen config with its PREDICTED ms/step (the
+    predicted-vs-measured honesty check), and search wall time."""
+    import numpy as np
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.pipeline import make_executor
+    from flexflow_tpu.runtime.telemetry import Telemetry
+    from flexflow_tpu.runtime.trainer import Trainer
+    from flexflow_tpu.search import Calibration, search_execution_config
+
+    batch = 64 * n_chips if on_tpu else 32
+    width = 256 if on_tpu else 64
+    iters = 32 if on_tpu else 16
+
+    def build():
+        ff = FFModel(FFConfig(batch_size=batch, seed=11))
+        x = ff.create_tensor((batch, width), name="x")
+        lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+        t = ff.dense(x, width, activation="relu", name="fc1")
+        t = ff.dense(t, 8, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        return ff
+
+    opt = lambda: SGDOptimizer(lr=0.01, momentum=0.9)
+    with Telemetry() as tel:
+        stats = Trainer(Executor(build(), optimizer=opt())).fit(
+            iterations=iters, warmup=1
+        )
+    default_ms = stats["elapsed_s"] / iters * 1e3
+    cal = Calibration.from_telemetry(tel)
+    ff = build()
+    t0 = time.perf_counter()
+    # ks capped at 16 so iters stays superstep-divisible (no tail
+    # recompile inside the timed region).
+    res = search_execution_config(
+        ff, n_chips, iters=2000, seed=0, calibration=cal,
+        ks=(1, 2, 4, 8, 16),
+    )
+    wall = time.perf_counter() - t0
+    best = res.best
+    ex = make_executor(
+        ff, best.store if best.store.table else None, optimizer=opt(),
+        microbatches=best.microbatches, chunk=best.chunk,
+        compiled=best.compiled,
+    )
+    stats = Trainer(ex).fit(iterations=iters, warmup=1,
+                            steps_per_call=best.steps_per_call)
+    auto_ms = stats["elapsed_s"] / iters * 1e3
+    return {
+        "batch_size": batch,
+        "iterations": iters,
+        "default_ms_per_step": round(default_ms, 3),
+        "auto_ms_per_step": round(auto_ms, 3),
+        "auto_speedup": round(default_ms / max(auto_ms, 1e-9), 3),
+        "auto_config": best.describe(),
+        "predicted_ms_per_step": round(best.predicted_ms, 3),
+        "search_wall_s": round(wall, 3),
+        "calibrated": cal.calibrated,
+    }
+
+
 def bench_op_parallel_speedup(n_devices: int = 4):
     """The third BASELINE metric: operator-parallel vs data-parallel
     speedup (the ICML'18 headline claims it for AlexNet/VGG/Inception;
@@ -760,6 +831,12 @@ def main():
             extra["telemetry"] = bench_telemetry(n_chips, on_tpu)
     except Exception as e:
         extra["telemetry_error"] = f"{type(e).__name__}: {e}"
+    checkpoint_result(per_chip)
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            extra["search"] = bench_search(n_chips, on_tpu)
+    except Exception as e:
+        extra["search_error"] = f"{type(e).__name__}: {e}"
     checkpoint_result(per_chip)
     try:
         with contextlib.redirect_stdout(sys.stderr):

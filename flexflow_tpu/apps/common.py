@@ -62,6 +62,13 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --eval-iters N (held-out eval after training)   --clip-norm F
   --lazy-sparse-opt (row-sparse tables under momentum/Adam, lazy)
   --search | --search-iters N (inline strategy autotuning)
+  -s auto (execution-config autotuner: strategy x stages x chunk x
+           superstep k x compiled x accum searched against the
+           telemetry-calibrated dispatch/fence cost model, winner
+           applied to this run; SEARCH.md)
+  --calibration PATH (telemetry JSONL file/dir feeding -s auto's
+           dispatch/fence constants; default: latest run under the
+           telemetry dir, else uncalibrated constants)
   --resilient (detection + checkpoint rollback + SIGTERM emergency save)
   --save-every N   --ckpt-dir PATH   --max-restarts N   --sync-ckpt
   --telemetry DIR (JSONL run telemetry + heartbeat + stall watchdog,
@@ -153,8 +160,11 @@ def load_image_dataset(cfg: FFConfig, image_size: int):
 
 def load_strategy(cfg: FFConfig, num_devices: int) -> Optional[StrategyStore]:
     """``-s file.pb`` reads the reference protobuf format; anything
-    else is our JSON schema (``parallel/strategy.py``)."""
-    if not cfg.strategy_file:
+    else is our JSON schema (``parallel/strategy.py``).  ``-s auto``
+    returns None here — the app's default strategy stays the search
+    BASELINE, and ``run_training`` replaces it with the
+    execution-config autotuner's winner (search-then-run)."""
+    if not cfg.strategy_file or cfg.strategy_file.lower() == "auto":
         return None
     if cfg.strategy_file.endswith(".pb"):
         return StrategyStore.load_pb(cfg.strategy_file, num_devices=num_devices)
@@ -359,6 +369,10 @@ def _run_resilient(
             "batch_size": cfg.batch_size,
             "loss": out["loss"],
             "restarts": out["restarts"],
+            # Steps executed by THIS process (a checkpoint-resumed run
+            # reports its absolute step in "iterations"): the right
+            # denominator for this run's elapsed_s.
+            "steps_this_run": completed,
         }
         if "telemetry" in out:
             stats["telemetry"] = out["telemetry"]
@@ -398,6 +412,116 @@ def run_training(
                              num_samples, arrays)
 
 
+def _resolve_calibration(cfg: FFConfig):
+    """Dispatch/fence calibration for ``-s auto``: ``--calibration
+    PATH`` (file or dir) wins; else the latest run-*.jsonl under the
+    telemetry dir (EXCLUDING the active run's own file, which holds no
+    steps yet); else the uncalibrated measured-host defaults."""
+    from flexflow_tpu.runtime import telemetry as _telemetry
+    from flexflow_tpu.search import Calibration
+
+    active = _telemetry.current().path
+    if cfg.search_calibration:
+        if os.path.isdir(cfg.search_calibration):
+            # --calibration pointed at a DIRECTORY (possibly the
+            # telemetry dir itself): the active run's just-opened file
+            # is the newest there and holds no steps yet — same
+            # exclusion as the default path below.
+            return Calibration.from_dir(cfg.search_calibration,
+                                        exclude=active)
+        return Calibration.from_jsonl(cfg.search_calibration)
+    d = cfg.telemetry_dir or os.environ.get("FF_TELEMETRY_DIR")
+    if d:
+        return Calibration.from_dir(d, exclude=active)
+    return Calibration()
+
+
+def _auto_execution_search(ff: FFModel, cfg: FFConfig,
+                           default_strategy: Optional[StrategyStore],
+                           ndev: int):
+    """``-s auto``: search the FULL execution-config space (strategy x
+    stage partition x chunk x superstep k x compiled x accum) against
+    the telemetry-calibrated dispatch/fence cost model, apply the
+    winner to this run, and emit a ``search`` telemetry event so the
+    choice is reconstructable from the log (SEARCH.md).  Returns
+    ``(store, chosen ExecutionConfig)``."""
+    from flexflow_tpu.runtime import telemetry as _telemetry
+    from flexflow_tpu.search import search_execution_config
+    from flexflow_tpu.search.execution import ExecutionConfig
+
+    cal = _resolve_calibration(cfg)
+    base_store = default_strategy or StrategyStore.data_parallel(ndev)
+    n_stages = 1
+    if base_store.layer_wise:
+        from flexflow_tpu.runtime.pipeline import derive_stages
+
+        n_stages = len(derive_stages(ff, base_store))
+    baseline = ExecutionConfig(
+        store=base_store, microbatches=cfg.microbatches,
+        chunk=cfg.pipeline_chunk, steps_per_call=cfg.steps_per_call,
+        compiled=cfg.pipeline_compiled, accum_steps=cfg.accum_steps,
+        schedule=cfg.pipeline_schedule,  # survives a baseline win
+        stages=n_stages, label="app-default",
+    )
+    res = search_execution_config(
+        ff, ndev,
+        iters=cfg.search_iters if cfg.search_iters >= 0 else 20_000,
+        seed=cfg.seed,
+        calibration=cal, clip_norm=cfg.clip_norm,
+        accum_steps=cfg.accum_steps, resilient=cfg.resilient,
+        allow_layer_wise=not (cfg.zc_dataset or cfg.granules > 1),
+        baseline=baseline,
+    )
+    choice = res.best
+    if choice is res.baseline:
+        print("auto: the app's default config already wins the "
+              "searched space; keeping it")
+    elif default_strategy is not None:
+        print("auto: overriding the app's default strategy")
+    print(f"auto: chose {choice.describe()}")
+    print(f"auto: predicted {choice.predicted_ms:.3f} ms/step vs "
+          f"default {res.baseline.predicted_ms:.3f} ms/step "
+          f"({res.speedup:.2f}x simulated); {cal.describe()}; "
+          f"searched {len(res.candidates)} configs in {res.wall_s:.1f}s")
+    choice.apply_to(cfg)
+    _telemetry.current().emit(
+        "search", chosen=choice.to_json(),
+        baseline=res.baseline.to_json(),
+        predicted_ms=round(choice.predicted_ms, 4),
+        baseline_predicted_ms=round(res.baseline.predicted_ms, 4),
+        dispatch_ms=round(res.calibration.dispatch_ms, 4),
+        fence_ms=round(res.calibration.fence_ms, 4),
+        compute_scale=round(res.compute_scale, 6),
+        calibrated=res.calibration.calibrated,
+        calibration_source=res.calibration.source,
+        candidates=len(res.candidates),
+        wall_s=round(res.wall_s, 3),
+    )
+    return choice.store, choice
+
+
+def _fold_auto_stats(stats: Dict[str, float], choice) -> Dict[str, float]:
+    """``-s auto`` epilogue: predicted-vs-measured ms/step, printed and
+    folded into the stats dict under ``"search"``.  The denominator is
+    the steps THIS process ran (``steps_this_run`` on the resilient
+    path — a resumed run's absolute "iterations" would shrink the
+    measured number by the checkpointed prefix it never executed)."""
+    if choice is None:
+        return stats
+    steps = stats.get("steps_this_run", stats.get("iterations"))
+    if not steps:
+        return stats
+    measured = stats["elapsed_s"] / steps * 1e3
+    print(f"auto: predicted {choice.predicted_ms:.3f} ms/step, "
+          f"measured {measured:.3f} ms/step")
+    stats["search"] = {
+        "config": choice.describe(),
+        "predicted_ms_per_step": round(choice.predicted_ms, 4),
+        "measured_ms_per_step": round(measured, 4),
+    }
+    return stats
+
+
 def _run_training(
     ff: FFModel,
     cfg: FFConfig,
@@ -410,6 +534,14 @@ def _run_training(
     ndev = cfg.resolve_num_devices()
     if strategy is None:
         strategy = load_strategy(cfg, ndev)
+    auto_choice = None
+    if (cfg.strategy_file or "").lower() == "auto":
+        # -s auto: execution-config autotuning, search-then-run — the
+        # app's default strategy (still in ``strategy``) is the
+        # baseline the searched config must beat.
+        strategy, auto_choice = _auto_execution_search(
+            ff, cfg, strategy, ndev
+        )
     if cfg.search_iters > 0 and cfg.strategy_file is None:
         # --search: inline automatic parallelization — the reference's
         # offline simulator+MCMC run (scripts/simulator.cc) folded into
@@ -478,8 +610,11 @@ def _run_training(
                 accum_steps=cfg.accum_steps,
             )
 
-        return _run_resilient(ff, cfg, executor_factory, ex, arrays,
-                              int_high, label)
+        return _fold_auto_stats(
+            _run_resilient(ff, cfg, executor_factory, ex, arrays,
+                           int_high, label),
+            auto_choice,
+        )
     trainer = Trainer(ex)
     batches = None
     eval_arrays = None
@@ -532,4 +667,4 @@ def _run_training(
     if cfg.eval_iters > 0:
         params, _, state = trainer.final
         stats["eval"] = _run_eval(trainer, params, state, cfg, eval_arrays)
-    return stats
+    return _fold_auto_stats(stats, auto_choice)

@@ -33,7 +33,7 @@ def main(argv=None) -> int:
     layers = pop_int(argv, "--layers", 2)
     dropout = pop_float(argv, "--dropout", 0.2)  # lstm.cu:152
     cfg = FFConfig.parse_args(argv)
-    if pipeline and cfg.search_iters:
+    if pipeline and cfg.search_iters > 0:
         raise SystemExit(
             "--pipeline pins an explicit layer-wise placement; --search "
             "would discard it — pass one or the other"

@@ -37,6 +37,12 @@ class FFConfig:
     loaders_per_node: int = 0
     # Data / strategy files.
     dataset_path: Optional[str] = None  # -d; None => synthetic input
+    # -s FILE loads a strategy table (JSON, or the reference .pb); the
+    # special value ``-s auto`` runs the execution-config autotuner at
+    # launch instead (search/execution.py): strategy x stage partition
+    # x pipeline chunk x superstep k x compiled x accum searched
+    # against the telemetry-calibrated dispatch/fence cost model, the
+    # winner applied to this run (search-then-run; SEARCH.md).
     strategy_file: Optional[str] = None  # -s
     # -p/--print-freq: metric-print frequency in iterations (reference
     # README.md flag table; default 10 there, 0 = quiet here to keep
@@ -122,8 +128,18 @@ class FFConfig:
     # --search: run the MCMC strategy autotuner at launch when no -s
     # file is given (the reference runs its simulator offline and feeds
     # the result back via -s; this folds the two steps into one run).
-    # Value = MCMC iterations; 0 = off.
-    search_iters: int = 0
+    # Value = MCMC iterations; 0 = off; -1 = unset.  Also the MCMC
+    # budget of the ``-s auto`` execution-config search, where unset
+    # means the 20k default and an explicit 0 disables the MCMC leg
+    # (DP + stage-partition candidates only).
+    search_iters: int = -1
+    # --calibration PATH: dispatch/fence calibration source for the
+    # ``-s auto`` execution search — a telemetry JSONL file (or a
+    # directory holding run-*.jsonl, latest wins).  Unset: the latest
+    # run under --telemetry DIR / FF_TELEMETRY_DIR when present,
+    # else the uncalibrated measured-host defaults
+    # (search/cost_model.Calibration).
+    search_calibration: Optional[str] = None
     # --trace DIR: capture an XProf/TensorBoard trace of the timed
     # training loop (the fused step as XLA executes it — fusions,
     # collectives, device timelines; view with tensorboard --logdir).
@@ -306,9 +322,12 @@ class FFConfig:
             elif a == "--pipeline-compiled":
                 cfg.pipeline_compiled = True
             elif a == "--search":
-                cfg.search_iters = cfg.search_iters or 20_000
+                cfg.search_iters = (cfg.search_iters
+                                    if cfg.search_iters > 0 else 20_000)
             elif a == "--search-iters":
                 cfg.search_iters = int(_next())
+            elif a == "--calibration":
+                cfg.search_calibration = _next()
             elif a == "--trace":
                 cfg.trace_dir = _next()
             elif a == "--ones-init":

@@ -359,6 +359,29 @@ def build_mesh_plan(
     return make_plan(devices, names, sizes)
 
 
+def check_stage_mesh_feasible(
+    stage_device_ids: Sequence[Sequence[int]],
+) -> None:
+    """The shared-stage-mesh feasibility predicate, raised as
+    :class:`InfeasibleStrategyError` — ONE implementation shared by
+    :func:`build_stage_mesh_plan` (at executor build) and the
+    execution-config searcher's compiled-pipeline eligibility check
+    (``runtime.pipeline.compiled_unsupported_reason``), so a config the
+    search emits is never one the executor falls back on."""
+    sizes = {len(ids) for ids in stage_device_ids}
+    if len(sizes) != 1:
+        raise InfeasibleStrategyError(
+            f"shared stage mesh needs equal-size stages, got sizes "
+            f"{sorted(len(ids) for ids in stage_device_ids)}"
+        )
+    flat = [d for ids in stage_device_ids for d in ids]
+    if len(set(flat)) != len(flat):
+        raise InfeasibleStrategyError(
+            "shared stage mesh needs disjoint stage device sets "
+            "(overlapping stages serialize and have no mesh row)"
+        )
+
+
 def build_stage_mesh_plan(
     stage_device_ids: Sequence[Sequence[int]],
     devices: Optional[Sequence[jax.Device]] = None,
@@ -393,21 +416,10 @@ def build_stage_mesh_plan(
     cannot partition (ROADMAP) — until then the compact mesh is the
     strictly better realization.
     """
-    sizes = {len(ids) for ids in stage_device_ids}
-    if len(sizes) != 1:
-        raise InfeasibleStrategyError(
-            f"shared stage mesh needs equal-size stages, got sizes "
-            f"{sorted(len(ids) for ids in stage_device_ids)}"
-        )
-    flat = [d for ids in stage_device_ids for d in ids]
-    if len(set(flat)) != len(flat):
-        raise InfeasibleStrategyError(
-            "shared stage mesh needs disjoint stage device sets "
-            "(overlapping stages serialize and have no mesh row)"
-        )
+    check_stage_mesh_feasible(stage_device_ids)
     if devices is None:
         devices = jax.devices()
-    per = sizes.pop()
+    per = len(stage_device_ids[0])
     intra_names, intra_sizes = factor_axes(per, prefix="s")
     arr = np.array([devices[d] for d in stage_device_ids[0]]).reshape(
         tuple(intra_sizes)

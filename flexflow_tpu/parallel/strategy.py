@@ -114,6 +114,21 @@ class StrategyStore:
     def __contains__(self, op_name: str) -> bool:
         return op_name in self.table
 
+    @property
+    def layer_wise(self) -> bool:
+        """True when any op pins a PROPER device subset — the strategy
+        then partitions the graph into pipeline stages and runs on the
+        ``PipelineExecutor`` (``make_executor`` routes on the same
+        predicate).  The single source of truth for the searcher's
+        execution-config legality (search/execution.py) and for
+        :meth:`superstep_mode` — duplicating this test is how a
+        simulated config ends up one the executor refuses."""
+        return any(
+            pc.device_ids is not None
+            and len(set(pc.device_ids)) < self.num_devices
+            for pc in self.table.values()
+        )
+
     def superstep_mode(self, compiled: bool = False) -> str:
         """How ``steps_per_call > 1`` (superstep execution) realizes
         this strategy — every strategy family supports supersteps, in
@@ -137,12 +152,7 @@ class StrategyStore:
           and the per-step dispatch count is cut separately by the
           pipeline ``chunk`` factor.
         """
-        layer_wise = any(
-            pc.device_ids is not None
-            and len(set(pc.device_ids)) < self.num_devices
-            for pc in self.table.values()
-        )
-        return "amortized" if layer_wise and not compiled else "fused"
+        return "amortized" if self.layer_wise and not compiled else "fused"
 
     def superstep_capable(self, compiled: bool = False) -> bool:
         """Whether the FUSED superstep (K train steps in one compiled

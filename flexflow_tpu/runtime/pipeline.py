@@ -50,6 +50,7 @@ from flexflow_tpu.optim import SGDOptimizer
 from flexflow_tpu.parallel.mesh import (
     InfeasibleStrategyError,
     build_stage_mesh_plan,
+    check_stage_mesh_feasible,
 )
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 from flexflow_tpu.runtime import telemetry as _telemetry
@@ -233,6 +234,61 @@ def derive_stages(model: FFModel, strategy: StrategyStore) -> List[Stage]:
     return stages
 
 
+def compiled_unsupported_reason(
+    model: FFModel,
+    strategy: StrategyStore,
+    stages: Optional[List[Stage]] = None,
+) -> Optional[str]:
+    """``None`` when the compiled whole-step pipeline can realize this
+    model/strategy, else the blocker string ``PipelineExecutor``
+    raises as :class:`CompiledPipelineUnsupported`.
+
+    The SINGLE implementation of the compiled-pipeline eligibility
+    ladder — the constructor's gate AND the execution-config searcher's
+    legality predicate (``search/execution.py``), so the search never
+    simulates a compiled config the executor would refuse into the
+    loud host-driven fallback."""
+    if stages is None:
+        try:
+            stages = derive_stages(model, strategy)
+        except PlacementError as e:
+            return str(e)
+    for st in stages:
+        for op in st.ops:
+            pc = strategy.find(op.name)
+            if pc.s > 1:
+                return (
+                    "compiled pipeline step does not support s-degree "
+                    "(explicit-collective sequence ops) inside stages yet"
+                )
+            if pc.h > 1 or pc.w > 1:
+                # Spatial partials reduce across devices; their
+                # reduction order on the shared stage mesh is
+                # unverified against the submesh (the c-degree needed
+                # an explicit pin in Linear.forward — same hazard
+                # class).
+                return (
+                    f"compiled pipeline step: spatial (h/w) degree on "
+                    f"{op.name!r} is unverified against the host "
+                    f"path's submesh numerics"
+                )
+            if pc.c > 1 and not isinstance(op, Linear):
+                # Linear pins its contraction operand so the dot
+                # lowers identically on both meshes (ops/linear.py);
+                # other c-sharded ops keep partitioner-chosen
+                # reduction orders.
+                return (
+                    f"compiled pipeline step: c-degree on non-Linear "
+                    f"op {op.name!r} is unverified against the host "
+                    f"path's submesh numerics"
+                )
+    try:
+        check_stage_mesh_feasible([st.device_ids for st in stages])
+    except InfeasibleStrategyError as e:
+        return f"compiled pipeline step: {e}"
+    return None
+
+
 class PipelineExecutor:
     """Executes an FFModel whose strategy places op groups on device
     subsets (disjoint or overlapping) — the runtime realization of
@@ -397,48 +453,19 @@ class PipelineExecutor:
         if self.compiled:
             # Eligibility gate for the compiled whole-step path; every
             # refusal names the blocker so make_executor can fall back
-            # loudly to the host-driven runtime.
-            if any(
-                strategy.find(op.name).s > 1
-                for st in self.stages for op in st.ops
-            ):
-                raise CompiledPipelineUnsupported(
-                    "compiled pipeline step does not support s-degree "
-                    "(explicit-collective sequence ops) inside stages yet"
-                )
-            for st in self.stages:
-                for op in st.ops:
-                    pc = strategy.find(op.name)
-                    if pc.h > 1 or pc.w > 1:
-                        # Spatial partials reduce across devices; their
-                        # reduction order on the shared stage mesh is
-                        # unverified against the submesh (the c-degree
-                        # needed an explicit pin in Linear.forward —
-                        # same hazard class).
-                        raise CompiledPipelineUnsupported(
-                            f"compiled pipeline step: spatial (h/w) "
-                            f"degree on {op.name!r} is unverified "
-                            f"against the host path's submesh numerics"
-                        )
-                    if pc.c > 1 and not isinstance(op, Linear):
-                        # Linear pins its contraction operand so the
-                        # dot lowers identically on both meshes
-                        # (ops/linear.py); other c-sharded ops keep
-                        # partitioner-chosen reduction orders.
-                        raise CompiledPipelineUnsupported(
-                            f"compiled pipeline step: c-degree on "
-                            f"non-Linear op {op.name!r} is unverified "
-                            f"against the host path's submesh numerics"
-                        )
-            try:
-                self._stage_plan = build_stage_mesh_plan(
-                    [st.device_ids for st in self.stages],
-                    devices=all_devices,
-                )
-            except InfeasibleStrategyError as e:
-                raise CompiledPipelineUnsupported(
-                    f"compiled pipeline step: {e}"
-                ) from e
+            # loudly to the host-driven runtime.  ONE implementation
+            # (compiled_unsupported_reason) shared with the
+            # execution-config searcher, so a config the search emits
+            # is never one this constructor refuses into fallback.
+            reason = compiled_unsupported_reason(
+                model, strategy, stages=self.stages
+            )
+            if reason is not None:
+                raise CompiledPipelineUnsupported(reason)
+            self._stage_plan = build_stage_mesh_plan(
+                [st.device_ids for st in self.stages],
+                devices=all_devices,
+            )
             self._compiled_step_fn = None
             self._compiled_superstep_cache: Dict[int, Any] = {}
 

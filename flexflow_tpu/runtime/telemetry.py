@@ -76,6 +76,15 @@ HEARTBEAT_FILE_EVERY_S = 1.0
 #: exactly the events a postmortem cannot afford to lose).
 _BUFFERED_EVENTS = frozenset({"step"})
 
+#: Fence labels excluded from fence_ms calibration fitting: ``warmup``
+#: fences include the first-call compile, ``final`` drains the whole
+#: queued run — neither is a per-step round trip.  The ONE exclusion
+#: rule shared by :meth:`Telemetry.calibration_summary` (in-memory fit)
+#: and ``search.cost_model.Calibration.from_events`` (JSONL re-derive)
+#: — the two fitters must agree or fence_ms means different things
+#: depending on which path fed it.
+CALIBRATION_FENCE_EXCLUDE = frozenset({"warmup", "final"})
+
 #: Per-process run counter: strftime has one-second resolution, so two
 #: quick fits in one process would otherwise append-interleave into the
 #: same JSONL file (breaking the one-file-per-run contract).
@@ -211,6 +220,11 @@ class Telemetry:
         #: (superstep) they include device execution.  Either way they
         #: are measured host-side and add no ``device_get``.
         self.step_times: List[float] = []
+        #: (label, wall_s) of every fence — the calibration feed for
+        #: the execution autotuner's fence_ms constant (the MINIMUM
+        #: non-warmup/final fence is the round-trip floor estimate;
+        #: search/cost_model.Calibration).
+        self.fence_times: List[tuple] = []
         self._hb_path = (
             heartbeat_path
             or os.environ.get("FF_HEARTBEAT_FILE")
@@ -303,6 +317,7 @@ class Telemetry:
         host = jax.device_get(value)
         dt = time.perf_counter() - t0
         self.counts["fences"] += 1
+        self.fence_times.append((label, dt))
         self.emit("fence", label=label, wall_s=round(dt, 6))
         self.heartbeat(f"fence:{label}:done")
         return host
@@ -408,6 +423,45 @@ class Telemetry:
         stats["telemetry"] = self.step_summary()
         return stats
 
+    def calibration_summary(self) -> Dict[str, Any]:
+        """Everything the execution autotuner's :class:`~flexflow_tpu.
+        search.cost_model.Calibration` needs, from ONE run: the
+        per-program dispatch cost estimate (step p50 / programs-per-step
+        when the run was dispatch-audited at >= 2 programs/step), the
+        fence round-trip floor (MINIMUM non-warmup/final fence wall —
+        every fence also drains queued compute, so the cheapest one
+        bounds the round trip), and the source counts.  Folded into the
+        ``run_end`` event as its ``calibration`` block
+        (OBSERVABILITY.md)."""
+        ss = self.step_summary()
+        floors = [
+            dt for lbl, dt in self.fence_times
+            if lbl not in CALIBRATION_FENCE_EXCLUDE
+        ]
+        out: Dict[str, Any] = {
+            "steps": ss["steps"],
+            # STEADY-STATE fences per step: the excluded warmup/final
+            # fences happen once per RUN, not per step — counting them
+            # here would charge the cost model a per-step fence a long
+            # run never pays (the fit multiplies this by fence_ms,
+            # which is fitted over the same exclusion).
+            "fences_per_step": round(
+                len(floors) / max(ss["steps"], 1), 4
+            ),
+        }
+        pps = ss.get("programs_per_step")
+        if pps is not None:
+            out["programs_per_step"] = pps
+        p50 = ss.get("step_ms_p50")
+        if p50 is not None:
+            out["step_ms_p50"] = p50
+            if pps is not None and pps >= 2.0:
+                out["dispatch_ms_per_program"] = round(p50 / pps, 4)
+        if floors:
+            out["fence_ms"] = round(max(min(floors) * 1e3, 1e-3), 4)
+            out["fence_samples"] = len(floors)
+        return out
+
     # -- lifecycle ----------------------------------------------------------
 
     def close(self) -> None:
@@ -416,7 +470,8 @@ class Telemetry:
         self._stop.set()
         if self._watchdog is not None:
             self._watchdog.join(timeout=2.0)
-        self.emit("run_end", summary=self.step_summary())
+        self.emit("run_end", summary=self.step_summary(),
+                  calibration=self.calibration_summary())
         with self._lock:
             self._closed = True
             if self._f is not None:

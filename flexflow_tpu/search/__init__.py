@@ -22,25 +22,48 @@ from typing import Dict, List, Optional
 
 _log = logging.getLogger("ff.search")
 
+#: (op name, config json) pairs already warned about in
+#: ``simulate_strategy``'s no-enumerated-candidate fallback.
+_warned_unmatched: set = set()
+
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.native import ffsim_search, ffsim_simulate, ffsim_validate
 from flexflow_tpu.parallel.mesh import MeshPlan
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
-from flexflow_tpu.search.cost_model import DeviceModel
+from flexflow_tpu.search.cost_model import Calibration, DeviceModel
 from flexflow_tpu.search.problem import (
     SearchProblem,
     build_problem,
+    build_stage_partition,
     build_virtual_plan,
 )
 
 __all__ = [
+    "Calibration",
     "DeviceModel",
+    "ExecutionConfig",
+    "ExecutionSearchResult",
     "SearchResult",
+    "search_execution_config",
     "search_strategy",
     "simulate_strategy",
     "build_problem",
+    "build_stage_partition",
     "build_virtual_plan",
+    "predict_step_ms",
 ]
+
+
+def __getattr__(name):
+    # Lazy: execution.py pulls in the runtime stack (trainer/pipeline)
+    # for its legality reuse; the plain per-op search must stay
+    # importable without it.
+    if name in ("ExecutionConfig", "ExecutionSearchResult",
+                "search_execution_config", "predict_step_ms"):
+        from flexflow_tpu.search import execution
+
+        return getattr(execution, name)
+    raise AttributeError(name)
 
 
 @dataclasses.dataclass
@@ -152,13 +175,19 @@ def simulate_strategy(
                     idx = j
                     break
         if idx is None:
-            _log.warning(
-                "simulate_strategy: op %r config %s matches no enumerated "
-                "candidate (e.g. unaligned device block); costing its DP "
-                "fallback instead — the returned time does NOT reflect "
-                "this placement",
-                op.name, store.find(op.name).to_json(),
-            )
+            key = (op.name, str(store.find(op.name).to_json()))
+            if key not in _warned_unmatched:
+                # Warn once per (op, config) per process: the execution
+                # autotuner re-simulates the same store dozens of times
+                # and the repeated warning drowns the -s auto report.
+                _warned_unmatched.add(key)
+                _log.warning(
+                    "simulate_strategy: op %r config %s matches no "
+                    "enumerated candidate (e.g. unaligned device block); "
+                    "costing its DP fallback instead — the returned time "
+                    "does NOT reflect this placement",
+                    op.name, store.find(op.name).to_json(),
+                )
             idx = 0
         assign.append(idx)
     return ffsim_simulate(prob.text, assign)

@@ -46,6 +46,26 @@ def build_model(name: str, batch_size: int):
     raise SystemExit(f"unknown model {name!r}")
 
 
+def _save_store(store, output: str) -> str:
+    """Write ``store`` to ``output`` honoring the extension — ``.pb``
+    is the reference wire format (strategy.proto) via the native codec,
+    so searched strategies drop into the reference toolchain too.
+    Sequence-parallel (s>1) results have no .pb encoding; never lose a
+    finished search to that — fall back to JSON.  Returns the path
+    actually written (the one ``-s`` must load)."""
+    if output.endswith(".pb"):
+        try:
+            store.save_pb(output)
+            return output
+        except ValueError as e:
+            fallback = output + ".json"
+            store.save(fallback)
+            print(f"cannot encode as .pb ({e}); wrote {fallback} instead")
+            return fallback
+    store.save(output)
+    return output
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="flexflow_tpu.search")
     ap.add_argument("--model", required=True,
@@ -61,6 +81,18 @@ def main(argv=None):
         help="replace roofline compute costs with live per-op "
              "microbenchmarks on the current backend (the reference's "
              "measured simulator mode, scripts/cnn.h:204+)")
+    ap.add_argument(
+        "--auto", action="store_true",
+        help="search the FULL execution-config space (strategy x stage "
+             "partition x chunk x superstep k x compiled x accum) "
+             "against the dispatch/fence cost model instead of the "
+             "per-op strategy space alone; prints the winning config "
+             "and the app flags that run it (SEARCH.md)")
+    ap.add_argument(
+        "--calibration", default=None, metavar="PATH",
+        help="telemetry JSONL file (or directory of run-*.jsonl) to "
+             "calibrate the dispatch/fence constants from; default: "
+             "uncalibrated measured-host constants")
     ap.add_argument(
         "--audit-bytes", action="store_true",
         help="after the search, compile the train step under the found "
@@ -83,7 +115,7 @@ def main(argv=None):
         # Per-(op, degree) shard-local microbenchmarks on one device —
         # the reference's computeTime[config] cache (scripts/cnn.h:
         # 204-260); comm costs stay model-derived (the search prices
-        # them itself).
+        # them itself).  Feeds --auto's compute term too.
         table = measured_degree_table(model, num_devices=args.devices)
         n_cfg = sum(len(v) for v in table.values())
         print(
@@ -91,24 +123,42 @@ def main(argv=None):
             f"{jax.default_backend()} ({n_cfg} (op, degree) configs)"
         )
         measured = table
+    if args.auto:
+        from flexflow_tpu.search import Calibration, search_execution_config
+
+        cal = (Calibration.from_path(args.calibration)
+               if args.calibration else Calibration())
+        res = search_execution_config(
+            model, num_devices=args.devices, iters=args.iters,
+            seed=args.seed, calibration=cal, measured_costs=measured,
+        )
+        best = res.best
+        print(f"calibration: {cal.describe()}")
+        print(f"{'config':<44} {'predicted ms/step':>18}")
+        for c in res.candidates[:12]:
+            print(f"{c.describe():<44} {c.predicted_ms:>18.3f}")
+        if len(res.candidates) > 12:
+            print(f"  ... {len(res.candidates) - 12} more candidates")
+        print(f"best    = {best.describe()} "
+              f"({best.predicted_ms:.3f} ms/step predicted; "
+              f"baseline {res.baseline.predicted_ms:.3f}, "
+              f"{res.speedup:.2f}x)")
+        out_path = _save_store(best.store, args.output)
+        flags = [f"--steps-per-call {best.steps_per_call}"]
+        if best.stages > 1:
+            flags.append(f"--microbatches {best.microbatches}")
+            if best.compiled:
+                flags.append("--pipeline-compiled")
+            elif best.chunk > 1:
+                flags.append(f"--pipeline-chunk {best.chunk}")
+        print(f"run it: -s {out_path} " + " ".join(flags))
+        print(f"wrote {out_path}")
+        return 0
     res = search_strategy(
         model, num_devices=args.devices, iters=args.iters,
         seed=args.seed, alpha=args.alpha, measured_costs=measured,
     )
-    if args.output.endswith(".pb"):
-        # Reference wire format (strategy.proto) via the native codec —
-        # searched strategies drop into the reference toolchain too.
-        # Sequence-parallel (s>1) results have no .pb encoding; never
-        # lose a finished search to that — fall back to JSON.
-        try:
-            res.store.save_pb(args.output)
-        except ValueError as e:
-            fallback = args.output + ".json"
-            res.store.save(fallback)
-            print(f"cannot encode as .pb ({e}); wrote {fallback} instead")
-            args.output = fallback
-    else:
-        res.store.save(args.output)
+    args.output = _save_store(res.store, args.output)
     print(f"dp      = {res.dp_time_us:.1f} us/step (simulated)")
     print(f"best    = {res.best_time_us:.1f} us/step (simulated)")
     print(f"speedup = {res.speedup:.2f}x")

@@ -17,9 +17,14 @@ against wall clock either.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger("ff.search")
 
 from flexflow_tpu.ops import (
     LSTM,
@@ -222,3 +227,246 @@ def sync_cost_us(cost: OpCost, degrees: Dict[str, int], dev: DeviceModel) -> flo
             (c - 1) / c * cost.ep_alltoall_bytes / dev.ici_bytes_per_us
         )
     return total
+
+
+# -- host dispatch / fence calibration ----------------------------------------
+#
+# PIPELINE_OVERHEAD.md's central finding: at dispatch-bound shapes the
+# step time is dominated not by the compute the roofline above models
+# but by PER-PROGRAM HOST DISPATCH (~1.4-1.6 ms/program on this host,
+# ~16 ms/call through the axon relay) and host-readback fences.  The
+# execution-config search (search/execution.py) therefore adds an
+# explicit ``programs_per_step x dispatch_ms + fences_per_step x
+# fence_ms`` term, whose constants a :class:`Calibration` fits from a
+# run's own JSONL telemetry (runtime/telemetry.py records step wall
+# times, fence wall times, and the exact programs-per-step accounting).
+
+#: Uncalibrated fallbacks: the measured per-program host dispatch cost
+#: on the reference dev host (PIPELINE_OVERHEAD.md rounds 3/6) and the
+#: same-magnitude host-readback round trip.  Through the axon relay
+#: both are ~16 ms — calibrate from a real run's telemetry there.
+DEFAULT_DISPATCH_MS = 1.5
+DEFAULT_FENCE_MS = 1.5
+
+def _fence_exclude() -> frozenset:
+    """Fence labels excluded from fence_ms fitting — the ONE exclusion
+    rule shared with the in-memory fitter
+    (``Telemetry.calibration_summary``), so a constant fitted from a
+    live run and one re-derived from its JSONL agree.  Imported lazily:
+    the plain per-op search must stay importable without the runtime
+    stack (see search/__init__'s lazy ``__getattr__``)."""
+    from flexflow_tpu.runtime.telemetry import CALIBRATION_FENCE_EXCLUDE
+
+    return CALIBRATION_FENCE_EXCLUDE
+
+
+@dataclasses.dataclass
+class Calibration:
+    """Dispatch/fence constants for the execution cost model — either
+    the uncalibrated defaults above, or fitted from one run's JSONL
+    telemetry (:meth:`from_jsonl` / :meth:`from_dir`) or an in-memory
+    :class:`~flexflow_tpu.runtime.telemetry.Telemetry`
+    (:meth:`from_telemetry`).
+
+    Fitting protocol (OBSERVABILITY.md records every input):
+
+    - ``fence_ms``: the MINIMUM non-warmup/final fence wall time — on
+      an async backend every fence also drains queued compute, so the
+      cheapest observed fence is the round-trip floor estimate.
+    - ``dispatch_ms``: ``step_ms_p50 / programs_per_step`` when the
+      run was dispatch-audited at >= 2 programs/step (a host-driven
+      pipeline run, where per-program dispatch is what the step time
+      IS); runs at 1 program/step keep the default constant and let
+      ``compute_scale`` (solved at search time from ``step_ms_p50``,
+      see ``search/execution.py``) absorb the residual.
+    - ``step_ms_p50`` / ``programs_per_step`` / ``fences_per_step``
+      ride along so the search can solve the compute-scale equation
+      against the run's OWN accounting.
+    """
+
+    dispatch_ms: float = DEFAULT_DISPATCH_MS
+    fence_ms: float = DEFAULT_FENCE_MS
+    #: Measured per-step wall p50 of the calibration run (ms), when
+    #: known — the left-hand side of the compute-scale fit.
+    step_ms_p50: Optional[float] = None
+    programs_per_step: float = 1.0
+    fences_per_step: float = 0.0
+    steps: int = 0
+    fence_samples: int = 0
+    calibrated: bool = False
+    source: Optional[str] = None
+    #: True when the constants come from a COMPLETE accounting (the
+    #: run_end ``calibration`` block, or a live in-memory Telemetry).
+    #: A truncated log re-derives fence_ms / step p50 from raw events,
+    #: but its programs-per-step may be unrecoverable (plain step
+    #: events don't carry it), so the compute-scale fit — which prices
+    #: the run's own overhead from that counter — requires ``complete``.
+    complete: bool = False
+    #: True when the calibration run executed an auto-CHOSEN config
+    #: (its log carries a ``search`` event): its step p50 then measures
+    #: the winner, not the baseline, and must not anchor the
+    #: compute-scale fit (the dispatch/fence constants still apply).
+    auto_executed: bool = False
+
+    def describe(self) -> str:
+        if not self.calibrated:
+            return (f"uncalibrated defaults (dispatch {self.dispatch_ms} "
+                    f"ms/program, fence {self.fence_ms} ms)")
+        return (f"calibrated from {self.source or 'telemetry'} "
+                f"(dispatch {self.dispatch_ms:.3f} ms/program, fence "
+                f"{self.fence_ms:.3f} ms, {self.steps} steps / "
+                f"{self.fence_samples} fences)")
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_summary(summary: Dict[str, Any],
+                     source: Optional[str] = None,
+                     complete: bool = True) -> "Calibration":
+        """Build from a telemetry ``calibration`` block (the run_end
+        event's, or ``Telemetry.calibration_summary()``).
+        ``complete=False`` marks constants re-derived from a truncated
+        log (see the ``complete`` field)."""
+        cal = Calibration(source=source, complete=complete)
+        pps = float(summary.get("programs_per_step") or 1.0)
+        p50 = summary.get("step_ms_p50")
+        fence = summary.get("fence_ms")
+        if fence is not None:
+            cal.fence_ms = float(fence)
+            cal.calibrated = True
+        if p50 is not None:
+            cal.step_ms_p50 = float(p50)
+            cal.calibrated = True
+            if pps >= 2.0:
+                # Dispatch-audited regime: per-program dispatch is what
+                # the host-driven pipeline's step time is made of.
+                cal.dispatch_ms = float(summary.get(
+                    "dispatch_ms_per_program", p50 / pps
+                ))
+        cal.programs_per_step = pps
+        cal.fences_per_step = float(summary.get("fences_per_step") or 0.0)
+        cal.steps = int(summary.get("steps") or 0)
+        cal.fence_samples = int(summary.get("fence_samples") or 0)
+        return cal
+
+    @staticmethod
+    def from_events(events, source: Optional[str] = None) -> "Calibration":
+        """Fit from raw JSONL events (robust to truncated logs with no
+        ``run_end``): step wall p50, min non-warmup fence wall, and the
+        programs/fences-per-step counters re-derived from ``step`` /
+        ``fence`` / ``superstep`` events."""
+        run_end_cal: Optional[Dict[str, Any]] = None
+        step_walls: List[float] = []
+        fence_walls: List[float] = []
+        steps = fences = 0
+        programs = program_steps = 0.0
+        saw_search = False
+        exclude = _fence_exclude()
+        for ev in events:
+            kind = ev.get("ev")
+            if kind == "step":
+                steps += 1
+                if ev.get("wall_s") is not None:
+                    step_walls.append(float(ev["wall_s"]))
+            elif kind == "fence":
+                fences += 1
+                if (ev.get("label") not in exclude
+                        and ev.get("wall_s") is not None):
+                    fence_walls.append(float(ev["wall_s"]))
+            elif kind == "superstep":
+                pps = ev.get("programs_per_step")
+                k = float(ev.get("k") or 1)
+                if pps is not None:
+                    programs += float(pps) * k
+                    program_steps += k
+            elif kind == "search":
+                # The run trained under an auto-CHOSEN config; its
+                # step p50 must not anchor the baseline compute fit.
+                saw_search = True
+            elif kind == "run_end" and isinstance(ev.get("calibration"), dict):
+                run_end_cal = ev["calibration"]
+        if run_end_cal is not None:
+            cal = Calibration.from_summary(run_end_cal, source=source)
+            cal.auto_executed = saw_search
+            return cal
+        summary: Dict[str, Any] = {}
+        if step_walls:
+            ts = sorted(step_walls)
+            summary["step_ms_p50"] = ts[len(ts) // 2] * 1e3
+        if fence_walls:
+            summary["fence_ms"] = max(min(fence_walls) * 1e3, 1e-3)
+            summary["fence_samples"] = len(fence_walls)
+        if program_steps:
+            summary["programs_per_step"] = programs / program_steps
+        # Steady-state count: same warmup/final exclusion as fence_ms
+        # (and as Telemetry.calibration_summary's block).
+        summary["fences_per_step"] = len(fence_walls) / max(steps, 1)
+        summary["steps"] = steps
+        cal = Calibration.from_summary(summary, source=source,
+                                       complete=False)
+        cal.auto_executed = saw_search
+        return cal
+
+    @staticmethod
+    def from_jsonl(path: str) -> "Calibration":
+        """Load one run's JSONL telemetry; falls back LOUDLY to the
+        uncalibrated defaults on a missing/unreadable file."""
+        events = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line of a crashed run
+        except OSError as e:
+            _log.warning(
+                "calibration: cannot read %s (%s); using uncalibrated "
+                "roofline/dispatch defaults", path, e,
+            )
+            return Calibration()
+        if not events:
+            _log.warning(
+                "calibration: %s holds no events; using uncalibrated "
+                "defaults", path,
+            )
+            return Calibration()
+        return Calibration.from_events(events, source=path)
+
+    @staticmethod
+    def from_dir(directory: str,
+                 exclude: Optional[str] = None) -> "Calibration":
+        """Latest ``run-*.jsonl`` under ``directory`` (excluding e.g.
+        the ACTIVE run's own file); uncalibrated defaults when none."""
+        try:
+            names = sorted(
+                n for n in os.listdir(directory)
+                if n.startswith("run-") and n.endswith(".jsonl")
+            )
+        except OSError:
+            names = []
+        paths = [os.path.join(directory, n) for n in names]
+        if exclude is not None:
+            ex = os.path.abspath(exclude)
+            paths = [p for p in paths if os.path.abspath(p) != ex]
+        if not paths:
+            return Calibration()
+        return Calibration.from_jsonl(max(paths, key=os.path.getmtime))
+
+    @staticmethod
+    def from_path(path: str) -> "Calibration":
+        """File -> :meth:`from_jsonl`; directory -> :meth:`from_dir`."""
+        if os.path.isdir(path):
+            return Calibration.from_dir(path)
+        return Calibration.from_jsonl(path)
+
+    @staticmethod
+    def from_telemetry(tel) -> "Calibration":
+        """Fit from a live in-memory Telemetry (bench.py's in-process
+        calibration leg)."""
+        return Calibration.from_summary(
+            tel.calibration_summary(), source="in-memory telemetry"
+        )

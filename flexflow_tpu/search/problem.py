@@ -22,7 +22,7 @@ _log = logging.getLogger("ff.search")
 from flexflow_tpu.graph import FFModel
 from flexflow_tpu.ops import Op
 from flexflow_tpu.parallel.mesh import InfeasibleStrategyError, MeshPlan, _prime_factors
-from flexflow_tpu.parallel.strategy import AXES, ParallelConfig
+from flexflow_tpu.parallel.strategy import AXES, ParallelConfig, StrategyStore
 from flexflow_tpu.search.cost_model import (
     FWD_BWD_FACTOR,
     DeviceModel,
@@ -141,8 +141,16 @@ def enumerate_candidates(
         k = pc.num_parts
         if k >= ndev or pc.num_parts != pc.n or pc.device_ids is not None:
             continue
-        for b in range(1, ndev // k):
+        canon = tuple(shard_devices(plan, pc))
+        for b in range(0, ndev // k):
             ids = tuple(range(b * k, (b + 1) * k))
+            if ids == canon:
+                # b=0 exists so CONTIGUOUS origin blocks (the stage
+                # partitions layer-wise execution configs use) are
+                # first-class candidates even when the canonical mesh
+                # placement of pure-n strides the devices; skip only
+                # an exact duplicate of the canonical placement.
+                continue
             shifted.append(ParallelConfig(n=pc.n, device_ids=ids))
     # Smallest blocks first (single-device pinning is the DLRM case);
     # shifted candidates get a RESERVED quota so hybrid-combo floods on
@@ -161,6 +169,42 @@ def enumerate_candidates(
     kept = rest[:budget]
     kept += shifted[: max(0, max_candidates - 1 - len(kept))]
     return [dp] + kept
+
+
+def build_stage_partition(
+    model: FFModel, num_devices: int, stages: int,
+    microbatches: int = 1,
+) -> Optional[StrategyStore]:
+    """A layer-wise execution-config candidate: the op graph split into
+    ``stages`` maximal CONSECUTIVE runs (graph order, balanced op
+    counts) over disjoint contiguous device blocks of ``num_devices //
+    stages`` each, data-parallel within every stage — the same
+    construction the reference's NMT app hand-writes per layer chunk
+    (``nmt.cc:269-308``) and bench.py's pipeline leg uses.  Returns
+    ``None`` when the partition is infeasible for this model (stage
+    count vs ops/devices, or batch extents that don't divide across
+    ``microbatches x intra-stage DP``) — the searcher simply skips the
+    candidate, which is how every emitted config stays executor-legal.
+    """
+    n_ops = len(model.layers)
+    if stages < 2 or stages > n_ops or num_devices % stages:
+        return None
+    per = num_devices // stages
+    if per < 1:
+        return None
+    for t in model.input_tensors:
+        if not t.shape:
+            continue
+        if t.dim_axes and t.dim_axes[0] == "n":
+            b = t.shape[0]
+            if b % microbatches or (b // microbatches) % per:
+                return None  # microbatch rows must shard n-ways evenly
+    store = StrategyStore(num_devices)
+    for i, op in enumerate(model.layers):
+        si = min(i * stages // n_ops, stages - 1)
+        ids = tuple(range(si * per, (si + 1) * per))
+        store.set(op.name, ParallelConfig(n=per, device_ids=ids))
+    return store
 
 
 @dataclasses.dataclass

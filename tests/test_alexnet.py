@@ -5,6 +5,7 @@ checks (the reference's DISABLE_COMPUTATION mode — SURVEY.md §4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from flexflow_tpu.models.alexnet import build_alexnet
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
@@ -57,6 +58,7 @@ def test_alexnet_compiles_sharded():
     assert compiled is not None
 
 
+@pytest.mark.slow  # ~11s (targeted suite: test_alexnet)
 def test_ones_init_deterministic_mode():
     """--ones-init: the reference's PARAMETER_ALL_ONES build
     (conv_2d.cu:394-399) — every parameter is exactly ones, so two

@@ -167,6 +167,49 @@ def test_candle_uno_app_hybrid_granules(capsys):
     assert "THROUGHPUT =" in capsys.readouterr().out
 
 
+@pytest.mark.slow  # ~82s (auto picks a deep layer-wise pipeline);
+# tier-1 keeps -s auto covered by the candle_uno e2e below
+def test_alexnet_app_auto_strategy(capsys):
+    """``-s auto`` (ISSUE 6): the execution-config autotuner runs at
+    launch (search-then-run), prints the chosen config and the
+    predicted-vs-measured step time, and the run completes under the
+    winner — on every app via apps/common.py."""
+    assert alexnet.main([
+        "-b", "8", "-i", "2", "-ll:tpu", "8", "--image-size", "67",
+        "-s", "auto", "--search-iters", "200",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "auto: chose" in out
+    assert "predicted" in out and "measured" in out
+    assert "tp =" in out  # trained under the winner
+
+
+def test_candle_uno_app_auto_strategy_with_telemetry(tmp_path, capsys):
+    """``-s auto`` + ``--telemetry``: the choice lands in the JSONL as
+    a ``search`` event (reconstructable from the log alone), and a
+    SECOND run calibrates from the first run's log via --calibration."""
+    import json
+
+    args = ["-b", "8", "-i", "2", "-s", "auto", "--search-iters", "100",
+            "--dense-layers", "64-64", "--dense-feature-layers", "32",
+            "--telemetry", str(tmp_path)]
+    assert candle_uno.main(args) == 0
+    logs = sorted(tmp_path.glob("run-*.jsonl"))
+    assert logs
+    events = [json.loads(l) for l in logs[-1].read_text().splitlines()]
+    search_evs = [e for e in events if e["ev"] == "search"]
+    assert len(search_evs) == 1
+    ev = search_evs[0]
+    assert ev["chosen"]["steps_per_call"] >= 1
+    assert ev["baseline"]["label"] == "app-default"
+    assert ev["predicted_ms"] > 0 and ev["candidates"] > 1
+    # run 2: calibrated from run 1's telemetry log.
+    assert candle_uno.main(
+        args[:-2] + ["--calibration", str(logs[-1])]
+    ) == 0
+    assert "calibrated from" in capsys.readouterr().out
+
+
 def test_alexnet_app_inline_search(capsys):
     """--search: launch-time automatic parallelization (the reference's
     offline simulator run folded into the app); the searched table must

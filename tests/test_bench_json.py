@@ -80,6 +80,15 @@ def stubbed_bench(monkeypatch):
         }),
     )
     monkeypatch.setattr(
+        bench, "bench_search",
+        lambda n, t: chatty({
+            "default_ms_per_step": 2.0, "auto_ms_per_step": 1.0,
+            "auto_speedup": 2.0, "auto_config": "full-mesh dp k=8",
+            "predicted_ms_per_step": 1.1, "search_wall_s": 0.5,
+            "calibrated": True,
+        }),
+    )
+    monkeypatch.setattr(
         bench, "bench_op_parallel_speedup",
         lambda n: {"op_parallel_speedup_sim": 1.5},
     )
@@ -122,6 +131,16 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert tele["step_ms_p95"] == 3.0
     assert tele["step_ms_max"] == 4.0
     assert tele["overhead_pct"] == 0.5
+    # The execution-autotuner leg (ISSUE 6): auto-chosen config with
+    # its predicted-vs-measured ms/step + the search wall time.
+    search = record["extra"]["search"]
+    assert search["default_ms_per_step"] == 2.0
+    assert search["auto_ms_per_step"] == 1.0
+    assert search["auto_speedup"] == 2.0
+    assert search["auto_config"] == "full-mesh dp k=8"
+    assert search["predicted_ms_per_step"] == 1.1
+    assert search["search_wall_s"] == 0.5
+    assert search["calibrated"] is True
     # The chatter landed on stderr, not stdout.
     assert "tp = " in err.getvalue()
 
@@ -135,6 +154,7 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     monkeypatch.setattr(stubbed_bench, "bench_superstep", boom)
     monkeypatch.setattr(stubbed_bench, "bench_pipeline", boom)
     monkeypatch.setattr(stubbed_bench, "bench_telemetry", boom)
+    monkeypatch.setattr(stubbed_bench, "bench_search", boom)
     out, err = io.StringIO(), io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     monkeypatch.setattr(sys, "stderr", err)
@@ -146,3 +166,4 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     assert "leg exploded" in record["extra"]["superstep_error"]
     assert "leg exploded" in record["extra"]["pipeline_error"]
     assert "leg exploded" in record["extra"]["telemetry_error"]
+    assert "leg exploded" in record["extra"]["search_error"]

@@ -170,6 +170,7 @@ class TestImageFolder:
         with pytest.raises(FileNotFoundError):
             load_image_folder(str(tmp_path), image_size=8)
 
+    @pytest.mark.slow  # ~21s app e2e (targeted suite: test_data)
     def test_alexnet_app_trains_on_image_folder(self, image_root):
         """End to end: the alexnet app consumes -d DIR (tiny
         resolution so the CPU mesh finishes fast)."""

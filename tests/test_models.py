@@ -175,6 +175,7 @@ def test_cnn_catalog_shapes(builder, image_size, final_hw):
     assert logits.shape == (2, 10)
 
 
+@pytest.mark.slow  # ~66s: the heaviest compile in the suite
 def test_inception_small_train_step(rng):
     # Inception at reduced size: verify a full step runs (compile-heavy
     # models are exercised shape-only above).

@@ -295,6 +295,7 @@ def test_adam_lr_schedules():
     assert d2 < d1 * 0.2
 
 
+@pytest.mark.slow  # ~28s app e2e (targeted suite: test_optim_remat)
 def test_lr_schedule_app_flags(capsys):
     from flexflow_tpu.apps import alexnet
 

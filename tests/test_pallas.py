@@ -226,6 +226,7 @@ def test_flash_chunked_matches_naive(rng, causal, monkeypatch):
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-5)
 
 
+@pytest.mark.slow  # ~15s pair (targeted suite: test_pallas)
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_chunked_grads(rng, causal, monkeypatch):
     monkeypatch.setattr(pk, "_chunk_len", lambda t, hd, it: 16)

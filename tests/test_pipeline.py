@@ -223,6 +223,7 @@ def test_pipeline_intra_stage_tp(rng):
     assert np.isfinite(float(met["train_loss"]))
 
 
+@pytest.mark.slow  # ~12s; tier1_smoke runs test_pipeline unfiltered
 def test_reference_readme_alexnet_table_runs():
     """The reference README's example AlexNet strategy (README.md:42-51)
     verbatim: overlapping device subsets (GPU 0 serves five layers),

@@ -313,6 +313,7 @@ def test_pipeline_chunk_cli():
         FFConfig.parse_args(["--pipeline-chunk", "0"])
 
 
+@pytest.mark.slow  # ~8s app e2e; tier1_smoke runs it unfiltered
 def test_pipeline_chunk_app_end_to_end():
     """--pipeline --pipeline-chunk --steps-per-call through the shared
     app harness (the test_apps nmt --pipeline pattern)."""
@@ -401,6 +402,7 @@ def _nc_store():
     [(_s4_store, 4, 16), (_s4_store, 3, 24), (_nc_store, 4, 16)],
     ids=["S4_n2", "S4_odd_m", "S2_nested_n2c2"],
 )
+@pytest.mark.slow  # ~14s matrix; tier1_smoke runs it unfiltered
 def test_compiled_parity_corners(store_fn, mb, batch):
     """S=4 stage chains, m=3 (non-divisible 1f1b fill), and nested
     n/c sharding inside stages (the Linear contraction pin,
@@ -657,6 +659,7 @@ def test_compiled_fallback_unverified_degrees(caplog):
     assert isinstance(ex, PipelineExecutor) and not ex.compiled
 
 
+@pytest.mark.slow  # ~6s app e2e; tier1_smoke runs it unfiltered
 def test_compiled_cli_and_app_end_to_end():
     """--pipeline-compiled parses and drives the fused superstep path
     through the shared app harness."""

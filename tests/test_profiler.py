@@ -66,6 +66,7 @@ def test_trace_writes_profile(tmp_path):
     assert found, "no trace events written"
 
 
+@pytest.mark.slow  # ~28s app e2e (targeted suite: test_profiler)
 def test_trace_flag_wires_through_fit(tmp_path):
     """--trace DIR captures the timed loop (app surface of the trace()
     context); jax writes at least one .xplane.pb under the dir."""

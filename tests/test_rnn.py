@@ -187,6 +187,7 @@ def test_lstm_initial_state_chaining(rng):
     np.testing.assert_allclose(np.asarray(outs["dec:out"]), y_ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # ~11s (targeted suite: test_rnn)
 def test_nmt_trains_sharded(rng):
     """Full NMT stack under the pipeline strategy: loss finite and
     decreasing over a few steps."""

@@ -7,6 +7,9 @@ here pin the scheduler's exact semantics (device timelines, channel
 contention, rect-intersection comm volumes).
 """
 
+import logging
+import os
+
 import numpy as np
 import pytest
 
@@ -222,6 +225,7 @@ class TestEndToEndSearch:
         assert res.dp_time_us != pytest.approx(res2.dp_time_us)
         assert res.best_time_us <= res.dp_time_us
 
+    @pytest.mark.slow  # ~3 min of live per-op microbenchmarks
     def test_cli_measured_mode(self, tmp_path, capsys):
         """``python -m flexflow_tpu.search --measured`` microbenches
         every op live (the reference's measured simulator inputs,
@@ -247,6 +251,7 @@ class TestEndToEndSearch:
         res = search_strategy(ff, num_devices=8, iters=500, seed=0)
         _run_one_train_step(ff, res.store, 10, (8, 67, 67, 3))
 
+    @pytest.mark.slow  # ~78s Inception compile (targeted: test_search)
     def test_inception_op_parallel_strategy_runs(self):
         """BASELINE config #2: Inception-V3 blocks under a searched
         n/c/h/w operator-parallel strategy on 4 chips (virtual mesh).
@@ -548,6 +553,478 @@ class TestSearchTemperature:
             iters=20_000, seed=0,
         )
         assert res.speedup > 1.03
+
+
+def _mlp(batch=8, width=32, ndev_classes=4, seed=3):
+    """Tiny MLP for execution-config search tests (fast compiles)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.graph import FFModel
+
+    ff = FFModel(FFConfig(batch_size=batch, seed=seed))
+    x = ff.create_tensor((batch, width), name="x")
+    lbl = ff.create_tensor((batch,), dtype=jnp.int32, name="label")
+    t = ff.dense(x, width, activation="relu", name="fc1")
+    t = ff.dense(t, width, activation="relu", name="fc2")
+    t = ff.dense(t, ndev_classes, name="head")
+    ff.softmax(t, lbl, name="softmax")
+    return ff
+
+
+class TestCalibration:
+    """The dispatch/fence constant loader (search/cost_model.py):
+    fitted from a run's own JSONL telemetry, with the measured-host
+    defaults as the LOUD uncalibrated fallback (SEARCH.md protocol)."""
+
+    def test_defaults_are_uncalibrated(self):
+        from flexflow_tpu.search.cost_model import (
+            DEFAULT_DISPATCH_MS,
+            DEFAULT_FENCE_MS,
+            Calibration,
+        )
+
+        cal = Calibration()
+        assert not cal.calibrated
+        assert cal.dispatch_ms == DEFAULT_DISPATCH_MS
+        assert cal.fence_ms == DEFAULT_FENCE_MS
+        assert "uncalibrated" in cal.describe()
+
+    def test_from_run_end_calibration_block(self, tmp_path):
+        """A complete log's run_end ``calibration`` block wins — the
+        single-run protocol (OBSERVABILITY.md)."""
+        import json
+
+        from flexflow_tpu.search import Calibration
+
+        path = tmp_path / "run-1.jsonl"
+        events = [
+            {"ev": "run_start", "seq": 0},
+            {"ev": "step", "seq": 1, "wall_s": 0.004},
+            {"ev": "run_end", "seq": 2, "calibration": {
+                "steps": 30, "fences_per_step": 0.066,
+                "programs_per_step": 16.0, "step_ms_p50": 17.6,
+                "dispatch_ms_per_program": 1.1, "fence_ms": 0.9,
+                "fence_samples": 2,
+            }},
+        ]
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        cal = Calibration.from_jsonl(str(path))
+        assert cal.calibrated
+        assert cal.dispatch_ms == pytest.approx(1.1)
+        assert cal.fence_ms == pytest.approx(0.9)
+        assert cal.step_ms_p50 == pytest.approx(17.6)
+        assert cal.programs_per_step == pytest.approx(16.0)
+        assert cal.steps == 30
+        # Complete accounting + no `search` event: this run can anchor
+        # the compute-scale fit.
+        assert cal.complete and not cal.auto_executed
+
+    def test_from_truncated_log_rederives(self, tmp_path):
+        """A crashed run's log has no run_end: the constants re-derive
+        from the raw step/fence/superstep events (min non-warmup fence
+        = round-trip floor; step p50)."""
+        import json
+
+        from flexflow_tpu.search import Calibration
+
+        path = tmp_path / "run-crashed.jsonl"
+        events = (
+            [{"ev": "run_start", "seq": 0}]
+            + [{"ev": "fence", "label": "warmup", "wall_s": 0.5}]
+            + [{"ev": "step", "step": i, "wall_s": 0.010 + 0.001 * (i % 3)}
+               for i in range(9)]
+            + [{"ev": "fence", "label": "log", "wall_s": 0.002},
+               {"ev": "fence", "label": "log", "wall_s": 0.003}]
+        )
+        path.write_text("\n".join(json.dumps(e) for e in events)
+                        + '\n{"torn tail')  # crashed mid-write
+        cal = Calibration.from_jsonl(str(path))
+        assert cal.calibrated
+        assert cal.step_ms_p50 == pytest.approx(11.0)
+        # min non-warmup fence, NOT the 500ms compile-inclusive warmup.
+        assert cal.fence_ms == pytest.approx(2.0)
+        assert cal.steps == 9
+        # Truncated: programs-per-step may be unrecoverable, so this
+        # source must NOT anchor the compute-scale fit.
+        assert not cal.complete
+
+    def test_missing_file_falls_back_loudly(self, tmp_path, caplog):
+        from flexflow_tpu.search import Calibration
+
+        with caplog.at_level(logging.WARNING, logger="ff.search"):
+            cal = Calibration.from_jsonl(str(tmp_path / "nope.jsonl"))
+        assert not cal.calibrated
+        assert any("uncalibrated" in r.message for r in caplog.records)
+
+    def test_from_dir_picks_latest_excluding_active(self, tmp_path):
+        import json
+
+        from flexflow_tpu.search import Calibration
+
+        old = tmp_path / "run-a.jsonl"
+        new = tmp_path / "run-b.jsonl"
+        for p, fence in ((old, 3.0), (new, 7.0)):
+            p.write_text(json.dumps({
+                "ev": "run_end",
+                "calibration": {"steps": 4, "fences_per_step": 1.0,
+                                "fence_ms": fence, "fence_samples": 4},
+            }) + "\n")
+        os.utime(old, (1, 1))
+        assert Calibration.from_dir(str(tmp_path)).fence_ms == 7.0
+        # The ACTIVE run's own (still-empty) file must not self-feed.
+        cal = Calibration.from_dir(str(tmp_path), exclude=str(new))
+        assert cal.fence_ms == 3.0
+
+
+class TestExecutionConfigAccounting:
+    """programs/fences-per-step must be the EXACT formulas the run
+    telemetry pins (OBSERVABILITY.md dispatch audit): ``2*S*ceil(m/c)``
+    host-driven, ``1/k`` fused/compiled — the searcher optimizing any
+    OTHER accounting would tune a phantom runtime."""
+
+    def _ecfg(self, **kw):
+        from flexflow_tpu.parallel.strategy import StrategyStore
+        from flexflow_tpu.search.execution import ExecutionConfig
+
+        return ExecutionConfig(store=StrategyStore.data_parallel(8), **kw)
+
+    def test_host_pipeline_programs(self):
+        assert self._ecfg(stages=4, microbatches=8).programs_per_step() == 64
+        assert self._ecfg(
+            stages=4, microbatches=8, chunk=8
+        ).programs_per_step() == 8
+        # Non-divisible chunk tail: ceil(8/3) = 3 chunk programs/stage.
+        assert self._ecfg(
+            stages=2, microbatches=8, chunk=3
+        ).programs_per_step() == 2 * 2 * 3
+        # Accum lowers onto the microbatch loop (a*m microbatches).
+        assert self._ecfg(
+            stages=2, microbatches=4, accum_steps=2
+        ).programs_per_step() == 2 * 2 * 8
+
+    def test_fused_paths_are_one_program_per_k(self):
+        assert self._ecfg().programs_per_step() == 1.0
+        assert self._ecfg(steps_per_call=8).programs_per_step() == 1 / 8
+        assert self._ecfg(
+            stages=4, microbatches=8, compiled=True, steps_per_call=8
+        ).programs_per_step() == 1 / 8
+
+    def test_fence_accounting(self):
+        assert self._ecfg().fences_per_step() == 0.0  # unfenced k=1 loop
+        assert self._ecfg(steps_per_call=8).fences_per_step() == 1 / 8
+        # The loudly-warned clip-norm floor on the host-driven pipeline.
+        assert self._ecfg(
+            stages=4, microbatches=8
+        ).fences_per_step(clip_norm=1.0) == 1.0
+        assert self._ecfg(
+            stages=4, microbatches=8, compiled=True
+        ).fences_per_step(clip_norm=1.0) == 0.0  # device-side clip
+
+
+# PIPELINE_OVERHEAD.md round 7 (2026-08-04, 8-dev virtual CPU mesh,
+# 30 timed steps, same-day A/B) — the recorded dispatch-amortization
+# sweeps the simulator must reproduce the ranking of.  ms/step.
+_R7_DISPATCH_BOUND = {  # S=4 mb=8, b64 x w256: dispatch dominates
+    "host_c1": 113.7,      # 64 programs/step
+    "host_cm": 50.6,       # c=m=8 -> 8 programs/step
+    "compiled": 43.4,      # 1 program/step
+    "compiled_k8": 45.9,   # 1/8 programs/step (fence-neutral on CPU)
+}
+_R7_COMPUTE_BOUND = {  # S=2 mb=8, b512 x w1024: compute dominates
+    "host_c1": 2308.0,     # 32 programs/step
+    "host_cm": 1882.0,     # c=m=8 -> 4 programs/step
+    "compiled": 1917.0,    # 1 program/step
+}
+# Same-day re-measurement drift on this box is ~7% (round 6/7 notes);
+# measured pairs closer than that are ties the predictor need not
+# (and cannot honestly) order.
+_R7_NOISE = 1.07
+
+
+class TestRankingConsistency:
+    """ISSUE 6 acceptance: simulator-predicted ranking matches the
+    MEASURED ranking across the dispatch-amortization variants at one
+    dispatch-bound and one compute-bound shape — golden recorded
+    constants, no live timing in tier-1."""
+
+    def _predict(self, recorded, S, m, dispatch_ms):
+        from flexflow_tpu.parallel.strategy import StrategyStore
+        from flexflow_tpu.search.cost_model import Calibration
+        from flexflow_tpu.search.execution import (
+            REMAT_FACTOR,
+            ExecutionConfig,
+            predict_step_ms,
+        )
+
+        # The calibration protocol applied to the recorded sweep: the
+        # compiled row is compute + ONE dispatch, so the recorded
+        # compute term is its ms minus one program's dispatch.
+        compute_us = (recorded["compiled"] - dispatch_ms) / REMAT_FACTOR * 1e3
+        cal = Calibration(dispatch_ms=dispatch_ms, fence_ms=dispatch_ms,
+                          calibrated=True)
+        store = StrategyStore.data_parallel(8)
+        variants = {
+            "host_c1": ExecutionConfig(store=store, stages=S,
+                                       microbatches=m, chunk=1),
+            "host_cm": ExecutionConfig(store=store, stages=S,
+                                       microbatches=m, chunk=m),
+            "compiled": ExecutionConfig(store=store, stages=S,
+                                        microbatches=m, compiled=True),
+            "compiled_k8": ExecutionConfig(store=store, stages=S,
+                                           microbatches=m, compiled=True,
+                                           steps_per_call=8),
+        }
+        return {
+            name: predict_step_ms(None, e, 8, calibration=cal,
+                                  compute_us=compute_us)
+            for name, e in variants.items()
+        }
+
+    def _assert_ranking_matches(self, recorded, predicted):
+        """Every measured-distinguishable pair (outside the recorded
+        noise floor) must be predicted in the measured order."""
+        for a in recorded:
+            for b in recorded:
+                if recorded[a] > recorded[b] * _R7_NOISE:
+                    assert predicted[a] > predicted[b], (
+                        f"measured {a}={recorded[a]} > {b}={recorded[b]} "
+                        f"but predicted {predicted[a]:.2f} <= "
+                        f"{predicted[b]:.2f}"
+                    )
+
+    def test_dispatch_bound_shape(self):
+        rec = _R7_DISPATCH_BOUND
+        # Per-program host dispatch fitted from the sweep itself:
+        # (c1 - compiled) / (64 - 1 programs) ~= 1.1 ms/program.
+        dispatch_ms = (rec["host_c1"] - rec["compiled"]) / 63.0
+        pred = self._predict(rec, S=4, m=8, dispatch_ms=dispatch_ms)
+        self._assert_ranking_matches(rec, pred)
+        # c1 is exact by construction; the INDEPENDENT c=m point must
+        # land near its measured value (the linear-dispatch model).
+        assert pred["host_c1"] == pytest.approx(rec["host_c1"], rel=1e-6)
+        assert pred["host_cm"] == pytest.approx(rec["host_cm"], rel=0.15)
+        # Dispatch amortization must never be predicted as a slowdown.
+        assert pred["compiled_k8"] <= pred["compiled"]
+
+    def test_compute_bound_shape(self):
+        rec = _R7_COMPUTE_BOUND
+        # Same host: the DISPATCH-bound sweep's constant carries over.
+        dispatch_ms = (
+            _R7_DISPATCH_BOUND["host_c1"] - _R7_DISPATCH_BOUND["compiled"]
+        ) / 63.0
+        pred = self._predict(rec, S=2, m=8, dispatch_ms=dispatch_ms)
+        pred.pop("compiled_k8")  # not recorded at this shape
+        self._assert_ranking_matches(rec, pred)
+        # Where compute dominates, the predictor must NOT promise the
+        # dispatch-bound win: predicted compiled-vs-c1 gain small here,
+        # large at the dispatch-bound shape (matching 1.08x vs 2.6x
+        # measured).
+        gain_compute = pred["host_c1"] / pred["compiled"]
+        assert gain_compute < 1.10
+        pred_db = self._predict(_R7_DISPATCH_BOUND, S=4, m=8,
+                                dispatch_ms=dispatch_ms)
+        assert pred_db["host_c1"] / pred_db["compiled"] > 1.5
+
+
+class TestExecutionSearch:
+    """search_execution_config: the full execution-config space, with
+    legality REUSED from the runtime so every emitted candidate is
+    executor-legal (ISSUE 6 acceptance)."""
+
+    def test_every_emitted_candidate_is_runnable(self, caplog):
+        """Each config the searcher emits executes without a loud
+        fallback — built via make_executor and trained one superstep's
+        worth of iterations at ITS steps_per_call."""
+        import jax
+
+        from flexflow_tpu.optim import SGDOptimizer
+        from flexflow_tpu.runtime.pipeline import (
+            PipelineExecutor,
+            make_executor,
+        )
+        from flexflow_tpu.runtime.trainer import Trainer
+        from flexflow_tpu.search import search_execution_config
+
+        ff = _mlp()
+        res = search_execution_config(
+            ff, 4, iters=200, seed=0, ks=(1, 4),
+            stage_options=(2,), microbatch_options=(2,),
+        )
+        assert len(res.candidates) >= 4
+        families = set()
+        for ecfg in res.candidates:
+            families.add((ecfg.stages, ecfg.compiled))
+            with caplog.at_level(logging.WARNING):
+                caplog.clear()
+                ex = make_executor(
+                    ff, ecfg.store if ecfg.store.table else None,
+                    optimizer=SGDOptimizer(lr=0.01),
+                    devices=jax.devices()[:4],
+                    microbatches=ecfg.microbatches, chunk=ecfg.chunk,
+                    compiled=ecfg.compiled,
+                )
+                stats = Trainer(ex).fit(
+                    iterations=max(ecfg.steps_per_call, 1), warmup=0,
+                    steps_per_call=ecfg.steps_per_call,
+                )
+            fallback = [
+                r.message for r in caplog.records
+                if "falling back" in r.message or "refus" in r.message
+                or "unavailable" in r.message
+            ]
+            assert not fallback, (ecfg.describe(), fallback)
+            # The requested dispatch form was REALIZED, not degraded.
+            if ecfg.compiled:
+                assert isinstance(ex, PipelineExecutor) and ex.compiled
+            elif ecfg.layer_wise:
+                assert isinstance(ex, PipelineExecutor) and not ex.compiled
+            else:
+                assert not isinstance(ex, PipelineExecutor)
+            assert np.isfinite(stats["loss"])
+        # The reduced space still exercised every family: full-mesh,
+        # host-driven pipeline, compiled pipeline.
+        assert (1, False) in families and (2, False) in families
+        assert (2, True) in families
+
+    def test_search_space_legality_reuse(self):
+        """Candidate k-values route through the runtime's OWN
+        superstep_mode: amortized strategies under --resilient stay at
+        k=1 (the loop refuses k>1 there), compiled candidates appear
+        only when compiled_unsupported_reason is None."""
+        from flexflow_tpu.runtime.pipeline import (
+            compiled_unsupported_reason,
+        )
+        from flexflow_tpu.search import search_execution_config
+
+        ff = _mlp()
+        res = search_execution_config(
+            ff, 4, iters=0, seed=0, ks=(1, 4),
+            stage_options=(2,), microbatch_options=(2,), resilient=True,
+        )
+        for c in res.candidates:
+            if c.layer_wise and not c.compiled:
+                assert c.steps_per_call == 1
+            if c.compiled:
+                assert compiled_unsupported_reason(ff, c.store) is None
+
+    def test_calibration_steers_the_winner(self):
+        """The dispatch term must actually steer: an expensive
+        per-program host (relay-like) pushes the winner to the fused
+        minimum-dispatch form; a free-dispatch host ranks by compute
+        alone and keeps programs-per-step irrelevant."""
+        from flexflow_tpu.search import Calibration, search_execution_config
+
+        ff = _mlp()
+        relay = search_execution_config(
+            ff, 4, iters=0, seed=0, ks=(1, 8),
+            stage_options=(2,), microbatch_options=(2,),
+            calibration=Calibration(dispatch_ms=16.0, fence_ms=16.0,
+                                    calibrated=True),
+        )
+        assert relay.best.programs_per_step() <= 1 / 8
+        free = search_execution_config(
+            ff, 4, iters=0, seed=0, ks=(1, 8),
+            stage_options=(2,), microbatch_options=(2,),
+            calibration=Calibration(dispatch_ms=0.0, fence_ms=0.0,
+                                    calibrated=True),
+        )
+        by_compute = min(free.candidates, key=lambda c: c.compute_ms)
+        assert free.best.predicted_ms == pytest.approx(
+            by_compute.compute_ms
+        )
+
+    def test_compute_scale_fit_from_measured_p50(self):
+        """A calibrated step_ms_p50 anchors the compute term: measured
+        p50 minus the run's OWN dispatch/fence overhead is what the
+        baseline's simulated compute must scale to."""
+        from flexflow_tpu.search import Calibration, search_execution_config
+
+        ff = _mlp()
+        cal = Calibration(dispatch_ms=1.0, fence_ms=1.0, calibrated=True,
+                          step_ms_p50=21.0, programs_per_step=1.0,
+                          fences_per_step=0.0, steps=30, complete=True)
+        res = search_execution_config(
+            ff, 4, iters=0, seed=0, ks=(1,),
+            stage_options=(2,), microbatch_options=(2,), calibration=cal,
+        )
+        # baseline = DP k=1: predicted = scale*compute + 1 dispatch
+        # must equal the measured p50 the scale was solved from.
+        assert res.baseline.predicted_ms == pytest.approx(21.0, rel=1e-6)
+        assert res.compute_scale > 0
+
+    def test_auto_run_calibration_does_not_anchor_scale(self, tmp_path):
+        """A calibration log that carries a ``search`` event trained
+        under an auto-CHOSEN config: its step p50 measures the winner,
+        not the baseline, so the compute-scale fit must be skipped
+        (the dispatch/fence constants still apply)."""
+        import json
+
+        from flexflow_tpu.search import Calibration, search_execution_config
+
+        path = tmp_path / "run-auto.jsonl"
+        events = [
+            {"ev": "run_start"},
+            {"ev": "search", "chosen": {"label": "won"}},
+            {"ev": "run_end", "calibration": {
+                "steps": 20, "fences_per_step": 0.0, "step_ms_p50": 5.0,
+                "fence_ms": 1.25, "fence_samples": 1,
+            }},
+        ]
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        cal = Calibration.from_jsonl(str(path))
+        assert cal.calibrated and cal.auto_executed
+        res = search_execution_config(
+            _mlp(), 4, iters=0, seed=0, ks=(1,),
+            stage_options=(2,), microbatch_options=(2,), calibration=cal,
+        )
+        assert res.compute_scale == 1.0
+        assert res.calibration.fence_ms == pytest.approx(1.25)
+
+    def test_search_result_is_deterministic(self):
+        from flexflow_tpu.search import search_execution_config
+
+        ff = _mlp()
+        a = search_execution_config(ff, 4, iters=300, seed=0,
+                                    stage_options=(2,),
+                                    microbatch_options=(2,))
+        b = search_execution_config(ff, 4, iters=300, seed=0,
+                                    stage_options=(2,),
+                                    microbatch_options=(2,))
+        assert a.best.describe() == b.best.describe()
+        assert a.best.predicted_ms == pytest.approx(b.best.predicted_ms)
+
+    def test_cli_auto_mode(self, tmp_path, capsys):
+        """``python -m flexflow_tpu.search --auto`` prints the ranked
+        execution configs + the app flags that run the winner, and
+        still writes a loadable strategy file."""
+        from flexflow_tpu.search.__main__ import main
+
+        out = tmp_path / "strategy.json"
+        assert main([
+            "--model", "alexnet", "-b", "8", "--devices", "4",
+            "--iters", "200", "--auto", "-o", str(out),
+        ]) in (0, None)
+        printed = capsys.readouterr().out
+        assert "best    =" in printed
+        assert "run it: -s" in printed
+        assert "uncalibrated" in printed  # no calibration file given
+        StrategyStore.load(str(out))
+
+    def test_build_stage_partition_legality(self):
+        """The synthetic stage-partition builder returns None (skip)
+        rather than an illegal store: stage count vs ops, divisibility
+        of the batch across microbatches x intra-stage DP."""
+        from flexflow_tpu.search.problem import build_stage_partition
+
+        ff = _mlp(batch=8)
+        store = build_stage_partition(ff, 8, 2, microbatches=2)
+        assert store is not None and store.layer_wise
+        # 4 ops cannot split into 8 stages; 8 devices % 3 stages != 0.
+        assert build_stage_partition(ff, 8, 8) is None
+        assert build_stage_partition(ff, 8, 3) is None
+        # batch 8 / m=4 = 2 rows, intra-stage DP n=4 cannot shard them.
+        assert build_stage_partition(ff, 8, 2, microbatches=4) is None
 
 
 class TestScheduleValidation:

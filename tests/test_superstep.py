@@ -261,6 +261,7 @@ def test_steps_per_call_cli():
         FFConfig.parse_args(["--steps-per-call", "0"])
 
 
+@pytest.mark.slow  # ~42s app e2e; tier1_smoke runs it unfiltered
 def test_steps_per_call_app_end_to_end():
     """The shared app harness drives the superstep path (the
     test_zero_opt CLI-flag pattern)."""

@@ -93,6 +93,36 @@ def test_event_schema_golden(tmp_path):
             <= stats["telemetry"]["step_ms_max"])
 
 
+def test_run_end_calibration_block(tmp_path):
+    """ISSUE 6: ``run_end`` carries a ``calibration`` block — the
+    dispatch/fence constants the execution autotuner
+    (search/cost_model.Calibration) fits from ONE ``--telemetry`` run
+    (OBSERVABILITY.md schema)."""
+    with Telemetry(str(tmp_path)) as tel:
+        Trainer(_executor()).fit(iterations=4, warmup=1, log_every=2)
+    cal = _events(tel.path)[-1]["calibration"]
+    assert cal["steps"] == 4
+    # STEADY-STATE fences/step: the 2 log_every readbacks over 4 steps;
+    # the once-per-run warmup/final fences are excluded (they are also
+    # excluded from fence_ms — the fit multiplies the two together).
+    assert cal["fences_per_step"] == 0.5
+    assert cal["step_ms_p50"] > 0
+    # fence_ms = the MINIMUM non-warmup/final fence (round-trip floor);
+    # the compile-inclusive warmup and run-draining final are excluded.
+    assert cal["fence_samples"] == 2  # the two log_every readbacks
+    log_walls = [e["wall_s"] * 1e3 for e in _events(tel.path)
+                 if e["ev"] == "fence" and e["label"] == "log"]
+    assert cal["fence_ms"] == pytest.approx(min(log_walls), abs=2e-3)
+    # The loader round-trips the block into calibrated constants.
+    from flexflow_tpu.search import Calibration
+
+    loaded = Calibration.from_jsonl(tel.path)
+    assert loaded.calibrated
+    assert loaded.fence_ms == cal["fence_ms"]
+    assert loaded.step_ms_p50 == cal["step_ms_p50"]
+    assert Calibration.from_telemetry(tel).fence_ms == cal["fence_ms"]
+
+
 def test_superstep_one_fence_per_superstep(tmp_path):
     with Telemetry(str(tmp_path)) as tel:
         stats = Trainer(_executor()).fit(iterations=8, warmup=2,

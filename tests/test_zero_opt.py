@@ -96,6 +96,7 @@ def test_zero_opt_composes_with_tp():
     assert spec and spec[0], spec  # dim0 gained the DP axis
 
 
+@pytest.mark.slow  # ~19s app e2e (targeted suite: test_zero_opt)
 def test_zero_opt_cli_flag():
     assert FFConfig.parse_args(["--zero-opt"]).zero_sharded_optimizer
     from flexflow_tpu.apps import alexnet

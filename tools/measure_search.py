@@ -1,0 +1,145 @@
+"""Auto-vs-default execution-config A/B on real apps (SEARCH.md).
+
+The acceptance run for ``-s auto`` (ISSUE 6): for each app, train
+under the app's hand-written default strategy at the default execution
+config (k=1, per-step dispatch), calibrate the dispatch/fence cost
+model from that leg's OWN in-memory telemetry, run
+``search_execution_config``, then train under the chosen config — a
+same-day A/B on the 8-dev virtual CPU mesh (the same methodology as
+``tools/measure_superstep.py`` / ``measure_pipeline.py``; run with
+``env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python
+tools/measure_search.py``).
+
+The virtual mesh is dispatch-bound at these shapes (one core
+multiplexing 8 devices — PIPELINE_OVERHEAD.md), which is exactly the
+regime the autotuner's dispatch/fence term models; on the live chip
+the same flow runs through ``bench.py``'s ``search`` leg.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.runtime.pipeline import make_executor
+from flexflow_tpu.runtime.telemetry import Telemetry
+from flexflow_tpu.runtime.trainer import Trainer
+from flexflow_tpu.search import Calibration, search_execution_config
+from flexflow_tpu.search.execution import ExecutionConfig
+
+
+def _apps(batch: int, nd: int):
+    """(name, model, default strategy store) for the A/B apps — the
+    apps' own builders and hand-written default strategies, sized to
+    the live mesh (``nd`` devices)."""
+    out = []
+
+    from flexflow_tpu.models.candle_uno import (
+        CandleConfig,
+        build_candle_uno,
+        candle_uno_strategy,
+    )
+
+    candle = CandleConfig(
+        dense_layers=[256, 128], dense_feature_layers=[256, 128]
+    )
+    ff = build_candle_uno(
+        batch_size=batch, candle=candle,
+        config=FFConfig(batch_size=batch, seed=17),
+    )
+    out.append(("candle_uno", ff, candle_uno_strategy(nd, candle)))
+
+    from flexflow_tpu.models.dlrm import (
+        build_dlrm,
+        dlrm_random_benchmark_config,
+        dlrm_strategy,
+    )
+
+    dcfg = dlrm_random_benchmark_config(num_tables=8)
+    dcfg.embedding_size = [2000] * 8  # CPU-mesh scale (bench.py's cut)
+    ff = build_dlrm(batch, dcfg, config=FFConfig(batch_size=batch, seed=17))
+    out.append(("dlrm", ff, dlrm_strategy(nd, dcfg)))
+
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    ff = build_alexnet(batch_size=batch, image_size=67, num_classes=10,
+                       config=FFConfig(batch_size=batch, seed=17))
+    out.append(("alexnet", ff, None))
+    return out
+
+
+def _fit_ms(ex, iters: int, k: int = 1) -> float:
+    stats = Trainer(ex).fit(iterations=iters, warmup=2, steps_per_call=k)
+    return stats["elapsed_s"] / iters * 1e3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="measure_search")
+    ap.add_argument("-b", "--batch-size", type=int, default=64)
+    ap.add_argument("-i", "--iterations", type=int, default=32)
+    ap.add_argument("--search-iters", type=int, default=3000)
+    args = ap.parse_args(argv)
+
+    nd = len(jax.devices())
+    rows = []
+    for name, ff, default in _apps(args.batch_size, nd):
+        opt = lambda: SGDOptimizer(lr=0.01, momentum=0.9)
+        ex = make_executor(ff, default, optimizer=opt())
+        with Telemetry() as tel:
+            default_ms = _fit_ms(ex, args.iterations)
+        cal = Calibration.from_telemetry(tel)
+        from flexflow_tpu.parallel.strategy import StrategyStore
+
+        base_store = default or StrategyStore.data_parallel(nd)
+        baseline = ExecutionConfig(store=base_store, label="app-default")
+        t0 = time.perf_counter()
+        res = search_execution_config(
+            ff, nd, iters=args.search_iters, seed=0, calibration=cal,
+            ks=(1, 2, 4, 8, 16), baseline=baseline,
+        )
+        wall = time.perf_counter() - t0
+        best = res.best
+        ex = make_executor(
+            ff, best.store if best.store.table else None, optimizer=opt(),
+            microbatches=best.microbatches, chunk=best.chunk,
+            compiled=best.compiled,
+        )
+        auto_ms = _fit_ms(ex, args.iterations, k=best.steps_per_call)
+        rows.append({
+            "app": name,
+            "default_ms_per_step": round(default_ms, 3),
+            "auto_ms_per_step": round(auto_ms, 3),
+            "speedup": round(default_ms / max(auto_ms, 1e-9), 3),
+            "auto_config": best.describe(),
+            "predicted_ms_per_step": round(best.predicted_ms, 3),
+            "search_wall_s": round(wall, 2),
+        })
+        print(f"{name:12s} default {default_ms:8.3f} ms/step | auto "
+              f"{auto_ms:8.3f} ms/step ({rows[-1]['speedup']:.2f}x) | "
+              f"{best.describe()} (predicted {best.predicted_ms:.3f}) | "
+              f"search {wall:.1f}s", flush=True)
+    print(json.dumps({"batch_size": args.batch_size,
+                      "iterations": args.iterations, "apps": rows}))
+    wins = sum(r["speedup"] > 1.0 for r in rows)
+    print(f"auto beats default on {wins}/{len(rows)} apps")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,9 +3,12 @@
 # ops/oracles, strategy numerics, the pipeline runtime (incl. the
 # chunked-scan dispatch + pipeline-superstep numerics,
 # test_pipeline_chunk.py), superstep execution, the resilience/
-# checkpoint subsystem, and the run-telemetry layer — ~4 min on the
-# 8-dev virtual CPU mesh, vs ~14 min+ for the full tier-1 run.
-# Single core box: no pytest-xdist.
+# checkpoint subsystem, the run-telemetry layer, and the
+# strategy/execution search — ~5 min on the 8-dev virtual CPU mesh,
+# vs ~14 min+ for the full suite.  Cases marked @pytest.mark.slow are
+# excluded here as in the tier-1 budget run; they stay covered by the
+# per-area targeted suites run WITHOUT the -m filter (CLAUDE.md
+# "Tests", pytest.ini).  Single core box: no pytest-xdist.
 #
 # Usage: ./tools/tier1_smoke.sh [extra pytest args]
 set -euo pipefail
@@ -19,4 +22,5 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
     tests/test_telemetry.py \
+    tests/test_search.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"
