@@ -3,5 +3,6 @@
 Each module is runnable (``python -m flexflow_tpu.apps.<name>``) and
 shares the FFConfig flag surface (``-e -b --lr --wd -d -s -ll:tpu -i``,
 ``config.py``): alexnet, cnn (legacy multi-model driver), dlrm,
-candle_uno, nmt, transformer.
+candle_uno, nmt, transformer — plus ``serve``, the inference serving
+driver (continuous-batching KV-cache decode, SERVING.md).
 """

@@ -72,7 +72,9 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --resilient (detection + checkpoint rollback + SIGTERM emergency save)
   --save-every N   --ckpt-dir PATH   --max-restarts N   --sync-ckpt
   --telemetry DIR (JSONL run telemetry + heartbeat + stall watchdog,
-                   OBSERVABILITY.md)   --stall-deadline S (0 = no watchdog)"""
+                   OBSERVABILITY.md)   --stall-deadline S (0 = no watchdog)
+  --stall-notify-pid PID (stall escalation: SIGUSR1 to an external
+                   supervisor pid on stall; never kills anything)"""
 
 
 def check_help(argv, doc: Optional[str]) -> None:

@@ -1,0 +1,161 @@
+"""Transformer LM serving driver — the inference half of the north
+star (SERVING.md; FlexFlow Serve lineage).
+
+Builds the transformer LM at serving shapes, restores params from a
+TRAINING checkpoint when ``--ckpt-dir`` names one (the
+strategy-portable train->serve handoff; fresh init otherwise), and
+drives the continuous-batching loop (``runtime/serving.py``) over a
+synthetic request stream: pad-to-bucket prefill per admission, K-token
+fused decode supersteps (one dispatch + one ``jax.device_get`` fence
+per K tokens across the whole slot batch), admit/evict between
+supersteps.
+
+Flags beyond the common set:
+  --max-seq N        serving context length (cache rows per slot; 64)
+  --max-batch N      decode slots (4)
+  --decode-steps K   fused decode tokens per dispatch (8, clamped 20)
+  --buckets A,B,..   prefill pad buckets (default max_seq/4, /2, full)
+  --requests N       synthetic request count (8)
+  --prompt-len LO:HI prompt length range (4:12)
+  --max-new N        generation budget per request (16)
+  --arrival-every N  one request eligible every N decode supersteps
+                     (0 = all at start, the burst pattern)
+  --eos ID           greedy EOS token id (unset = budget-bounded)
+  --no-decode-kernel force the pure-jnp decode oracle (A/B, tests)
+  --vocab --d-model --heads --layers   model shape (transformer app)
+
+Example::
+
+    python -m flexflow_tpu.apps.serve --max-seq 64 --max-batch 4 \
+        --decode-steps 8 --requests 8 --ckpt-dir ./ckpts
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from flexflow_tpu.apps.common import check_help, pop_int
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.transformer import build_transformer_lm
+
+
+def _pop_str(argv, flag, default):
+    if flag not in argv:
+        return default
+    i = argv.index(flag)
+    try:
+        val = argv[i + 1]
+    except IndexError:
+        raise SystemExit(f"{flag} expects a value")
+    del argv[i:i + 2]
+    return val
+
+
+def _dry_run(sex, decode_steps: int) -> int:
+    """Compute-free serving validation: eval_shape every prefill
+    bucket and the fused decode superstep, print the program/cache
+    table (the --dry-run contract of the training apps)."""
+    table = sex.abstract_programs(decode_steps=decode_steps)
+    print(f"{'program':<18} {'shape':<28} notes")
+    for name, aval in sorted(table["cache"].items()):
+        print(f"{'cache ' + name:<18} {str(tuple(aval.shape)):<28} "
+              f"{aval.dtype}")
+    for bucket, aval in sorted(table["prefill"].items()):
+        print(f"{'prefill L=' + str(bucket):<18} "
+              f"{'(1, ' + str(bucket) + ') -> token':<28} "
+              f"1 dispatch + 1 fence per admission")
+    toks = table["decode"]
+    print(f"{'decode k=' + str(decode_steps):<18} "
+          f"{str(tuple(toks.shape)) + ' tokens':<28} "
+          f"1 dispatch + 1 fence per {decode_steps} tokens")
+    print("DRY RUN OK (no device compute)")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    check_help(argv, __doc__)
+    max_seq = pop_int(argv, "--max-seq", 64)
+    max_batch = pop_int(argv, "--max-batch", 4)
+    decode_steps = pop_int(argv, "--decode-steps", 8)
+    n_requests = pop_int(argv, "--requests", 8)
+    max_new = pop_int(argv, "--max-new", 16)
+    arrival_every = pop_int(argv, "--arrival-every", 0)
+    eos = pop_int(argv, "--eos", -1)
+    vocab = pop_int(argv, "--vocab", 32 * 1024)
+    d_model = pop_int(argv, "--d-model", 512)
+    heads = pop_int(argv, "--heads", 8)
+    layers = pop_int(argv, "--layers", 4)
+    plen_s = _pop_str(argv, "--prompt-len", "4:12")
+    buckets_s = _pop_str(argv, "--buckets", "")
+    no_kernel = "--no-decode-kernel" in argv
+    if no_kernel:
+        argv.remove("--no-decode-kernel")
+    cfg = FFConfig.parse_args(argv)
+    try:
+        lo, hi = (int(v) for v in plen_s.split(":"))
+    except ValueError:
+        raise SystemExit("--prompt-len expects LO:HI")
+    if buckets_s:
+        buckets = tuple(int(b) for b in buckets_s.split(","))
+    else:
+        buckets = tuple(sorted({max(max_seq // 4, hi), max_seq // 2,
+                                max_seq}))
+    buckets = tuple(b for b in buckets if b <= max_seq)
+
+    from flexflow_tpu.runtime import telemetry as _telemetry
+    from flexflow_tpu.runtime.serving import (
+        Server,
+        ServingExecutor,
+        synthetic_requests,
+    )
+
+    ff = build_transformer_lm(
+        batch_size=max_batch, seq_len=max_seq, vocab_size=vocab,
+        d_model=d_model, num_heads=heads, num_layers=layers, config=cfg,
+    )
+    sex = ServingExecutor(
+        ff, cfg, max_batch=max_batch, max_seq=max_seq, buckets=buckets,
+        decode_kernel=False if no_kernel else None,
+    )
+    if cfg.dry_run:
+        return _dry_run(sex, decode_steps)
+
+    with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
+        if cfg.ckpt_dir:
+            step, params, state = sex.restore(cfg.ckpt_dir)
+            print(f"restored training checkpoint step {step} "
+                  f"from {cfg.ckpt_dir}")
+        else:
+            params, state = sex.init(cfg.seed)
+        requests = synthetic_requests(
+            n_requests, vocab, prompt_len=(lo, hi),
+            max_new_tokens=max_new, arrival_every=arrival_every,
+            seed=cfg.seed,
+        )
+        srv = Server(sex, params, state, decode_steps=decode_steps,
+                     eos_id=None if eos < 0 else eos)
+        t0 = time.perf_counter()
+        results, stats = srv.run(requests)
+        elapsed = time.perf_counter() - t0
+    print(f"requests = {stats['requests']} "
+          f"completed = {stats['completed']} failed = {stats['failed']}")
+    print(f"time = {elapsed:.4f}s")
+    print(f"tokens/s = {stats['tokens_per_s']:.1f}")
+    print(f"request latency p50 = {stats['request_latency_ms_p50']:.1f} ms "
+          f"p95 = {stats['request_latency_ms_p95']:.1f} ms")
+    print(f"decode supersteps = {stats['decode_supersteps']} "
+          f"(k={stats['decode_steps_per_call']}, 1 dispatch + 1 fence "
+          f"per superstep)")
+    if stats["failed"]:
+        for rid in sorted(results):
+            r = results[rid]
+            if r.error:
+                print(f"request {rid} FAILED: {r.error}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

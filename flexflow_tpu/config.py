@@ -211,6 +211,15 @@ class FFConfig:
     # TPU-claim holder wedges the tunnel).  0 disables the monitor
     # thread; only active when telemetry is on.
     stall_deadline_s: float = 300.0
+    # --stall-notify-pid PID: watchdog ESCALATION hook — on a stall the
+    # watchdog additionally sends SIGUSR1 to this external supervisor
+    # pid (e.g. a tools/tpu_watcher.sh wrapper), so an operator process
+    # learns about a silent relay wedge without polling the JSONL.
+    # The watchdog still NEVER kills anything, least of all its own
+    # process (the relay-wedge hazard); notification of an external
+    # observer is the only action.  0 = off.  FF_STALL_NOTIFY_PID in
+    # the environment sets it without flags.
+    stall_notify_pid: int = 0
     # --zero-opt: ZeRO-1-style optimizer-state sharding — each
     # parameter's optimizer moments (Adam m/v, SGD momentum) shard
     # their leading dim across the mesh axes the op's strategy assigns
@@ -359,6 +368,8 @@ class FFConfig:
                         f"--stall-deadline must be >= 0, got "
                         f"{cfg.stall_deadline_s}"
                     )
+            elif a == "--stall-notify-pid":
+                cfg.stall_notify_pid = int(_next())
             i += 1
         return cfg
 

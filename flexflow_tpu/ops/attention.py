@@ -39,6 +39,26 @@ _NEG_INF = -1e30
 _merge_lse = pallas_kernels.merge_lse
 
 
+def _einsum_decode(q, cache_k, cache_v, pos):
+    """Dense reference decode attention: one query per (batch, head)
+    against a (B, max_seq, h, hd) KV cache, f32 scores, masked to key
+    positions ``<= pos`` (the query's own position — its K/V are
+    already written into the cache).  ``q``: (B, h, hd); ``pos``: (B,)
+    int32.  The numerics oracle the Pallas ``flash_decode`` kernel is
+    pinned against (tests/test_serving.py), and the fallback when the
+    kernel does not support the cache shape."""
+    dtype = q.dtype
+    qf = q.astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    vf = cache_v.astype(jnp.float32)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhd,bshd->bhs", qf, kf) * scale
+    mask = jnp.arange(cache_k.shape[1])[None, :] <= pos[:, None]  # (B, S)
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    attn = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", attn, vf).astype(dtype)
+
+
 def _einsum_attention(q, k, v, causal: bool):
     """Dense reference attention on (b, h, t, hd) heads, f32 scores;
     returns the input dtype.  The fallback when no flash formulation
@@ -100,7 +120,18 @@ class PositionEmbedding(Op):
 
     def forward(self, params, xs, state, training):
         (x,) = xs
-        return [x + params["table"][None]], state
+        table = params["table"]
+        if "pos" in state:
+            # Serving inference mode (runtime/serving.py): ``pos`` is
+            # the per-slot position of this call's FIRST token.  Decode
+            # (t == 1) gathers one table row per slot; prefill starts
+            # every slot at position 0 and may be shorter than the
+            # declared sequence (pad-to-bucket), so slice.
+            if x.shape[1] == 1:
+                rows = jnp.take(table, state["pos"], axis=0)[:, None]
+                return [x + rows], state
+            return [x + table[None, : x.shape[1]]], state
+        return [x + table[None]], state
 
 
 def _streaming_attention_block(q, k, v, scores_mask, m, denom, acc):
@@ -211,6 +242,8 @@ class MultiHeadAttention(Op):
 
     def forward(self, params, xs, state, training):
         (x,) = xs
+        if "cache_k" in state:
+            return self._forward_cached(params, x, state)
         pc = getattr(self, "_pc", None)
         S = pc.s if pc is not None else 1
         q, k, v = self._project(params, x)
@@ -222,6 +255,73 @@ class MultiHeadAttention(Op):
         if self.attrs["use_bias"]:
             y = y + params["bo"]
         return [y], state
+
+    # -- KV-cache inference protocol (runtime/serving.py) -------------------
+    #
+    # The serving executor threads an inference mode through the
+    # existing ``state`` mechanism: when ``state`` carries
+    # ``cache_k``/``cache_v`` — preallocated (B, max_seq, heads,
+    # d_head) caches — plus the per-slot position vector ``pos`` (B,)
+    # int32, ``forward`` takes this path instead.  Two sub-modes by
+    # query length:
+    #
+    # - **prefill** (t > 1): the full-sequence causal forward — the
+    #   EXACT training attention path, so prefill logits are
+    #   bit-identical to a training forward on the same tokens — that
+    #   additionally writes this call's K/V into cache rows 0..t-1
+    #   (every prefilled slot starts at position 0; pad-to-bucket
+    #   rows beyond a prompt's true length hold pad-token K/V that
+    #   decode overwrites before its causal mask can reach them).
+    # - **decode** (t == 1): the token at position ``pos`` writes its
+    #   K/V at ``cache[b, pos[b]]`` and attends key positions
+    #   ``<= pos`` via the Pallas ``flash_decode`` kernel (q_len=1
+    #   streaming softmax over cache blocks) or the pure-jnp
+    #   ``_einsum_decode`` oracle.
+    #
+    # Training never sets cache keys, so the differentiable pure-jnp
+    # contract on the training path is untouched (the decode kernel
+    # has no VJP — it is reachable only from the forward-only serving
+    # programs, the same reachability discipline as the sparse
+    # protocol's scalar-prefetch kernels, ops/base.py).
+
+    #: Decode-kernel routing: None = auto (kernel when the cache shape
+    #: supports it), True/False force.  Static (bound by the serving
+    #: executor, like ``bind_mesh``) so the traced program is stable.
+    decode_kernel: Optional[bool] = None
+
+    def _forward_cached(self, params, x, state):
+        ck, cv = state["cache_k"], state["cache_v"]
+        q, k, v = self._project(params, x)
+        qh, kh, vh = map(self._split_heads, (q, k, v))   # (B, h, t, hd)
+        b, h, t, hd = qh.shape
+        if t == 1:
+            pos = state["pos"]
+            rows = jnp.arange(b)
+            ck = ck.at[rows, pos].set(kh[:, :, 0].astype(ck.dtype))
+            cv = cv.at[rows, pos].set(vh[:, :, 0].astype(cv.dtype))
+            use_kernel = self.decode_kernel
+            if use_kernel is None:
+                use_kernel = pallas_kernels.flash_decode_supported(
+                    ck.shape, qh.dtype
+                )
+            if use_kernel:
+                out = pallas_kernels.flash_decode(
+                    qh[:, :, 0], ck, cv, pos + 1
+                )
+            else:
+                out = _einsum_decode(qh[:, :, 0], ck, cv, pos)
+            y = self._merge_heads(out[:, :, None], x.dtype)
+        else:
+            ck = ck.at[:, :t].set(kh.transpose(0, 2, 1, 3).astype(ck.dtype))
+            cv = cv.at[:, :t].set(vh.transpose(0, 2, 1, 3).astype(cv.dtype))
+            y = self._attend_dense(q, k, v, x.dtype)
+        out_y = y @ params["wo"]
+        if self.attrs["use_bias"]:
+            out_y = out_y + params["bo"]
+        new_state = dict(state)
+        new_state["cache_k"] = ck
+        new_state["cache_v"] = cv
+        return [out_y], new_state
 
     def _attend_dense(self, q, k, v, dtype):
         q, k, v = map(self._split_heads, (q, k, v))

@@ -1048,6 +1048,126 @@ def flash_attention_lse_chunked(q, k, v, causal: bool = True,
 
 
 # ---------------------------------------------------------------------------
+# flash DECODE: q_len=1 against a KV cache (the serving inner loop)
+# ---------------------------------------------------------------------------
+#
+# The training kernels above are fwd/bwd pairs over (b, h, t, hd) with
+# t == t_kv; serving's decode step is a different shape class entirely:
+# ONE query per (batch, head) against a preallocated (B, max_seq, h, hd)
+# cache whose valid prefix length varies PER SLOT (continuous batching).
+# The kernel streams the cache in k-blocks through pipelined BlockSpecs
+# (no resident full cache in VMEM), masks key positions >= the slot's
+# length, and keeps the streaming-softmax state (m, l, acc) in scratch
+# across the sequential k dimension — the _fwd_stream_kernel structure
+# at block_q=1.  Inference-only: no VJP (the decode path is reachable
+# only from the ServingExecutor, never from a differentiated train
+# step; the pure-jnp ``_einsum_decode`` in ops/attention.py stays the
+# numerics oracle and the fallback).
+
+
+def _decode_block(s: int) -> int:
+    """K-block edge for the decode kernel: largest divisor of the cache
+    length <= the flash target that satisfies the TPU block rule."""
+    return _pick_block(s, _BLOCK_TARGET)
+
+
+def flash_decode_supported(cache_shape: Tuple[int, ...],
+                           dtype=jnp.float32) -> bool:
+    """Whether ``flash_decode`` applies to a (B, max_seq, h, hd) cache."""
+    if len(cache_shape) != 4:
+        return False
+    _, s, _, hd = cache_shape
+    if s < 8 or hd < 8:
+        return False
+    return _decode_block(s) >= 8
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_k, scale, num_kb):
+    b = pl.program_id(0)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+    q = q_ref[0]                                        # (1, hd)
+    k = k_ref[0, :, 0, :]                               # (bk, hd)
+    v = v_ref[0, :, 0, :]
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                           # (1, bk)
+    k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    s = jnp.where(k_pos < length, s, _NEG_INF)
+    m = m_scr[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[:] = m_new
+
+    @pl.when(kb == num_kb - 1)
+    def _emit():
+        o_ref[0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+
+
+def flash_decode(q, cache_k, cache_v, lengths,
+                 interpret: Optional[bool] = None):
+    """Single-token decode attention against a KV cache.
+
+    ``q``: (B, h, hd) — this step's query (the token at position
+    ``lengths - 1``, whose K/V the caller has already written into the
+    cache).  ``cache_k``/``cache_v``: (B, max_seq, h, hd) preallocated
+    caches.  ``lengths``: (B,) int32 — valid keys per slot (the query
+    attends key positions ``< lengths[b]``).  Returns (B, h, hd) in
+    ``q.dtype``.  Callers gate on :func:`flash_decode_supported`.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, s, h, hd = cache_k.shape
+    block_k = _decode_block(s)
+    if block_k < 8:
+        raise ValueError(
+            f"flash_decode needs a cache length with a block divisor "
+            f"that is a multiple of 8; got max_seq={s}.  Gate callers "
+            f"on flash_decode_supported()."
+        )
+    num_kb = s // block_k
+    kernel = functools.partial(
+        _decode_kernel, block_k=block_k, scale=1.0 / math.sqrt(hd),
+        num_kb=num_kb,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, num_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, hd), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, block_k, 1, hd),
+                         lambda bi, hi, ki: (bi, ki, hi, 0)),
+            pl.BlockSpec((1, block_k, 1, hd),
+                         lambda bi, hi, ki: (bi, ki, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, hd), lambda bi, hi, ki: (bi, hi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, cache_k, cache_v)
+
+
+# ---------------------------------------------------------------------------
 # fused softmax + cross-entropy (the reference's fused softmax/loss op,
 # src/ops/softmax.cu:91-160, rebuilt as a vocab-blocked streaming kernel)
 # ---------------------------------------------------------------------------

@@ -284,6 +284,71 @@ def scenario_pipeline_superstep_nan(root: str) -> Tuple[bool, str]:
     )
 
 
+def _serving_setup():
+    """Tiny transformer LM serving stack shared by the baseline and
+    faulted runs of the serving chaos scenario (one instance = shared
+    compiled programs; params deterministic from the seed)."""
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    from flexflow_tpu.runtime.serving import ServingExecutor
+
+    ff = build_transformer_lm(
+        batch_size=2, seq_len=32, vocab_size=32, d_model=16,
+        num_heads=2, num_layers=1, config=FFConfig(batch_size=2),
+    )
+    sex = ServingExecutor(ff, max_batch=2, max_seq=32, buckets=(8,))
+    params, state = sex.init(seed=0)
+    return sex, params, state
+
+
+def _serving_requests():
+    from flexflow_tpu.runtime.serving import synthetic_requests
+
+    return synthetic_requests(4, 32, prompt_len=(3, 6),
+                              max_new_tokens=12, seed=7)
+
+
+def scenario_serving_decode_fault(root: str) -> Tuple[bool, str]:
+    """Serving fault isolation: injected NaN logits (a NaN'd cache
+    row) inside one decode superstep AND a raised exception before
+    another — each faulted slot's request errors out, while every
+    OTHER request's generated token sequence stays byte-identical to
+    an unfaulted run (slots are independent in the batch dim; the
+    per-slot finiteness flag at the superstep fence is the detector).
+
+    Timeline (2 slots, 4 requests, k=4, max_new=12): r0/r1 admitted at
+    start; NaN in slot 0 before superstep 1 fails r0 at that fence;
+    r2 takes slot 0; r1 completes at superstep 2; r3 takes slot 1;
+    the raise before superstep 3 fails r2 (slot 0) without running
+    the superstep; r3 serves to completion.
+    """
+    from flexflow_tpu.runtime.serving import Server, ServingFaultInjector
+
+    sex, params, state = _serving_setup()
+    base_results, _ = Server(sex, params, state, decode_steps=4).run(
+        _serving_requests()
+    )
+    if any(r.error for r in base_results.values()):
+        return False, "serving: unfaulted baseline had errors"
+    inj = ServingFaultInjector(nan_cache_at={1: 0}, raise_at={3: 0})
+    results, _ = Server(sex, params, state, decode_steps=4,
+                        fault_injector=inj).run(_serving_requests())
+    fired = {m for m, _, _ in inj.fired}
+    if fired != {"nan_cache", "raise"}:
+        return False, f"serving: injector fired {sorted(fired)}"
+    failed = sorted(rid for rid, r in results.items() if r.error)
+    if failed != [0, 2]:
+        return False, (f"serving: expected requests [0, 2] to error "
+                       f"out, got {failed}")
+    for rid in (1, 3):
+        if results[rid].tokens != base_results[rid].tokens:
+            return False, (f"serving: request {rid}'s tokens DIVERGED "
+                           f"from the unfaulted run (slot-neighbor "
+                           f"isolation broken)")
+    return True, ("serving: faulted requests [0, 2] errored out; "
+                  "surviving slots' sequences byte-identical to the "
+                  "unfaulted run")
+
+
 SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "raised_fault": scenario_raised_fault,
     "nan_batch": scenario_nan_batch,
@@ -292,6 +357,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "corrupt_checkpoint": scenario_corrupt_checkpoint,
     "force_save_kill": scenario_force_save_kill,
     "pipeline_superstep_nan": scenario_pipeline_superstep_nan,
+    "serving_decode_fault": scenario_serving_decode_fault,
 }
 
 

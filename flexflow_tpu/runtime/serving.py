@@ -1,0 +1,727 @@
+"""Inference serving stack: ServingExecutor, KV-cache decode, and a
+continuous-batching scheduler.
+
+Everything before this subsystem trains; this is the serving half of
+the north star (ROADMAP: "millions of users"), and it is where the
+reference lineage itself went — FlexFlow Serve / SpecInfer built
+low-latency LLM serving on top of the FlexFlow runtime.  The design
+here follows the repo's own measured constraints rather than the GPU
+reference's: the axon relay's ~16 ms/call dispatch floor (BASELINE.md,
+PIPELINE_OVERHEAD.md) makes per-request — even per-token — dispatch a
+non-starter, so the serving loop reuses the superstep discipline the
+training runtime already proved out (PRs 1/3/5):
+
+- **Prefill**: the whole full-sequence forward over a request's
+  prompt, pad-to-bucket, as ONE jitted program that also populates a
+  per-layer (B, max_seq, heads, d_head) KV cache and returns the first
+  greedy token — one dispatch + one fence per admission.
+- **Decode superstep**: K single-token decode steps fused into one
+  jitted ``lax.scan`` dispatch (greedy sampling INSIDE the program, so
+  no host round-trip per token) with one ``jax.device_get`` fence per
+  superstep — the same one-dispatch-one-fence shape as
+  ``Executor.build_superstep``, under the same relay-safe k <= 20
+  clamp (``trainer.MAX_STEPS_PER_CALL``).
+- **Continuous batching**: a request queue feeds ``max_batch`` fixed
+  decode slots; admission (prefill + cache-row install) and eviction
+  happen BETWEEN decode supersteps, so one dispatch always serves the
+  whole active batch.  A slot finishing mid-superstep discards its
+  tail tokens (bounded speculation waste — the fused-dispatch
+  tradeoff, K tokens max).
+
+The KV-cache protocol lives on the op layer (``ops/attention.py``):
+``MultiHeadAttention.forward`` takes a cached path when ``state``
+carries ``cache_k``/``cache_v``/``pos``, with a Pallas flash *decode*
+kernel (``ops/pallas_kernels.flash_decode``: q_len=1 streaming softmax
+over cache blocks, per-slot length masking) and the pure-jnp
+``_einsum_decode`` as numerics oracle + fallback.  Params come from
+training checkpoints via the strategy-portable ``CheckpointManager``
+restore — the train->serve handoff (SERVING.md).
+
+Fault isolation (chaos matrix: ``runtime/chaos.py`` serving scenario):
+slots are independent in the batch dimension, per-slot logits carry an
+in-program finiteness flag read at the superstep fence, and a faulted
+slot errors out its request WITHOUT touching its neighbors' sequences.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.ops.attention import MultiHeadAttention, PositionEmbedding
+from flexflow_tpu.runtime import telemetry as _telemetry
+
+#: Relay hazard ceiling for the fused decode superstep — THE training
+#: supersteps' keep-chains-short clamp, shared so the two dispatch
+#: regimes cannot drift if the relay-safe cap is ever retuned.
+from flexflow_tpu.runtime.trainer import (
+    MAX_STEPS_PER_CALL as MAX_DECODE_STEPS_PER_CALL,
+)
+
+_log = logging.getLogger("ff.serving")
+
+
+class ServingFault(RuntimeError):
+    """A raised (device-class) fault attributed to one decode slot —
+    the scheduler errors out that slot's request and keeps serving the
+    rest (see :class:`ServingFaultInjector`)."""
+
+    def __init__(self, slot: int, msg: str = ""):
+        super().__init__(msg or f"injected serving fault in slot {slot}")
+        self.slot = slot
+
+
+class ServingFaultInjector:
+    """Scheduled chaos for the serving loop (the FaultInjector pattern
+    from ``runtime/resilience.py``, keyed by decode-superstep index).
+
+    - ``nan_cache_at``: ``{superstep_index: slot}`` — that slot's
+      layer-0 K cache row becomes NaN before the superstep, so its
+      logits go non-finite and the finiteness flag at the fence errors
+      the request out.  A *silent per-request* fault: neighbors'
+      cache rows are untouched.
+    - ``raise_at``: ``{superstep_index: slot}`` — a host-side raise
+      attributed to the slot before the dispatch (the raised-failure
+      class); the superstep never runs, so neighbors lose nothing.
+    """
+
+    def __init__(self, nan_cache_at: Optional[Dict[int, int]] = None,
+                 raise_at: Optional[Dict[int, int]] = None):
+        self.nan_cache_at = dict(nan_cache_at or {})
+        self.raise_at = dict(raise_at or {})
+        #: Log of ("nan_cache"|"raise", superstep, slot) fired.
+        self.fired: List[Tuple[str, int, int]] = []
+
+    def before_superstep(self, idx: int, caches):
+        """Returns possibly-corrupted caches; may raise ServingFault."""
+        if idx in self.raise_at:
+            slot = self.raise_at.pop(idx)
+            self.fired.append(("raise", idx, slot))
+            _telemetry.current().emit("fault", mode="serving_raise",
+                                      superstep=idx, slot=slot)
+            raise ServingFault(slot)
+        if idx in self.nan_cache_at:
+            slot = self.nan_cache_at.pop(idx)
+            self.fired.append(("nan_cache", idx, slot))
+            _telemetry.current().emit("fault", mode="serving_nan",
+                                      superstep=idx, slot=slot)
+            name = next(iter(caches))
+            k = caches[name]["k"]
+            caches = dict(caches)
+            caches[name] = {
+                "k": k.at[slot].set(jnp.nan),
+                "v": caches[name]["v"],
+            }
+        return caches
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``arrival`` is the decode-superstep
+    index at which the request becomes eligible for admission (0 =
+    available at start) — the synthetic closed-loop arrival knob."""
+
+    id: int
+    prompt: np.ndarray  # 1-D int32 token ids
+    max_new_tokens: int = 16
+    arrival: int = 0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    id: int
+    prompt_len: int
+    tokens: List[int]            # generated token ids, in order
+    error: Optional[str] = None  # None = completed cleanly
+    latency_s: float = 0.0       # eligible -> finished wall time
+    prefill_s: float = 0.0
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    pos: int                 # position of the NEXT token to decode
+    last_tok: int            # token at position pos-1... fed to decode
+    tokens: List[int]
+    t_eligible: float
+    prefill_s: float
+
+
+class ServingExecutor:
+    """Compiles forward-only serving programs for an FFModel LM.
+
+    Two program families, both whole-graph jitted (the
+    ``PipelineExecutor.build_compiled_step`` fusion discipline, minus
+    backward/optimizer):
+
+    - :meth:`build_prefill` (one per pad bucket L): ``(params, state,
+      tokens (1, L), length) -> (cache_rows, first_token, finite)`` —
+      the full-sequence causal forward (bit-identical to the training
+      forward on the same tokens), cache rows 0..L-1 populated, greedy
+      first token taken at ``length - 1``.
+    - :meth:`build_decode_superstep` (one per k): K fused single-token
+      decode steps as one ``lax.scan`` dispatch over the whole slot
+      batch — greedy tokens and per-slot finiteness stacked (K, B),
+      read back in ONE fence.
+
+    Params restore from training checkpoints through the existing
+    strategy-portable ``CheckpointManager`` (:meth:`restore`); serving
+    runs on a single device (``device``, default the first visible) —
+    multi-chip serving sharding is future work (SERVING.md).
+    """
+
+    def __init__(
+        self,
+        model: FFModel,
+        config: Optional[FFConfig] = None,
+        max_batch: int = 4,
+        max_seq: Optional[int] = None,
+        buckets: Optional[Sequence[int]] = None,
+        decode_kernel: Optional[bool] = None,
+        device: Optional[jax.Device] = None,
+    ):
+        self.model = model
+        self.config = config or model.config
+        self._layers = [op for op in model.layers if not op.is_loss]
+        loss_ops = model.loss_ops
+        if loss_ops:
+            self._logits_name = loss_ops[-1].inputs[0].name
+        else:
+            self._logits_name = self._layers[-1].outputs[0].name
+        consumed = {t.name for op in self._layers for t in op.inputs}
+        feed = [t for t in model.input_tensors if t.name in consumed]
+        if len(feed) != 1:
+            raise ValueError(
+                f"serving drives single-input token LMs (transformer "
+                f"first); the non-loss graph consumes inputs "
+                f"{[t.name for t in feed]}"
+            )
+        self._tokens_name = feed[0].name
+        self.attn_ops = [
+            op for op in self._layers if isinstance(op, MultiHeadAttention)
+        ]
+        if not self.attn_ops:
+            raise ValueError(
+                "serving needs at least one MultiHeadAttention op "
+                "(the KV-cache decode protocol lives there)"
+            )
+        self.max_batch = int(max_batch)
+        self.max_seq = int(max_seq or feed[0].shape[1])
+        # Pad buckets for prefill (ascending); every bucket compiles
+        # its own prefill program, so keep the list short.
+        bks = sorted(set(int(b) for b in (buckets or (self.max_seq,))))
+        if any(b < 1 or b > self.max_seq for b in bks):
+            raise ValueError(f"buckets must be in [1, max_seq]: {bks}")
+        self.buckets: Tuple[int, ...] = tuple(bks)
+        self.decode_kernel = decode_kernel
+        self.device = device if device is not None else jax.devices()[0]
+        #: Per-attention-op cache specs: name -> (heads, d_head, dtype).
+        self._cache_specs: Dict[str, Tuple[int, int, Any]] = {}
+        for op in self.attn_ops:
+            d = op.inputs[0].shape[-1]
+            h = op.attrs["num_heads"]
+            self._cache_specs[op.name] = (h, d // h, op.outputs[0].dtype)
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fns: Dict[Tuple[int, bool], Any] = {}
+
+    # -- params / checkpoint handoff ---------------------------------------
+
+    def _templates(self):
+        """(params, opt_state, op_state) templates from a throwaway
+        full-mesh Executor — the same init path training uses, so a
+        training checkpoint restores into matching structure (the
+        strategy-portable restore re-shards on load)."""
+        from flexflow_tpu.runtime.executor import Executor
+
+        return Executor(self.model, config=self.config).init()
+
+    def _place(self, tree):
+        return jax.device_put(tree, self.device)
+
+    def init(self, seed: Optional[int] = None):
+        """Fresh (params, op_state) on the serving device — the
+        no-checkpoint path (synthetic serving benchmarks)."""
+        from flexflow_tpu.runtime.executor import Executor
+
+        params, _opt, state = Executor(self.model, config=self.config).init(
+            seed
+        )
+        return self._place(params), self._place(state)
+
+    def restore(self, ckpt_dir: str, step: Optional[int] = None):
+        """Train->serve handoff: restore ``(step, params, op_state)``
+        from a training checkpoint directory (optimizer state is
+        restored into the templates and discarded — serving needs
+        none of it)."""
+        from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+        templates = self._templates()
+        with CheckpointManager(ckpt_dir) as ck:
+            got_step, params, _opt, state = ck.restore(
+                templates=templates, step=step
+            )
+        return got_step, self._place(params), self._place(state)
+
+    # -- caches -------------------------------------------------------------
+
+    def init_cache(self):
+        """Preallocated per-layer KV caches: ``{op: {"k"/"v":
+        (max_batch, max_seq, heads, d_head)}}`` on the serving device."""
+        B, S = self.max_batch, self.max_seq
+        return {
+            name: {
+                "k": self._place(jnp.zeros((B, S, h, hd), dt)),
+                "v": self._place(jnp.zeros((B, S, h, hd), dt)),
+            }
+            for name, (h, hd, dt) in self._cache_specs.items()
+        }
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest pad "
+            f"bucket {self.buckets[-1]} (max_seq={self.max_seq})"
+        )
+
+    # -- the forward walk ---------------------------------------------------
+
+    def _forward(self, params, op_state, tokens, caches, pos):
+        """Forward-only walk over the non-loss op graph in inference
+        mode: attention ops get their caches + the per-slot position
+        vector through the existing ``state`` mechanism
+        (``ops/attention.py`` KV-cache protocol), position embeddings
+        get ``pos``; everything else runs its plain eval forward.
+        Returns ``(logits, new_caches)``."""
+        env: Dict[str, Any] = {self._tokens_name: tokens}
+        new_caches: Dict[str, Any] = {}
+        for op in self._layers:
+            # Serving runs unsharded on one device: bind a mesh-less
+            # placement so strategy-bound paths (ring attention, TP
+            # linear pinning) stay off regardless of what a training
+            # executor last bound on these shared op objects.
+            op.bind_mesh(None, None)
+            if isinstance(op, MultiHeadAttention):
+                op.decode_kernel = self.decode_kernel
+            xs = [env[t.name] for t in op.inputs]
+            s = dict(op_state.get(op.name, {}))
+            if op.name in caches:
+                s["cache_k"] = caches[op.name]["k"]
+                s["cache_v"] = caches[op.name]["v"]
+                s["pos"] = pos
+            elif isinstance(op, PositionEmbedding):
+                s["pos"] = pos
+            ys, s_new = op.forward(params.get(op.name, {}), xs, s,
+                                   training=False)
+            if op.name in caches:
+                new_caches[op.name] = {
+                    "k": s_new["cache_k"], "v": s_new["cache_v"],
+                }
+            for t, y in zip(op.outputs, ys):
+                env[t.name] = y
+        return env[self._logits_name], new_caches
+
+    # -- compiled programs ---------------------------------------------------
+
+    def build_prefill(self, bucket: int):
+        """One jitted prefill program per pad bucket: ``(params,
+        op_state, tokens (1, bucket), length ()) -> (cache_rows,
+        first_token, finite)``.  ``cache_rows`` are (max_seq, h, hd)
+        per layer (rows beyond ``bucket`` zero), ready for
+        :meth:`install` into a slot."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        S = self.max_seq
+
+        def prefill(params, op_state, tokens, length):
+            caches = {
+                name: {
+                    "k": jnp.zeros((1, S, h, hd), dt),
+                    "v": jnp.zeros((1, S, h, hd), dt),
+                }
+                for name, (h, hd, dt) in self._cache_specs.items()
+            }
+            pos = jnp.zeros((1,), jnp.int32)
+            logits, caches = self._forward(
+                params, op_state, tokens, caches, pos
+            )
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1, axis=0, keepdims=False
+            )
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)))
+            rows = {
+                name: {"k": c["k"][0], "v": c["v"][0]}
+                for name, c in caches.items()
+            }
+            return rows, tok, ok
+
+        fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        _telemetry.current().emit("serving_program", kind="prefill",
+                                  bucket=int(bucket))
+        return fn
+
+    @functools.cached_property
+    def install(self):
+        """One jitted program installing a prefilled cache row into a
+        slot across every layer's K and V (donated caches: the install
+        is in-place on device)."""
+
+        def install(caches, rows, slot):
+            return jax.tree.map(
+                lambda c, r: c.at[slot].set(r.astype(c.dtype)),
+                caches, rows,
+            )
+
+        return jax.jit(install, donate_argnums=(0,))
+
+    def build_decode_superstep(self, k: int, return_logits: bool = False):
+        """K fused single-token decode steps as ONE jitted dispatch:
+        ``(params, op_state, caches, pos (B,), tok (B,)) -> (caches,
+        pos, tok, (tokens (K, B), finite (K, B)))`` — greedy argmax
+        INSIDE the scan, so the host sees one program and one fence
+        per K tokens across the whole slot batch.  ``return_logits``
+        additionally stacks the (K, B, V) logits (test/oracle use
+        only — production keeps the readback K x B ints)."""
+        if k < 1:
+            raise ValueError(f"decode steps per call must be >= 1, got {k}")
+        key = (k, return_logits)
+        fn = self._decode_fns.get(key)
+        if fn is not None:
+            return fn
+        S = self.max_seq
+
+        def superstep(params, op_state, caches, pos, tok):
+            def body(carry, _):
+                caches, pos, tok = carry
+                logits, caches = self._forward(
+                    params, op_state, tok[:, None], caches, pos
+                )
+                logits = logits[:, 0]                      # (B, V)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                ok = jnp.all(
+                    jnp.isfinite(logits.astype(jnp.float32)), axis=-1
+                )
+                pos = jnp.minimum(pos + 1, S - 1)
+                out = (nxt, ok, logits) if return_logits else (nxt, ok)
+                return (caches, pos, nxt), out
+
+            (caches, pos, tok), outs = jax.lax.scan(
+                body, (caches, pos, tok), None, length=k
+            )
+            return caches, pos, tok, outs
+
+        fn = self._decode_fns[key] = jax.jit(
+            superstep, donate_argnums=(2, 3, 4)
+        )
+        _telemetry.current().emit("serving_program", kind="decode", k=int(k))
+        return fn
+
+    # -- compute-free mode ---------------------------------------------------
+
+    def abstract_programs(self, decode_steps: int = 8):
+        """``jax.eval_shape`` over every prefill bucket and the decode
+        superstep — the serving DRY RUN (no device compute): validates
+        the whole forward-only graph, the cache protocol and the scan,
+        and returns the program table ``{"prefill": {bucket: logits
+        aval...}, "decode": ...}``."""
+        from flexflow_tpu.runtime.executor import Executor
+
+        params, _opt, op_state = Executor(
+            self.model, config=self.config
+        )._abstract_init()
+        B, S = self.max_batch, self.max_seq
+        out: Dict[str, Any] = {"prefill": {}, "cache": {}}
+        for name, (h, hd, dt) in self._cache_specs.items():
+            out["cache"][name] = jax.ShapeDtypeStruct((B, S, h, hd), dt)
+        for bucket in self.buckets:
+            toks = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+            ln = jax.ShapeDtypeStruct((), jnp.int32)
+            rows, tok, okf = jax.eval_shape(
+                self.build_prefill(bucket), params, op_state, toks, ln
+            )
+            out["prefill"][bucket] = tok
+        caches = {
+            name: {
+                "k": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+                "v": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+            }
+            for name, (h, hd, dt) in self._cache_specs.items()
+        }
+        pos = jax.ShapeDtypeStruct((B,), jnp.int32)
+        tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+        _, _, _, (toks, okf) = jax.eval_shape(
+            self.build_decode_superstep(decode_steps),
+            params, op_state, caches, pos, tok,
+        )
+        out["decode"] = toks
+        return out
+
+
+class Server:
+    """Continuous-batching serving loop over a :class:`ServingExecutor`.
+
+    ``run(requests)`` drives the closed loop to completion: admit
+    eligible requests into free slots (prefill + cache install),
+    dispatch one fused K-token decode superstep over the whole slot
+    batch, consume the fenced tokens per slot (EOS / budget / context
+    limits), evict finished slots, repeat.  Returns ``(results,
+    stats)`` — per-request :class:`RequestResult` plus the latency/
+    throughput stats block (request latency p50/p95 ms, tokens/s,
+    decode supersteps, telemetry summary when enabled).
+    """
+
+    def __init__(
+        self,
+        executor: ServingExecutor,
+        params,
+        op_state,
+        decode_steps: int = 8,
+        eos_id: Optional[int] = None,
+        fault_injector: Optional[ServingFaultInjector] = None,
+    ):
+        self.ex = executor
+        self.params = params
+        self.op_state = op_state
+        if decode_steps > MAX_DECODE_STEPS_PER_CALL:
+            _log.warning(
+                "decode_steps=%d exceeds the relay-safe fence cap; "
+                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
+                decode_steps, MAX_DECODE_STEPS_PER_CALL,
+            )
+            decode_steps = MAX_DECODE_STEPS_PER_CALL
+        self.decode_steps = max(1, int(decode_steps))
+        self.eos_id = eos_id
+        self.injector = fault_injector
+
+    # -- loop ----------------------------------------------------------------
+
+    def run(self, requests: Sequence[Request]):
+        tel = _telemetry.current()
+        ex = self.ex
+        B, k = ex.max_batch, self.decode_steps
+        decode_fn = ex.build_decode_superstep(k)
+        caches = ex.init_cache()
+        slots: List[Optional[_Slot]] = [None] * B
+        queue = collections.deque(
+            sorted(requests, key=lambda r: (r.arrival,))
+        )
+        results: Dict[int, RequestResult] = {}
+        eligible_at: Dict[int, float] = {}
+        superstep_idx = 0
+        total_tokens = 0
+        supersteps = 0
+        prefills = 0
+        decode_s = 0.0
+        t_run0 = time.perf_counter()
+
+        def finish(slot_i: int, error: Optional[str] = None):
+            sl = slots[slot_i]
+            lat = time.perf_counter() - sl.t_eligible
+            results[sl.request.id] = RequestResult(
+                id=sl.request.id,
+                prompt_len=len(sl.request.prompt),
+                tokens=list(sl.tokens),
+                error=error,
+                latency_s=lat,
+                prefill_s=sl.prefill_s,
+            )
+            tel.emit("request_end", id=sl.request.id,
+                     tokens=len(sl.tokens), error=error,
+                     latency_s=round(lat, 6))
+            slots[slot_i] = None
+
+        def slot_done(sl: _Slot) -> bool:
+            if self.eos_id is not None and sl.tokens and \
+                    sl.tokens[-1] == self.eos_id:
+                return True
+            if len(sl.tokens) >= sl.request.max_new_tokens:
+                return True
+            return sl.pos >= ex.max_seq  # context limit
+        while queue or any(slots):
+            # -- admissions (between decode supersteps) --
+            now = time.perf_counter()
+            # Eligibility is when the arrival clock passes, NOT when a
+            # slot frees up — queue wait under full slots is real
+            # request latency.
+            for r in queue:
+                if r.arrival <= superstep_idx and r.id not in eligible_at:
+                    eligible_at[r.id] = now
+            while queue and queue[0].arrival <= superstep_idx and \
+                    None in slots:
+                r = queue.popleft()
+                slot_i = slots.index(None)
+                plen = len(r.prompt)
+                try:
+                    bucket = ex.bucket_for(plen)
+                except ValueError as e:
+                    # Rejected requests still leave a complete
+                    # start/end pair in the log (the reconstructable-
+                    # from-JSONL contract) and an honest latency.
+                    tel.emit("request_start", id=r.id, prompt_len=plen,
+                             bucket=None, slot=None)
+                    lat = time.perf_counter() - eligible_at[r.id]
+                    results[r.id] = RequestResult(
+                        id=r.id, prompt_len=plen, tokens=[],
+                        error=str(e), latency_s=lat,
+                    )
+                    tel.emit("request_end", id=r.id, tokens=0,
+                             error=str(e), latency_s=round(lat, 6))
+                    continue
+                tel.emit("request_start", id=r.id, prompt_len=plen,
+                         bucket=bucket, slot=slot_i)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :plen] = np.asarray(r.prompt, np.int32)
+                t0 = time.perf_counter()
+                rows, tok0, okf = ex.build_prefill(bucket)(
+                    self.params, self.op_state, padded,
+                    np.int32(plen),
+                )
+                tok0, ok = tel.fence((tok0, okf), "prefill")
+                pf_s = time.perf_counter() - t0
+                prefills += 1
+                tel.emit("prefill", id=r.id, bucket=bucket,
+                         wall_s=round(pf_s, 6))
+                if not bool(ok):
+                    sl = _Slot(r, plen, 0, [], eligible_at[r.id], pf_s)
+                    slots[slot_i] = sl
+                    finish(slot_i, error="non-finite logits in prefill")
+                    continue
+                caches = ex.install(caches, rows, slot_i)
+                sl = _Slot(
+                    request=r, pos=plen, last_tok=int(tok0),
+                    tokens=[int(tok0)], t_eligible=eligible_at[r.id],
+                    prefill_s=pf_s,
+                )
+                total_tokens += 1
+                slots[slot_i] = sl
+                if slot_done(sl):
+                    finish(slot_i)
+
+            active = [i for i, sl in enumerate(slots) if sl is not None]
+            if not active:
+                if queue:
+                    # Closed-loop idle tick: no active slot, but future
+                    # arrivals remain — advance the superstep clock.
+                    superstep_idx += 1
+                    continue
+                break
+
+            # -- one fused decode superstep over the whole batch --
+            if self.injector is not None:
+                try:
+                    caches = self.injector.before_superstep(
+                        superstep_idx, caches
+                    )
+                except ServingFault as f:
+                    superstep_idx += 1
+                    if slots[f.slot] is not None:
+                        finish(f.slot, error=f"raised fault: {f}")
+                    continue
+            pos_vec = np.array(
+                [sl.pos if sl else 0 for sl in slots], np.int32
+            )
+            tok_vec = np.array(
+                [sl.last_tok if sl else 0 for sl in slots], np.int32
+            )
+            t_call = time.perf_counter()
+            caches, _pos, _tok, (toks, oks) = decode_fn(
+                self.params, self.op_state, caches, pos_vec, tok_vec
+            )
+            host_toks, host_oks = tel.fence((toks, oks), "decode_superstep")
+            wall = time.perf_counter() - t_call
+            decode_s += wall
+            supersteps += 1
+            superstep_idx += 1
+            # Training-superstep accounting: ONE host program and one
+            # fence covered k decode steps (programs/step == 1/k).
+            tel.add_programs(1, steps=k)
+            tel.emit("decode_superstep", k=k, active=len(active),
+                     wall_s=round(wall, 6))
+            for j in range(k):
+                tel.record_step((supersteps - 1) * k + j, wall_s=wall / k)
+            for i in active:
+                sl = slots[i]
+                err = None
+                for j in range(k):
+                    if not bool(host_oks[j, i]):
+                        err = "non-finite logits in decode"
+                        break
+                    sl.tokens.append(int(host_toks[j, i]))
+                    sl.pos += 1
+                    total_tokens += 1
+                    if slot_done(sl):
+                        break
+                sl.last_tok = sl.tokens[-1] if sl.tokens else 0
+                if err is not None:
+                    finish(i, error=err)
+                elif slot_done(sl):
+                    finish(i)
+
+        elapsed = time.perf_counter() - t_run0
+        lats = sorted(
+            r.latency_s for r in results.values() if r.error is None
+        )
+
+        def pct(p: float) -> float:
+            if not lats:
+                return 0.0
+            return lats[min(len(lats) - 1, int(round(p * (len(lats) - 1))))]
+
+        stats = {
+            "requests": len(results),
+            "completed": sum(1 for r in results.values() if r.error is None),
+            "failed": sum(1 for r in results.values() if r.error),
+            "tokens": total_tokens,
+            "elapsed_s": elapsed,
+            "tokens_per_s": total_tokens / max(elapsed, 1e-9),
+            "decode_supersteps": supersteps,
+            "decode_steps_per_call": k,
+            "decode_s": decode_s,
+            "prefills": prefills,
+            "request_latency_ms_p50": round(pct(0.50) * 1e3, 3),
+            "request_latency_ms_p95": round(pct(0.95) * 1e3, 3),
+            # One host program per decode superstep, by construction
+            # (audited by the telemetry programs/step counter).
+            "programs_per_decode_superstep": 1,
+        }
+        return results, tel.fold_stats(stats)
+
+
+def synthetic_requests(
+    n: int,
+    vocab: int,
+    prompt_len: Tuple[int, int] = (4, 12),
+    max_new_tokens: int = 16,
+    arrival_every: int = 0,
+    seed: int = 0,
+) -> List[Request]:
+    """Deterministic synthetic request stream for closed-loop
+    benchmarking: prompt lengths uniform in ``prompt_len`` (inclusive),
+    ids uniform over the vocab, one request becoming eligible every
+    ``arrival_every`` decode supersteps (0 = all at start — the burst
+    pattern)."""
+    rng = np.random.default_rng(seed)
+    lo, hi = prompt_len
+    out = []
+    for i in range(n):
+        plen = int(rng.integers(lo, hi + 1))
+        out.append(Request(
+            id=i,
+            prompt=rng.integers(0, vocab, size=plen).astype(np.int32),
+            max_new_tokens=max_new_tokens,
+            arrival=i * arrival_every,
+        ))
+    return out

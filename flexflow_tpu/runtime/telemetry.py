@@ -154,7 +154,21 @@ def maybe_run(config=None, meta: Optional[Dict[str, Any]] = None):
     if not d:
         return NULL
     deadline = getattr(config, "stall_deadline_s", DEFAULT_STALL_DEADLINE_S)
-    return Telemetry(d, stall_deadline_s=deadline, meta=meta)
+    notify = getattr(config, "stall_notify_pid", 0)
+    if not notify:
+        try:
+            notify = int(os.environ.get("FF_STALL_NOTIFY_PID", "0") or 0)
+        except ValueError:
+            # Junk in the environment must not abort a run that never
+            # asked for escalation; warn and run without it.
+            _log.warning(
+                "FF_STALL_NOTIFY_PID=%r is not an integer; stall "
+                "escalation disabled",
+                os.environ.get("FF_STALL_NOTIFY_PID"),
+            )
+            notify = 0
+    return Telemetry(d, stall_deadline_s=deadline, meta=meta,
+                     notify_pid=notify)
 
 
 def _json_default(o):
@@ -195,6 +209,7 @@ class Telemetry:
         heartbeat_path: Optional[str] = None,
         stall_deadline_s: float = 0.0,
         meta: Optional[Dict[str, Any]] = None,
+        notify_pid: int = 0,
     ):
         self.run_id = run_id or (
             time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
@@ -237,6 +252,32 @@ class Telemetry:
         self._last_beat = time.monotonic()
         self._last_label = "run_start"
         self._stall_deadline = float(stall_deadline_s or 0.0)
+        #: Stall-escalation hook: an EXTERNAL supervisor pid notified
+        #: with SIGUSR1 when a stall fires (0 = off).  Never the own
+        #: pid — the watchdog must not signal the process it watches
+        #: (in-process kill is the relay-wedge hazard, and even a
+        #: handled signal interrupting a blocked device_get is
+        #: territory the observe-and-warn contract stays out of).
+        self._notify_pid = int(notify_pid or 0)
+        if self._notify_pid < 0:
+            # A negative pid makes os.kill signal a whole PROCESS
+            # GROUP — potentially including this process, whose
+            # default SIGUSR1 disposition is termination: the exact
+            # kill-a-TPU-claim-holder hazard the watchdog exists to
+            # avoid.
+            _log.warning(
+                "stall_notify_pid=%d is negative (a process group); "
+                "refusing — escalation notifies exactly one external "
+                "pid or nothing", self._notify_pid,
+            )
+            self._notify_pid = 0
+        if self._notify_pid == os.getpid():
+            _log.warning(
+                "stall_notify_pid=%d is THIS process; refusing "
+                "(the watchdog never signals the process it watches) "
+                "— escalation disabled", self._notify_pid,
+            )
+            self._notify_pid = 0
         self._stalled = False
         self._closed = False
         self._stop = threading.Event()
@@ -387,9 +428,35 @@ class Telemetry:
                     "wedges the tunnel for hours).",
                     idle, self._stall_deadline, self._last_label,
                 )
+                notified = self._notify_supervisor()
                 self.emit("stall", idle_s=round(idle, 1),
                           deadline_s=self._stall_deadline,
-                          last=self._last_label)
+                          last=self._last_label,
+                          notified_pid=notified)
+
+    def _notify_supervisor(self) -> int:
+        """Stall escalation: SIGUSR1 to the configured EXTERNAL
+        supervisor pid (``--stall-notify-pid`` / FF_STALL_NOTIFY_PID).
+        Observe-and-warn stays the in-process contract — this never
+        touches the watched process itself; a dead/invalid supervisor
+        is logged and ignored.  Returns the pid notified (0 = none)."""
+        if not self._notify_pid:
+            return 0
+        import signal
+
+        try:
+            os.kill(self._notify_pid, signal.SIGUSR1)
+            _log.warning(
+                "telemetry watchdog: notified supervisor pid %d "
+                "(SIGUSR1) of the stall", self._notify_pid,
+            )
+            return self._notify_pid
+        except (OSError, ProcessLookupError) as e:
+            _log.warning(
+                "telemetry watchdog: could not notify supervisor "
+                "pid %d: %s", self._notify_pid, e,
+            )
+            return 0
 
     # -- summaries ----------------------------------------------------------
 

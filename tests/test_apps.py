@@ -4,7 +4,15 @@
 import numpy as np
 import pytest
 
-from flexflow_tpu.apps import alexnet, candle_uno, cnn, dlrm, nmt, transformer
+from flexflow_tpu.apps import (
+    alexnet,
+    candle_uno,
+    cnn,
+    dlrm,
+    nmt,
+    serve,
+    transformer,
+)
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 
 
@@ -254,7 +262,25 @@ def test_shipped_strategy_files_load():
     assert pb.num_devices == 8
 
 
-@pytest.mark.parametrize("mod", [alexnet, cnn, dlrm, nmt, candle_uno, transformer])
+def test_serve_app_dry_run(capsys):
+    """apps/serve.py --dry-run: the serving program table (prefill
+    buckets, decode superstep, cache layout) validates via eval_shape
+    with zero device compute — the DISABLE_COMPUTATION contract of the
+    training apps, for the serving stack (ISSUE 7)."""
+    assert serve.main([
+        "--max-seq", "16", "--max-batch", "2", "--decode-steps", "4",
+        "--vocab", "64", "--d-model", "32", "--heads", "2",
+        "--layers", "1", "--dry-run",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "DRY RUN OK" in out
+    assert "decode k=4" in out and "prefill" in out
+    assert "cache blk0_attn" in out
+
+
+@pytest.mark.parametrize(
+    "mod", [alexnet, cnn, dlrm, nmt, candle_uno, transformer, serve]
+)
 def test_apps_print_help(mod, capsys):
     """-h/--help prints the app docstring + common flag table and
     exits 0 instead of being swallowed by Legion-style pass-through."""

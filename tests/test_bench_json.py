@@ -80,6 +80,17 @@ def stubbed_bench(monkeypatch):
         }),
     )
     monkeypatch.setattr(
+        bench, "bench_serving",
+        lambda n, t: chatty({
+            "k1_tokens_per_s": 100.0, "k8_tokens_per_s": 400.0,
+            "k1_decode_ms_per_token": 4.0, "k8_decode_ms_per_token": 1.0,
+            "fused_speedup_k8_vs_k1": 4.0,
+            "request_latency_ms_p50": 50.0,
+            "request_latency_ms_p95": 80.0,
+            "programs_per_decode_superstep": 1,
+        }),
+    )
+    monkeypatch.setattr(
         bench, "bench_search",
         lambda n, t: chatty({
             "default_ms_per_step": 2.0, "auto_ms_per_step": 1.0,
@@ -131,6 +142,17 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert tele["step_ms_p95"] == 3.0
     assert tele["step_ms_max"] == 4.0
     assert tele["overhead_pct"] == 0.5
+    # The serving leg (ISSUE 7): continuous-batching KV-cache decode —
+    # request latency p50/p95, tokens/s, one program per K-token
+    # decode superstep, and the fused-vs-per-token dispatch A/B.
+    serving = record["extra"]["serving"]
+    assert serving["k8_tokens_per_s"] == 400.0
+    assert serving["k1_decode_ms_per_token"] == 4.0
+    assert serving["k8_decode_ms_per_token"] == 1.0
+    assert serving["fused_speedup_k8_vs_k1"] == 4.0
+    assert serving["request_latency_ms_p50"] == 50.0
+    assert serving["request_latency_ms_p95"] == 80.0
+    assert serving["programs_per_decode_superstep"] == 1
     # The execution-autotuner leg (ISSUE 6): auto-chosen config with
     # its predicted-vs-measured ms/step + the search wall time.
     search = record["extra"]["search"]
@@ -154,6 +176,7 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     monkeypatch.setattr(stubbed_bench, "bench_superstep", boom)
     monkeypatch.setattr(stubbed_bench, "bench_pipeline", boom)
     monkeypatch.setattr(stubbed_bench, "bench_telemetry", boom)
+    monkeypatch.setattr(stubbed_bench, "bench_serving", boom)
     monkeypatch.setattr(stubbed_bench, "bench_search", boom)
     out, err = io.StringIO(), io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
@@ -166,4 +189,5 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     assert "leg exploded" in record["extra"]["superstep_error"]
     assert "leg exploded" in record["extra"]["pipeline_error"]
     assert "leg exploded" in record["extra"]["telemetry_error"]
+    assert "leg exploded" in record["extra"]["serving_error"]
     assert "leg exploded" in record["extra"]["search_error"]

@@ -280,6 +280,47 @@ def test_watchdog_warns_once_per_stall(caplog):
     assert len(stalls) == 1  # loud once, not a warning storm
 
 
+def test_watchdog_notifies_external_supervisor(tmp_path):
+    """Stall escalation (--stall-notify-pid): the watchdog SIGUSR1s an
+    EXTERNAL supervisor process on stall — and still kills nothing
+    (the child observes the signal and exits cleanly on its own)."""
+    import subprocess
+    import sys
+
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import signal, sys, time\n"
+            "got = []\n"
+            "signal.signal(signal.SIGUSR1, lambda s, f: got.append(s))\n"
+            "deadline = time.monotonic() + 15\n"
+            "while not got and time.monotonic() < deadline:\n"
+            "    time.sleep(0.02)\n"
+            "print('NOTIFIED' if got else 'TIMEOUT')\n"
+        )],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        with Telemetry(str(tmp_path), stall_deadline_s=0.1,
+                       notify_pid=child.pid) as tel:
+            time.sleep(0.5)
+            path = tel.path
+        out, _ = child.communicate(timeout=20)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert "NOTIFIED" in out
+    events = [json.loads(l) for l in open(path)]
+    stalls = [e for e in events if e["ev"] == "stall"]
+    assert stalls and stalls[0]["notified_pid"] == child.pid
+
+
+def test_watchdog_refuses_self_notification():
+    """The escalation hook never signals the process it watches
+    (in-process kill is the relay-wedge hazard)."""
+    with Telemetry(stall_deadline_s=0.0, notify_pid=os.getpid()) as tel:
+        assert tel._notify_pid == 0
+
+
 def test_heartbeat_file(tmp_path, monkeypatch):
     hb = tmp_path / "heartbeat"
     with Telemetry(str(tmp_path)) as tel:

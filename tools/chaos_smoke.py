@@ -3,9 +3,12 @@
 
 Runs every scenario in ``flexflow_tpu/runtime/chaos.py`` — raised
 fault / NaN batch / NaN loss inside a k=8 superstep, SIGTERM
-preemption + resume, checkpoint corruption fallback, and
+preemption + resume, checkpoint corruption fallback,
 kill-between-force-save-phases — each required to finish with a loss
-trajectory bit-identical to the unfaulted run.  <2 min on the 8-device
+trajectory bit-identical to the unfaulted run — plus the serving
+fault-isolation scenario (NaN logits / raised exception inside a
+decode superstep: the faulted request errors out, surviving slots'
+sequences byte-identical; SERVING.md).  <2 min on the 8-device
 virtual CPU mesh; never touches the TPU claim (the child is pinned to
 ``JAX_PLATFORMS=cpu`` with the axon sitecustomize dropped from
 PYTHONPATH, per CLAUDE.md).

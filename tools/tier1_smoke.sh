@@ -22,5 +22,6 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
     tests/test_telemetry.py \
+    tests/test_serving.py \
     tests/test_search.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"
