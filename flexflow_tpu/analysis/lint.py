@@ -1,0 +1,498 @@
+"""fflint layer 1: AST rules encoding the CLAUDE.md hazards.
+
+Each rule is a checkable code property with a stable id, a one-line
+rationale naming the hazard it enforces, and inline suppression::
+
+    dangerous_call()  # fflint: disable=FF001
+    # fflint: disable-file=FF007   (anywhere in the file, whole file)
+
+The rules are deliberately AST-based: docstrings and comments cannot
+trigger them (the ``block_until_ready`` reference in
+``runtime/trainer.py`` prose is not a violation; a call is).  This
+module imports no jax so the lint layer runs anywhere, instantly.
+
+Rule catalog (ANALYSIS.md has the full rationale table):
+
+- FF001 ``block_until_ready`` on a runtime path — fence with
+  ``jax.device_get`` (a no-op through the axon relay, CLAUDE.md).
+- FF002 ``jax.devices("tpu")`` named lookup — the relay masquerades
+  as "tpu" but named lookup tries a real local device and fails.
+- FF003 host time / host RNG (``time.*``, ``np.random``, stdlib
+  ``random``) inside a jit-traced function — traced once, frozen
+  forever; breaks replay determinism.
+- FF004 bare stdout writes in ``bench.py`` — the driver parses
+  exactly ONE JSON line from stdout (``print(json.dumps(...))`` is
+  the sanctioned form; everything else goes to stderr).
+- FF005 ``pallas_call`` outside ``ops/pallas_kernels.py`` and its
+  sanctioned probe consumers — kernels without AD rules must stay
+  behind the audited reachability choke points.
+- FF006 ``build_superstep``/``build_decode_superstep`` in a module
+  that never references the relay cap
+  (``relay_safe_steps``/``MAX_STEPS_PER_CALL``) — an unclamped k is
+  the keep-chains-short relay-wedge hazard.
+- FF007 ``timeout=``-killed subprocesses in ``tools/`` — killing a
+  TPU-claim holder wedges the tunnel for hours; only the sanctioned
+  short health probe may do this (suppressed there, with rationale).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+#: The relay keep-chains-short ceiling (kept in sync with
+#: ``runtime/trainer.py::MAX_STEPS_PER_CALL`` by
+#: ``tests/test_analysis.py`` — lint must not import the runtime).
+RELAY_CAP = 20
+
+_SUPPRESS_RE = re.compile(r"#\s*fflint:\s*disable=([A-Z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*fflint:\s*disable-file=([A-Z0-9,\s]+)")
+
+#: Names whose reference marks a module as relay-cap aware (FF006).
+_CAP_NAMES = frozenset({
+    "relay_safe_steps", "MAX_STEPS_PER_CALL", "MAX_DECODE_STEPS_PER_CALL",
+})
+
+#: Sanctioned homes of raw ``pallas_call`` (FF005): the kernel library
+#: and its two probe-tool consumers (kernel-variant A/B probes that by
+#: design bypass the library to compare raw pallas_call variants).
+PALLAS_ALLOWLIST = (
+    "flexflow_tpu/ops/pallas_kernels.py",
+    "tools/probe_flash_variants.py",
+    "tools/probe_flash_bwd_variants.py",
+)
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    title: str
+    rationale: str     # one line, names the CLAUDE.md/ROADMAP hazard
+    applies: Callable[[str], bool]          # repo-relative path -> bool
+    check: Callable[[ast.AST, str], List[Tuple[int, str]]]
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression (``a.b.c`` -> "a.b.c")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_test(path: str) -> bool:
+    return path.startswith("tests/") or os.path.basename(path).startswith(
+        "test_"
+    )
+
+
+# -- FF001 ------------------------------------------------------------------
+
+def _check_block_until_ready(tree: ast.AST, path: str):
+    out = []
+    msg = ("block_until_ready does not fence through the "
+           "axon relay; use jax.device_get")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and \
+                node.attr == "block_until_ready":
+            out.append((node.lineno, msg))
+        elif isinstance(node, ast.Name) and \
+                node.id == "block_until_ready":
+            # `from jax import block_until_ready` + bare-name call.
+            out.append((node.lineno, msg))
+        elif isinstance(node, ast.ImportFrom) and any(
+                a.name == "block_until_ready" for a in node.names):
+            out.append((node.lineno, msg))
+    return out
+
+
+# -- FF002 ------------------------------------------------------------------
+
+def _check_named_tpu_lookup(tree: ast.AST, path: str):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name.endswith("devices") and not name.endswith(
+                "local_devices"):
+            continue
+        literals = [a for a in node.args if isinstance(a, ast.Constant)]
+        literals += [k.value for k in node.keywords
+                     if isinstance(k.value, ast.Constant)]
+        if any(a.value == "tpu" for a in literals):
+            out.append((node.lineno,
+                        'jax.devices("tpu") named lookup fails through '
+                        "the relay (it masquerades as tpu but named "
+                        "lookup probes a real local device)"))
+    return out
+
+
+# -- FF003 ------------------------------------------------------------------
+
+_HOST_IMPURE_PREFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic",
+    "np.random.", "numpy.random.", "random.",
+)
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``functools.partial(jax.jit, ...)``."""
+    name = _dotted(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "functools.partial", "partial"):
+        return bool(node.args) and _is_jit_expr(node.args[0])
+    return False
+
+
+def _traced_functions(tree: ast.AST) -> List[ast.AST]:
+    """Function defs the lint treats as jit-traced: decorated with jit,
+    or passed directly to a ``jax.jit(...)`` call as the first argument
+    (resolved to a def in the same module).  A static approximation —
+    the program audit (layer 2) checks the real traced programs."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    traced: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                traced.append(node)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                fn = defs.get(node.args[0].id)
+                if fn is not None:
+                    traced.append(fn)
+    return traced
+
+
+def _check_host_impurity_in_jit(tree: ast.AST, path: str):
+    out = []
+    seen: Set[int] = set()
+    for fn in _traced_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if any(
+                name == p.rstrip(".") or name.startswith(p)
+                for p in _HOST_IMPURE_PREFIXES
+            ) and not name.startswith("jax."):
+                if node.lineno in seen:
+                    continue
+                seen.add(node.lineno)
+                out.append((node.lineno,
+                            f"host-impure call {name!r} inside a "
+                            f"jit-traced function: traced once, frozen "
+                            f"into the compiled program"))
+    return out
+
+
+# -- FF004 ------------------------------------------------------------------
+
+def _check_bench_stdout(tree: ast.AST, path: str):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name == "print":
+            file_kw = next(
+                (k for k in node.keywords if k.arg == "file"), None
+            )
+            if file_kw is not None and \
+                    _dotted(file_kw.value) != "sys.stdout":
+                continue  # routed (bench always routes to stderr)
+            # The sanctioned form: print(json.dumps(...)) — THE one
+            # JSON line (including the structured-error epilogue).
+            if len(node.args) == 1 and isinstance(node.args[0], ast.Call) \
+                    and _dotted(node.args[0].func) == "json.dumps":
+                continue
+            out.append((node.lineno,
+                        "bare print to stdout in bench.py: the driver "
+                        "parses exactly ONE JSON line from stdout "
+                        "(print(json.dumps(...)) or file=sys.stderr)"))
+        elif name == "sys.stdout.write":
+            out.append((node.lineno,
+                        "sys.stdout.write in bench.py breaks the "
+                        "one-JSON-line stdout contract"))
+    return out
+
+
+# -- FF005 ------------------------------------------------------------------
+
+def _check_pallas_confinement(tree: ast.AST, path: str):
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "pallas_call":
+            out.append((node.lineno,
+                        "raw pallas_call outside ops/pallas_kernels.py: "
+                        "kernels without AD rules must stay behind the "
+                        "audited choke points (sparse protocol / serving "
+                        "decode)"))
+        elif isinstance(node, ast.ImportFrom):
+            # Raw jax pallas only — the repo's own wrapper library
+            # (ops/pallas_kernels) IS the sanctioned import surface.
+            if node.module and "pallas" in node.module \
+                    and node.module.startswith("jax."):
+                out.append((node.lineno,
+                            f"import of {node.module!r} outside the "
+                            f"kernel library (FF005 confinement)"))
+    return out
+
+
+# -- FF006 ------------------------------------------------------------------
+
+def _module_is_cap_aware(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _CAP_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _CAP_NAMES:
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name in _CAP_NAMES:
+                    return True
+    return False
+
+
+def _check_unclamped_superstep_k(tree: ast.AST, path: str):
+    builders = ("build_superstep", "build_decode_superstep")
+    calls = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.split(".")[-1] in builders:
+                calls.append(node)
+    if not calls:
+        return []
+    cap_aware = _module_is_cap_aware(tree)
+    out = []
+    for node in calls:
+        k = node.args[0] if node.args else None
+        if k is None:
+            for kw in node.keywords:
+                if kw.arg == "k":
+                    k = kw.value
+        if isinstance(k, ast.Constant) and isinstance(k.value, int) \
+                and k.value <= RELAY_CAP:
+            continue  # literal under the cap: safe by inspection
+        if cap_aware:
+            continue  # module clamps through the relay-cap helper
+        out.append((node.lineno,
+                    "superstep/decode k flows into a scan build without "
+                    "passing the relay cap (relay_safe_steps / "
+                    "MAX_STEPS_PER_CALL): the keep-chains-short hazard"))
+    return out
+
+
+# -- FF007 ------------------------------------------------------------------
+
+def _check_tool_subprocess_timeout(tree: ast.AST, path: str):
+    out = []
+    # Resolve `import subprocess as sp` style aliases so the alias
+    # cannot evade the rule.
+    aliases = {"subprocess"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "subprocess":
+                    aliases.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name.split(".")[-1] not in (
+                "run", "Popen", "check_output", "check_call", "call",
+                "communicate", "wait"):
+            continue
+        timeout_kw = next(
+            (k for k in node.keywords if k.arg == "timeout"), None
+        )
+        if timeout_kw is None:
+            continue
+        # Only subprocess-ish call sites: require the dotted name's
+        # root to be the subprocess module (or an alias of it), or a
+        # proc-like receiver method.  The violation anchors on the
+        # timeout kwarg's line so an inline suppression sits next to
+        # the thing it sanctions.
+        if name.split(".")[0] in aliases or "subprocess" in name \
+                or name.split(".")[-1] in ("communicate", "wait"):
+            out.append((timeout_kw.value.lineno,
+                        "timeout-killed subprocess in tools/: killing a "
+                        "TPU-claim holder wedges the tunnel for hours "
+                        "(CLAUDE.md); probe in a claimless subprocess or "
+                        "run to completion"))
+    return out
+
+
+RULES: List[Rule] = [
+    Rule(
+        "FF001", "block_until_ready on a runtime path",
+        "CLAUDE.md: fence with jax.device_get — block_until_ready is a "
+        "no-op through the axon relay",
+        lambda p: p.endswith(".py") and not _is_test(p),
+        _check_block_until_ready,
+    ),
+    Rule(
+        "FF002", 'jax.devices("tpu") named lookup',
+        "CLAUDE.md: the relay masquerades as tpu; named lookup tries a "
+        "real local device and fails",
+        lambda p: p.endswith(".py"),
+        _check_named_tpu_lookup,
+    ),
+    Rule(
+        "FF003", "host time/RNG inside a jit-traced function",
+        "traced-once host values freeze into the compiled program and "
+        "break deterministic replay (RESILIENCE.md)",
+        lambda p: p.endswith(".py") and not _is_test(p),
+        _check_host_impurity_in_jit,
+    ),
+    Rule(
+        "FF004", "bare stdout write in bench.py",
+        "bench.py prints exactly ONE JSON line on stdout (CLAUDE.md "
+        "design invariant); everything else goes to stderr",
+        lambda p: os.path.basename(p) == "bench.py",
+        _check_bench_stdout,
+    ),
+    Rule(
+        "FF005", "pallas_call outside the kernel library",
+        "kernels without AD rules are reachable only via the sparse "
+        "protocol or serving programs (CLAUDE.md design invariant)",
+        lambda p: p.endswith(".py") and p not in PALLAS_ALLOWLIST
+        and not _is_test(p),
+        _check_pallas_confinement,
+    ),
+    Rule(
+        "FF006", "unclamped superstep/decode k",
+        "k <= 20 keep-chains-short relay clamp (CLAUDE.md): scan builds "
+        "must pass the relay-cap helper",
+        lambda p: p.endswith(".py") and not _is_test(p),
+        _check_unclamped_superstep_k,
+    ),
+    Rule(
+        "FF007", "timeout-killed subprocess in tools/",
+        "CLAUDE.md: NEVER timeout-kill a TPU-claim holder — it wedges "
+        "the tunnel for hours",
+        lambda p: p.startswith("tools/") and p.endswith(".py"),
+        _check_tool_subprocess_timeout,
+    ),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}
+
+
+def _suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line -> suppressed rule ids, file-level suppressed ids)."""
+    per_line: Dict[int, Set[str]] = {}
+    file_level: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            file_level.update(
+                s.strip() for s in m.group(1).split(",") if s.strip()
+            )
+            continue
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            per_line.setdefault(i, set()).update(
+                s.strip() for s in m.group(1).split(",") if s.strip()
+            )
+    return per_line, file_level
+
+
+def lint_source(
+    source: str,
+    path: str,
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Violation]:
+    """Lint one file's source under its repo-relative ``path``."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation("FF000", path, e.lineno or 0,
+                          f"syntax error: {e.msg}")]
+    per_line, file_level = _suppressions(source)
+    out: List[Violation] = []
+    for rule in (rules if rules is not None else RULES):
+        if not rule.applies(path):
+            continue
+        for line, msg in rule.check(tree, path):
+            if rule.id in file_level or rule.id in per_line.get(line, ()):
+                continue
+            out.append(Violation(rule.id, path, line, msg))
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def repo_root() -> str:
+    """The repo root: the directory holding the ``flexflow_tpu``
+    package this module lives in."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def iter_python_files(root: Optional[str] = None) -> List[str]:
+    root = root or repo_root()
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", ".claude", "ckpts")
+        ]
+        for f in filenames:
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def lint_paths(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+) -> List[Violation]:
+    """Lint files (absolute or repo-relative paths; default: the whole
+    repo).  Rule scopes match on repo-relative paths."""
+    root = root or repo_root()
+    files = [
+        p if os.path.isabs(p) else os.path.join(root, p)
+        for p in (paths if paths else iter_python_files(root))
+    ]
+    out: List[Violation] = []
+    for f in files:
+        rel = os.path.relpath(f, root)
+        try:
+            with open(f) as fh:
+                src = fh.read()
+        except OSError as e:
+            out.append(Violation("FF000", rel, 0, f"unreadable: {e}"))
+            continue
+        out.extend(lint_source(src, rel))
+    return out
+
+
+def format_report(violations: Sequence[Violation]) -> str:
+    if not violations:
+        return "fflint: clean"
+    lines = [str(v) for v in violations]
+    lines.append(f"fflint: {len(violations)} violation(s)")
+    return "\n".join(lines)

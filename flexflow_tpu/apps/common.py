@@ -209,9 +209,26 @@ def _dry_run(ff: FFModel, ex, strategy: Optional[StrategyStore]) -> Dict[str, fl
     metrics = avals[3]
     print(f"parameters = {total}")
     print(f"metrics = {sorted(metrics)}")
+    # The program audit over the EXACT programs this run would build
+    # (trace-only: AD-reachability, purity, dispatch accounting —
+    # ANALYSIS.md); violations are named, not fatal, so a dry run
+    # stays a diagnostic.
+    from flexflow_tpu import analysis
+
+    violations = analysis.audit_executor(ex)
+    print(analysis.summary_line(violations))
+    for v in violations:
+        print(f"  {v}")
+    from flexflow_tpu.runtime import telemetry as _telemetry
+
+    _telemetry.current().emit(
+        "analysis", clean=not violations,
+        violations=[str(v) for v in violations],
+    )
     print("DRY RUN OK (no device compute)")
     return {"parameters": float(total), "elapsed_s": 0.0,
-            "samples_per_s": 0.0, "dry_run": True}
+            "samples_per_s": 0.0, "dry_run": True,
+            "audit_violations": len(violations)}
 
 
 def make_batch_fn(

@@ -69,6 +69,19 @@ def _dry_run(sex, decode_steps: int) -> int:
     print(f"{'decode k=' + str(decode_steps):<18} "
           f"{str(tuple(toks.shape)) + ' tokens':<28} "
           f"1 dispatch + 1 fence per {decode_steps} tokens")
+    # The program audit over the exact serving programs this run would
+    # build (purity + K-tokens-per-dispatch accounting, ANALYSIS.md).
+    from flexflow_tpu import analysis
+    from flexflow_tpu.runtime import telemetry as _telemetry
+
+    violations = analysis.audit_serving(sex, decode_steps=decode_steps)
+    print(analysis.summary_line(violations))
+    for v in violations:
+        print(f"  {v}")
+    _telemetry.current().emit(
+        "analysis", clean=not violations,
+        violations=[str(v) for v in violations],
+    )
     print("DRY RUN OK (no device compute)")
     return 0
 
@@ -120,7 +133,10 @@ def main(argv=None) -> int:
         decode_kernel=False if no_kernel else None,
     )
     if cfg.dry_run:
-        return _dry_run(sex, decode_steps)
+        # Inside maybe_run so the dry run's `analysis` audit event
+        # lands in the JSONL stream when telemetry is armed.
+        with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
+            return _dry_run(sex, decode_steps)
 
     with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
         if cfg.ckpt_dir:

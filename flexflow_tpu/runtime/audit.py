@@ -1,298 +1,29 @@
-"""Post-SPMD HLO collective audit.
-
-"No involuntary-remat warnings" (tests/test_reshard.py) proves GSPMD
-did not hit its replicate-then-repartition fallback, but not that the
-partitions are *efficient*: a strategy boundary could still lower to
-an all-gather that materializes a full, unsharded-size activation on
-every device.  The reference gets this property by construction —
-halo/repartition copies move exactly the needed rectangles
-(``src/ops/conv_2d.cu:177-209``); here we verify it after compilation
-by parsing the optimized HLO of the real jitted train step
-(``Executor.lower_train_step().compile()``), with zero hardware
-needed (VERDICT r3 item 4).
-
-``collective_stats`` extracts every cross-device collective with its
-per-device result element count; ``full_activation_allgathers``
-flags all-gathers whose result reaches the full global size of an
-activation that the strategy says should be sharded.
-"""
+"""Deprecation shim: the post-SPMD HLO collective audit moved to
+``flexflow_tpu.analysis.hlo`` (the fflint HLO rule family), giving the
+repo ONE audit surface.  Import from ``flexflow_tpu.analysis`` (or
+``flexflow_tpu.analysis.hlo``) going forward."""
 
 from __future__ import annotations
 
-import dataclasses
-import re
-from typing import Dict, List
+import warnings
 
-#: HLO opcodes that move data across devices.
-COLLECTIVE_OPS = (
-    "all-gather",
-    "all-to-all",
-    "collective-permute",
-    "all-reduce",
-    "reduce-scatter",
+from flexflow_tpu.analysis.hlo import (  # noqa: F401
+    COLLECTIVE_OPS,
+    Collective,
+    _attribute,
+    collective_bytes_by_op,
+    collective_stats,
+    count_collectives,
+    format_bytes_report,
+    full_activation_allgathers,
+    pipeline_collective_bytes,
+    sharded_activation_sizes,
+    spatial_halo_optimal_bytes,
 )
 
-# `%all-gather.3 = f32[16,128]{1,0} all-gather(...)` — result shape
-# precedes the opcode; tuple-shaped results list several arrays and
-# XLA's collective combiner nests them one level deep
-# (`((f32[4,8]{1,0}, ...), (f32[32,8]{1,0}, ...)) all-gather-start`),
-# so the tuple alternative admits one level of inner parens.
-# Async lowering splits each collective into `-start`/`-done` pairs;
-# the `-start` carries the transfer (counted), the `-done` only
-# unpacks its result (excluded by requiring `(` after the suffix).
-_INSTR_RE = re.compile(
-    r"=\s*(?P<shape>\((?:[^()]|\([^()]*\))*\)|[a-z0-9]+\[[0-9,]*\][^\s]*)\s+"
-    r"(?P<opcode>(?:" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?)\("
+warnings.warn(
+    "flexflow_tpu.runtime.audit moved to flexflow_tpu.analysis.hlo "
+    "(the unified fflint audit surface); update the import",
+    DeprecationWarning,
+    stacklevel=2,
 )
-_ARRAY_RE = re.compile(r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[0-9,]*)\]")
-#: Instruction metadata carries the jax named-scope path
-#: (Executor.forward wraps each op in ``jax.named_scope(op.name)``).
-_META_RE = re.compile(r'op_name="(?P<name>[^"]*)"')
-
-#: HLO element widths (bytes); unknown dtypes fall back to 4.
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
-    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-    "f64": 8, "c64": 8, "c128": 16,
-}
-
-
-@dataclasses.dataclass
-class Collective:
-    opcode: str
-    shape: str
-    elements: int  # per-device result elements (largest tuple member)
-    bytes: int = 0  # per-device result bytes (summed over tuple members)
-    op_name: str = ""  # metadata scope path ("" when absent)
-
-
-def _elements(shape: str) -> int:
-    best = 0
-    for m in _ARRAY_RE.finditer(shape):
-        dims = m.group("dims")
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        best = max(best, n)
-    return best
-
-
-def _bytes(shape: str) -> int:
-    """Total result bytes over ALL tuple members — the data-movement
-    measure (``_elements`` keeps the max-member semantics the
-    full-size check relies on)."""
-    total = 0
-    for m in _ARRAY_RE.finditer(shape):
-        n = 1
-        for d in m.group("dims").split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(m.group("dtype"), 4)
-    return total
-
-
-def collective_stats(hlo_text: str) -> List[Collective]:
-    """All cross-device collectives in compiled HLO text, with their
-    per-device result sizes, bytes, and metadata scope path."""
-    out = []
-    for m in _INSTR_RE.finditer(hlo_text):
-        line = hlo_text[m.start():hlo_text.find("\n", m.start())]
-        meta = _META_RE.search(line)
-        out.append(Collective(
-            m.group("opcode").removesuffix("-start"),
-            m.group("shape"),
-            _elements(m.group("shape")),
-            _bytes(m.group("shape")),
-            meta.group("name") if meta else "",
-        ))
-    return out
-
-
-def count_collectives(hlo_text: str) -> Dict[str, int]:
-    out: Dict[str, int] = {}
-    for c in collective_stats(hlo_text):
-        out[c.opcode] = out.get(c.opcode, 0) + 1
-    return out
-
-
-def _attribute(op_name_meta: str, model_ops: List[str]) -> str:
-    """Model op a collective belongs to: the LAST model-op path
-    component in the metadata scope (autodiff scopes nest like
-    ``transpose(.../conv2/...)``; longest-name-first avoids prefix
-    collisions like fc1 vs fc10)."""
-    components = re.split(r"[/()]", op_name_meta)
-    best = ""
-    best_pos = -1
-    for name in model_ops:
-        for i, comp in enumerate(components):
-            if comp == name and i > best_pos:
-                best, best_pos = name, i
-    return best or "<unattributed>"
-
-
-def collective_bytes_by_op(ex, hlo_text: str = None) -> Dict[str, Dict[str, int]]:
-    """Bytes moved per model op per collective opcode for the compiled
-    train step — the data-movement ledger the reference gets implicitly
-    from exact-rectangle Legion copies (``conv_2d.cu:177-209``).  A
-    strategy that is legal-but-chatty (e.g. a spatial split whose halo
-    lowers to a full-axis gather) shows up here as outsized bytes on
-    that op.  Keyed op -> {opcode -> total bytes}; scopes the audit
-    cannot attribute land under ``<unattributed>`` (optimizer update,
-    fused cross-op code)."""
-    if hlo_text is None:
-        hlo_text = ex.lower_train_step().compile().as_text()
-    names = [op.name for op in ex.model.layers]
-    out: Dict[str, Dict[str, int]] = {}
-    for c in collective_stats(hlo_text):
-        op = _attribute(c.op_name, names)
-        bucket = out.setdefault(op, {})
-        bucket[c.opcode] = bucket.get(c.opcode, 0) + c.bytes
-    return out
-
-
-def format_bytes_report(by_op: Dict[str, Dict[str, int]]) -> str:
-    """Human-readable per-op byte ledger (printed by the search CLI)."""
-    lines = [f"{'op':<24} {'collective':<20} {'bytes/device':>14}"]
-    total = 0
-    for op in sorted(by_op):
-        for opcode, b in sorted(by_op[op].items()):
-            lines.append(f"{op:<24} {opcode:<20} {b:>14,}")
-            total += b
-    lines.append(f"{'TOTAL':<24} {'':<20} {total:>14,}")
-    return "\n".join(lines)
-
-
-def spatial_halo_optimal_bytes(op, pc, dtype_bytes: int = 4) -> int:
-    """PER-DEVICE bytes an OPTIMAL halo exchange receives for one
-    spatially-split conv/pool op, fwd + bwd — per-device because HLO
-    collective result shapes (what ``Collective.bytes`` measures) are
-    per-device.
-
-    The reference moves exactly the needed input rectangles per shard
-    (``conv_2d.cu:177-209``): an interior device receives at most
-    ``kh-1`` rows (both h-neighbors combined), ``kw-1`` columns, and
-    the corner overlaps, all at LOCAL tile extents.  The backward data
-    pass mirrors the same halos for dx (dy tiles are disjoint) —
-    factor 2.  Returns 0 for ops without spatial degrees or kernels."""
-    kernel = getattr(op, "attrs", {}).get("kernel")
-    if not kernel:
-        return 0
-    kh, kw = kernel
-    dh, dw = pc.degree("h"), pc.degree("w")
-    if dh <= 1 and dw <= 1:
-        return 0
-    t = op.inputs[0]
-    b, H, W, C = t.shape if len(t.shape) == 4 else (1, *t.shape)
-    dn = pc.degree("n")
-    b_loc = -(-b // dn)
-    h_loc = -(-H // dh)
-    w_loc = -(-W // dw)
-    recv_h = (kh - 1) * w_loc * C * b_loc if dh > 1 else 0
-    recv_w = (kw - 1) * h_loc * C * b_loc if dw > 1 else 0
-    corner = (kh - 1) * (kw - 1) * C * b_loc if (dh > 1 and dw > 1) else 0
-    return 2 * dtype_bytes * (recv_h + recv_w + corner)
-
-
-def pipeline_collective_bytes(pipe) -> Dict[str, Dict[str, int]]:
-    """Per-op collective bytes for a ``PipelineExecutor``, one
-    microbatch through every stage.
-
-    Lowers each stage's REAL fwd and bwd programs (the jits
-    ``train_step`` dispatches).  Auditing a stage's ``lower_train_step``
-    instead would be vacuous for every non-final stage: its loss is a
-    constant zero, so XLA folds the gradients and DCE's the
-    collectives.  fwd + bwd double-counts nothing — the bwd program
-    really does recompute the stage forward (remat at stage
-    boundaries), so its collectives run again at step time.
-    Cross-stage boundary transfers are host ``device_put``s, invisible
-    to any stage's HLO."""
-    import jax
-    import jax.numpy as jnp
-
-    merged: Dict[str, Dict[str, int]] = {}
-
-    def _acc(ex, hlo):
-        for op, d in collective_bytes_by_op(ex, hlo).items():
-            bucket = merged.setdefault(op, {})
-            for k, v in d.items():
-                bucket[k] = bucket.get(k, 0) + v
-
-    graph_inputs = {t.name for t in pipe.model.input_tensors}
-    boundary: Dict[str, jax.ShapeDtypeStruct] = {}
-    m = pipe.microbatches
-    dloss = jax.ShapeDtypeStruct((), jnp.float32)
-    for si, st in enumerate(pipe.stages):
-        ex = pipe.stage_ex[si]
-        p, o, s = ex._abstract_init()
-        inputs = {}
-        for n in st.in_names:
-            spec = pipe._spec_of[n]
-            if n in graph_inputs:
-                shape = (spec.shape[0] // m,) + tuple(spec.shape[1:])
-                inputs[n] = jax.ShapeDtypeStruct(shape, spec.dtype)
-            else:
-                inputs[n] = boundary[n]
-        _acc(ex, pipe._fwd_fns[si].lower(p, s, inputs).compile().as_text())
-        outs = jax.eval_shape(pipe._fwd_fns[si], p, s, inputs)[0]
-        boundary.update(outs)
-        douts = {n: boundary[n] for n in st.out_names}
-        _acc(ex, pipe._bwd_fns[si].lower(
-            p, s, inputs, douts, dloss).compile().as_text())
-    return merged
-
-
-def sharded_activation_sizes(ex) -> Dict[str, int]:
-    """Global element counts of activations whose producing op's
-    strategy shards them (num_parts > 1) — the tensors an efficient
-    partition must never materialize in full on one device."""
-    sizes: Dict[str, int] = {}
-    for op in ex.model.layers:
-        if ex._pc(op).num_parts <= 1:
-            continue
-        for t in op.outputs:
-            n = 1
-            for d in t.shape:
-                n *= int(d)
-            sizes[t.name] = n
-    return sizes
-
-
-def _param_sizes(ex) -> set:
-    """Global element counts of trained parameters and op state —
-    tensors a strategy may legitimately all-gather in full (ZeRO-1
-    re-gather, replicated-weight placement)."""
-    sizes = set()
-    for op in ex.model.layers:
-        for specs in (op.param_specs(), op.state_specs()):
-            for ps in specs.values():
-                n = 1
-                for d in ps.shape:
-                    n *= int(d)
-                sizes.add(n)
-    return sizes
-
-
-def full_activation_allgathers(ex, hlo_text: str = None) -> List[Collective]:
-    """All-gathers whose per-device result reaches the full global
-    size of a sharded activation — the replicate-then-slice pattern
-    decomposed resharding exists to prevent.  Empty list = provably
-    no full-activation materialization in the compiled step.
-
-    Matching is by element count (XLA reshapes/merges dims freely in
-    optimized HLO, so shape strings don't survive).  Under ZeRO-1 the
-    step legitimately re-gathers full parameters, so counts that are
-    also parameter/state global sizes are excluded THERE — but only
-    there: unconditionally subtracting them would mask a real
-    activation all-gather whenever an activation count collides with a
-    parameter count (e.g. b*s*d == vocab*d exactly when b*s == vocab,
-    the flagship bench shape)."""
-    if hlo_text is None:
-        hlo_text = ex.lower_train_step().compile().as_text()
-    sizes = set(sharded_activation_sizes(ex).values())
-    if getattr(getattr(ex, "config", None), "zero_sharded_optimizer", False):
-        sizes -= _param_sizes(ex)
-    return [
-        c for c in collective_stats(hlo_text)
-        if c.opcode == "all-gather" and c.elements in sizes
-    ]

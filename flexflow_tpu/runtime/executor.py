@@ -358,7 +358,7 @@ class Executor:
             # The named scope lands in HLO instruction metadata
             # (op_name="…/opname/…"), which is what lets the post-SPMD
             # audit attribute collectives — and their bytes — to model
-            # ops (runtime/audit.py collective_bytes_by_op).
+            # ops (analysis/hlo.py collective_bytes_by_op).
             with jax.named_scope(op.name):
                 xs = [
                     self._reshard_input(env[t.name], env_spec.get(t.name), t, op)

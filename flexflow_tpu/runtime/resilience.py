@@ -48,7 +48,10 @@ import numpy as np
 from flexflow_tpu.runtime import telemetry as _telemetry
 from flexflow_tpu.runtime.checkpoint import CheckpointManager
 from flexflow_tpu.runtime.executor import Executor
-from flexflow_tpu.runtime.trainer import MAX_STEPS_PER_CALL
+from flexflow_tpu.runtime.trainer import (
+    MAX_STEPS_PER_CALL,
+    relay_safe_steps,
+)
 
 logger = logging.getLogger("ff.resilience")
 
@@ -386,14 +389,7 @@ class ResilientTrainer:
         check_every: Optional[int],
     ) -> Dict[str, Any]:
         injector = FaultInjector.wrap(self.fault_injector)
-        k = max(1, steps_per_call)
-        if k > MAX_STEPS_PER_CALL:
-            logger.warning(
-                "steps_per_call=%d exceeds the relay-safe fence cap; "
-                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
-                k, MAX_STEPS_PER_CALL,
-            )
-            k = MAX_STEPS_PER_CALL
+        k = relay_safe_steps(steps_per_call, log=logger)
         # The k=1 fence period is the same relay hazard as the
         # superstep length (an unfenced dependent dispatch chain):
         # clamp it to the same cap.

@@ -66,6 +66,7 @@ from flexflow_tpu.runtime import telemetry as _telemetry
 #: regimes cannot drift if the relay-safe cap is ever retuned.
 from flexflow_tpu.runtime.trainer import (
     MAX_STEPS_PER_CALL as MAX_DECODE_STEPS_PER_CALL,
+    relay_safe_steps,
 )
 
 _log = logging.getLogger("ff.serving")
@@ -495,14 +496,9 @@ class Server:
         self.ex = executor
         self.params = params
         self.op_state = op_state
-        if decode_steps > MAX_DECODE_STEPS_PER_CALL:
-            _log.warning(
-                "decode_steps=%d exceeds the relay-safe fence cap; "
-                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
-                decode_steps, MAX_DECODE_STEPS_PER_CALL,
-            )
-            decode_steps = MAX_DECODE_STEPS_PER_CALL
-        self.decode_steps = max(1, int(decode_steps))
+        self.decode_steps = relay_safe_steps(
+            decode_steps, what="decode_steps", log=_log
+        )
         self.eos_id = eos_id
         self.injector = fault_injector
 

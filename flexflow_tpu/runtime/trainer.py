@@ -32,6 +32,25 @@ _log = logging.getLogger("ff.trainer")
 MAX_STEPS_PER_CALL = 20
 
 
+def relay_safe_steps(k: int, what: str = "steps_per_call",
+                     log: logging.Logger = _log) -> int:
+    """THE relay-cap helper: clamp a fused-dispatch step count to
+    ``MAX_STEPS_PER_CALL`` with the loud keep-chains-short warning.
+    Every ``build_superstep``/``build_decode_superstep`` feed must pass
+    through here (fflint FF006 flags scan builds in modules that
+    don't), so the relay-wedge hazard has one owner instead of N
+    copied clamps."""
+    k = int(k)
+    if k > MAX_STEPS_PER_CALL:
+        log.warning(
+            "%s=%d exceeds the relay-safe fence cap; "
+            "clamping to %d (CLAUDE.md keep-chains-short hazard)",
+            what, k, MAX_STEPS_PER_CALL,
+        )
+        return MAX_STEPS_PER_CALL
+    return max(1, k)
+
+
 class Trainer:
     def __init__(self, executor: Executor):
         self.ex = executor
@@ -365,13 +384,7 @@ class Trainer:
                 "scan cannot fuse — they take the fence-amortized path"
             )
         assert iterations > 0, "fit() needs at least one iteration"
-        if k > MAX_STEPS_PER_CALL:
-            _log.warning(
-                "steps_per_call=%d exceeds the relay-safe fence cap; "
-                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
-                k, MAX_STEPS_PER_CALL,
-            )
-            k = MAX_STEPS_PER_CALL
+        k = relay_safe_steps(k)
         step_fns = {k: ex.build_superstep(k, accum_steps)}
         params, opt_state, state = ex.init()
         start_step = 0
@@ -619,13 +632,7 @@ class Trainer:
                 "accum_steps composes with full-mesh strategies only; "
                 "pipeline strategies microbatch via microbatches="
             )
-        if k > MAX_STEPS_PER_CALL:
-            _log.warning(
-                "steps_per_call=%d exceeds the relay-safe fence cap; "
-                "clamping to %d (CLAUDE.md keep-chains-short hazard)",
-                k, MAX_STEPS_PER_CALL,
-            )
-            k = MAX_STEPS_PER_CALL
+        k = relay_safe_steps(k)
         if ex.config.clip_norm > 0.0:
             _log.warning(
                 "steps_per_call=%d with clip_norm=%g: the global-norm "

@@ -97,7 +97,7 @@ def main(argv=None):
         "--audit-bytes", action="store_true",
         help="after the search, compile the train step under the found "
              "strategy on this host's devices and print the bytes each "
-             "op's collectives move (runtime/audit.py ledger — catches "
+             "op's collectives move (analysis/hlo.py ledger — catches "
              "legal-but-chatty strategies whose halos lower to full "
              "gathers)")
     ap.add_argument("-o", "--output", default="strategy.json")
@@ -168,7 +168,7 @@ def main(argv=None):
     if args.audit_bytes:
         import jax
 
-        from flexflow_tpu.runtime.audit import (
+        from flexflow_tpu.analysis.hlo import (
             collective_bytes_by_op,
             format_bytes_report,
             pipeline_collective_bytes,

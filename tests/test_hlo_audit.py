@@ -12,7 +12,7 @@ import jax
 import pytest
 
 from flexflow_tpu.optim import SGDOptimizer
-from flexflow_tpu.runtime.audit import (
+from flexflow_tpu.analysis.hlo import (
     Collective,
     collective_stats,
     count_collectives,
@@ -162,7 +162,7 @@ class TestByteAccounting:
         assert stats[1].op_name == "jit(step)/transpose(fc1)/dot"
 
     def test_attribution_by_op(self):
-        from flexflow_tpu.runtime.audit import _attribute
+        from flexflow_tpu.analysis.hlo import _attribute
 
         ops = ["fc1", "fc10", "conv2"]
         assert _attribute("jit(f)/fc10/dot", ops) == "fc10"
@@ -178,7 +178,7 @@ class TestByteAccounting:
         Gradient all-reduce is param sync, not halo traffic."""
         from tests.test_reshard import _boundary_model
 
-        from flexflow_tpu.runtime.audit import (
+        from flexflow_tpu.analysis.hlo import (
             collective_bytes_by_op,
             spatial_halo_optimal_bytes,
         )
@@ -204,7 +204,7 @@ class TestByteAccounting:
         from flexflow_tpu.config import FFConfig
         from flexflow_tpu.graph import FFModel
         from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
-        from flexflow_tpu.runtime.audit import (
+        from flexflow_tpu.analysis.hlo import (
             collective_bytes_by_op,
             spatial_halo_optimal_bytes,
         )
@@ -253,7 +253,7 @@ class TestByteAccounting:
         DCEs every collective, hiding chatty placements."""
         from tests.test_pipeline import _strategy_two_stage, _two_stage_model
 
-        from flexflow_tpu.runtime.audit import pipeline_collective_bytes
+        from flexflow_tpu.analysis.hlo import pipeline_collective_bytes
         from flexflow_tpu.runtime.pipeline import PipelineExecutor
 
         pipe = PipelineExecutor(_two_stage_model(), _strategy_two_stage())

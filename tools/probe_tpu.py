@@ -35,7 +35,11 @@ def probe(timeout_s: float) -> tuple:
     try:
         out = subprocess.run(
             [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
+            # The SANCTIONED timeout-kill: this throwaway probe exists
+            # precisely so nothing else ever needs one (CLAUDE.md:
+            # "probe health in a short subprocess first"); killing it
+            # abandons a claim attempt, not a held claim.
+            capture_output=True, text=True, timeout=timeout_s, env=env,  # fflint: disable=FF007
         )
     except subprocess.TimeoutExpired:
         return False, f"timeout after {timeout_s:.0f}s (backend hang)"

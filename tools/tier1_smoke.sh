@@ -13,8 +13,14 @@
 # Usage: ./tools/tier1_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# fflint first (ANALYSIS.md): AST rules + the trace-only program audit
+# (< 60 s) — the invariant gate runs before the suites that depend on
+# the invariants.
+env PYTHONPATH="$(pwd)" JAX_PLATFORMS=cpu \
+    python -m flexflow_tpu.analysis --fast
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ops.py \
+    tests/test_analysis.py \
     tests/test_sharding_equivalence.py \
     tests/test_pipeline.py \
     tests/test_pipeline_chunk.py \
