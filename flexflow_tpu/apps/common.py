@@ -49,6 +49,11 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
   --lr-schedule constant|cosine|step  --warmup N  --decay-steps N
   --min-lr F  --lr-gamma F (adam only)
   --profiling   --dry-run   --remat   --trace DIR   --ones-init   --zc-dataset
+  --stream-dataset (out-of-core streaming tier: background chunk
+                    reader -> windowed shuffle -> H2D prefetch; the
+                    dataset is never host-materialized; DATA.md)
+  --shuffle-window W (streaming shuffle width; 0 = whole host shard,
+                    which matches the in-memory loader bit-for-bit)
   --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
   --pipeline-chunk C (scan C microbatches per stage program)
   --pipeline-compiled (ONE jitted program per pipeline step: fence-free
@@ -305,6 +310,33 @@ def _run_eval(trainer: Trainer, params, state, cfg: FFConfig,
     return ev
 
 
+def _make_stream_loader(cfg: FFConfig, arrays, stream_source):
+    """--stream-dataset: build the out-of-core streaming loader
+    (data/stream.py; tiering table + determinism contract in DATA.md).
+    ``stream_source`` is an app-provided StreamSource (HDF5 / trace);
+    otherwise the app's arrays back an ArrayStreamSource."""
+    if cfg.zc_dataset:
+        raise SystemExit(
+            "--stream-dataset (out-of-core) and --zc-dataset "
+            "(whole-dataset device staging) are opposite ends of the "
+            "data tiering table; pick one (DATA.md)"
+        )
+    from flexflow_tpu.data.stream import ArrayStreamSource, StreamingLoader
+
+    src = stream_source
+    if src is None:
+        if arrays is None:
+            raise SystemExit(
+                "--stream-dataset needs a dataset: -d PATH, an "
+                "app-provided stream source, or synthetic arrays"
+            )
+        src = ArrayStreamSource(arrays)
+    return StreamingLoader(
+        src, cfg.batch_size, shuffle=True, seed=cfg.seed,
+        shuffle_window=cfg.shuffle_window,
+    )
+
+
 def _run_resilient(
     ff: FFModel,
     cfg: FFConfig,
@@ -313,6 +345,7 @@ def _run_resilient(
     arrays: Optional[Dict[str, np.ndarray]],
     int_high: Optional[Dict[str, int]],
     label: str,
+    stream_source=None,
 ) -> Dict[str, float]:
     """--resilient: the ResilientTrainer loop (runtime/resilience.py) —
     failure detection, checkpoint rollback with deterministic replay,
@@ -346,7 +379,16 @@ def _run_resilient(
         # The same true holdout as the non-resilient path: EVAL numbers
         # stay comparable across the two modes.
         arrays, eval_arrays = _holdout_split(cfg, arrays)
-    batch_fn = make_batch_fn(ff, cfg, arrays, int_high)
+    loader = batch_fn = None
+    if cfg.stream_dataset:
+        # The resilient loop drives the StreamingLoader DIRECTLY (no
+        # PrefetchLoader wrapper; disk overlap still comes from the
+        # reader thread) so the checkpointed consumer-side cursor
+        # matches the step count exactly — rollback rewinds the stream
+        # for bit-identical replay (DATA.md).
+        loader = _make_stream_loader(cfg, arrays, stream_source)
+    else:
+        batch_fn = make_batch_fn(ff, cfg, arrays, int_high)
     iters = cfg.iterations * max(cfg.epochs, 1)
     ckdir = cfg.ckpt_dir or os.path.join(os.getcwd(), "ckpts")
     with CheckpointManager(ckdir, async_save=cfg.async_checkpointing) as ck:
@@ -355,13 +397,18 @@ def _run_resilient(
             policy=FailurePolicy(max_restarts=cfg.max_restarts),
         )
         start = time.perf_counter()
-        out = rt.fit(
-            iterations=iters,
-            batch_fn=batch_fn,
-            save_every=cfg.save_every,
-            seed=cfg.seed,
-            steps_per_call=cfg.steps_per_call,
-        )
+        try:
+            out = rt.fit(
+                iterations=iters,
+                batch_fn=batch_fn,
+                save_every=cfg.save_every,
+                seed=cfg.seed,
+                steps_per_call=cfg.steps_per_call,
+                loader=loader,
+            )
+        finally:
+            if loader is not None:
+                loader.close()
         elapsed = time.perf_counter() - start
         completed = len(out["losses"])
         throughput = completed * cfg.batch_size / max(elapsed, 1e-9)
@@ -411,6 +458,7 @@ def run_training(
     label: str = "samples",
     num_samples: Optional[int] = None,
     arrays: Optional[Dict[str, np.ndarray]] = None,
+    stream_source=None,
 ) -> Dict[str, float]:
     """Build the executor, feed batches, run ``cfg.epochs x
     cfg.iterations`` fenced steps, and print the reference throughput
@@ -428,7 +476,7 @@ def run_training(
 
     with _telemetry.maybe_run(cfg, meta={"app": label}):
         return _run_training(ff, cfg, strategy, int_high, label,
-                             num_samples, arrays)
+                             num_samples, arrays, stream_source)
 
 
 def _resolve_calibration(cfg: FFConfig):
@@ -549,6 +597,7 @@ def _run_training(
     label: str,
     num_samples: Optional[int],
     arrays: Optional[Dict[str, np.ndarray]],
+    stream_source=None,
 ) -> Dict[str, float]:
     ndev = cfg.resolve_num_devices()
     if strategy is None:
@@ -631,7 +680,7 @@ def _run_training(
 
         return _fold_auto_stats(
             _run_resilient(ff, cfg, executor_factory, ex, arrays,
-                           int_high, label),
+                           int_high, label, stream_source),
             auto_choice,
         )
     trainer = Trainer(ex)
@@ -639,7 +688,17 @@ def _run_training(
     eval_arrays = None
     if cfg.eval_iters > 0 and arrays is not None:
         arrays, eval_arrays = _holdout_split(cfg, arrays)
-    if arrays is not None:
+    if cfg.stream_dataset:
+        # --stream-dataset: three-stage disk -> host-batch -> device
+        # pipeline.  The StreamingLoader's reader thread double-buffers
+        # chunk windows ahead of the PrefetchLoader's H2D stage; its
+        # queue_depths gauge nests into the prefetcher's, so
+        # --telemetry shows starvation at BOTH queue edges (DATA.md).
+        batches = PrefetchLoader(
+            iter(_make_stream_loader(cfg, arrays, stream_source)),
+            ex.shard_batch,
+        )
+    elif arrays is not None:
         if cfg.zc_dataset:
             # --zc-dataset: the reference DLRM's zero-copy staging —
             # whole dataset device-resident, per-step on-device gather

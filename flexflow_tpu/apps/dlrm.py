@@ -11,13 +11,30 @@ Example (the run_random.sh benchmark shape)::
         --arch-sparse-feature-size 64 \
         --arch-embedding-size 1000000-1000000-1000000-1000000 \
         --arch-mlp-bot 64-512-512-64 --arch-mlp-top 320-1024-1024-1024-1
+
+DLRM-specific flags:
+  --prod-trace          stream a production-shaped synthetic trace
+                        (power-law-skewed embedding ids + bursty
+                        arrival; data/trace.py) — implies
+                        --stream-dataset.  Named --prod-trace because
+                        --trace DIR is the XProf capture flag.
+  --trace-alpha F       zipf skew of the trace ids (default 1.2, > 1)
+  --trace-burst S       pause S seconds every 16th chunk read (bursty
+                        arrival; default 0 = smooth)
+With -d PATH --stream-dataset, the Criteo HDF5 is read in chunks
+through CriteoStreamSource (never host-materialized; DATA.md).
 """
 
 from __future__ import annotations
 
 import sys
 
-from flexflow_tpu.apps.common import check_help, load_strategy, run_training
+from flexflow_tpu.apps.common import (
+    check_help,
+    load_strategy,
+    pop_float,
+    run_training,
+)
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm, dlrm_strategy
 
@@ -25,7 +42,15 @@ from flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm, dlrm_strategy
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     check_help(argv, __doc__)
+    prod_trace = "--prod-trace" in argv
+    if prod_trace:
+        argv.remove("--prod-trace")
+    trace_alpha = pop_float(argv, "--trace-alpha", 1.2)
+    trace_burst = pop_float(argv, "--trace-burst", 0.0)
     cfg = FFConfig.parse_args(argv)
+    if prod_trace:
+        # The trace generator only exists as a StreamSource.
+        cfg.stream_dataset = True
     if any(a.startswith("--arch-") for a in argv):
         dlrm = DLRMConfig.parse_args(argv)
     else:
@@ -44,15 +69,43 @@ def main(argv=None) -> int:
     strategy = load_strategy(cfg, ndev) or dlrm_strategy(ndev, dlrm)
     int_high = {"sparse_input": min(dlrm.embedding_size)}
     arrays = None
-    if cfg.dataset_path and not cfg.dry_run:
-        # The reference's Criteo HDF5 schema (dlrm.cc:239-281).
-        from flexflow_tpu.data.criteo import make_dlrm_arrays
+    stream_source = None
+    num_samples = cfg.batch_size * max(cfg.iterations, 1) * 2
+    if prod_trace and not cfg.dry_run:
+        if cfg.dataset_path:
+            raise SystemExit("--prod-trace and -d are mutually exclusive")
+        if len(set(dlrm.embedding_size)) != 1:
+            raise SystemExit(
+                "--prod-trace emits one stacked sparse_input tensor, "
+                "which needs uniform --arch-embedding-size vocabs"
+            )
+        from flexflow_tpu.data.trace import ProductionTraceSource
 
-        arrays = make_dlrm_arrays(
-            dlrm, num_samples=cfg.batch_size * max(cfg.iterations, 1) * 2,
-            path=cfg.dataset_path,
+        stream_source = ProductionTraceSource(
+            num_samples, dense_dim=dlrm.mlp_bot[0],
+            vocab_sizes=list(dlrm.embedding_size), alpha=trace_alpha,
+            seed=cfg.seed,
+            burst_every=16 if trace_burst > 0 else 0,
+            burst_s=trace_burst,
         )
-    run_training(ff, cfg, strategy=strategy, int_high=int_high, arrays=arrays)
+    elif cfg.dataset_path and not cfg.dry_run:
+        if cfg.stream_dataset:
+            # Chunked out-of-core reads straight off the HDF5 — the
+            # dataset never materializes on the host (DATA.md).
+            from flexflow_tpu.data.criteo import CriteoStreamSource
+
+            stream_source = CriteoStreamSource(
+                cfg.dataset_path, dlrm, max_samples=num_samples,
+            )
+        else:
+            # The reference's Criteo HDF5 schema (dlrm.cc:239-281).
+            from flexflow_tpu.data.criteo import make_dlrm_arrays
+
+            arrays = make_dlrm_arrays(
+                dlrm, num_samples=num_samples, path=cfg.dataset_path,
+            )
+    run_training(ff, cfg, strategy=strategy, int_high=int_high,
+                 arrays=arrays, stream_source=stream_source)
     return 0
 
 

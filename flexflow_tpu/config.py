@@ -125,6 +125,20 @@ class FFConfig:
     # zero-copy staging + in-step gather (dlrm.cc:226-330); use when
     # the dataset fits HBM.  Off = host gather + prefetched H2D.
     zc_dataset: bool = False
+    # --stream-dataset: drive training from the out-of-core streaming
+    # data plane (data/stream.py, DATA.md) — a background reader thread
+    # pulls chunked windows from the source (HDF5 / synthetic / trace)
+    # ahead of the H2D prefetch stage; the dataset is never
+    # materialized on the host.  Composes with --resilient (the loader
+    # cursor+rng checkpoint as a ``loader`` item; rollback rewinds the
+    # stream for bit-identical replay).
+    stream_dataset: bool = False
+    # --shuffle-window W: windowed-shuffle width for --stream-dataset.
+    # 0 (default) = whole host shard, which matches ArrayDataLoader
+    # bit-for-bit (composed epoch permutations); W < shard bounds
+    # shuffle memory to W rows with per-window memoryless shuffles
+    # (the out-of-core mode; determinism contract in DATA.md).
+    shuffle_window: int = 0
     # --search: run the MCMC strategy autotuner at launch when no -s
     # file is given (the reference runs its simulator offline and feeds
     # the result back via -s; this folds the two steps into one run).
@@ -279,6 +293,15 @@ class FFConfig:
                 cfg.dry_run = True
             elif a == "--zc-dataset":
                 cfg.zc_dataset = True
+            elif a == "--stream-dataset":
+                cfg.stream_dataset = True
+            elif a == "--shuffle-window":
+                cfg.shuffle_window = int(_next())
+                if cfg.shuffle_window < 0:
+                    raise SystemExit(
+                        f"--shuffle-window must be >= 0 (0 = whole "
+                        f"shard), got {cfg.shuffle_window}"
+                    )
             elif a == "--remat":
                 cfg.remat = True
             elif a in ("-i", "--iterations"):

@@ -1,19 +1,46 @@
 from flexflow_tpu.data.csv import load_csv_matrix, load_feature_csvs
 from flexflow_tpu.data.loader import (
     ArrayDataLoader,
+    DeviceMemoryError,
     DeviceResidentLoader,
     PrefetchLoader,
     synthetic_arrays,
 )
-from flexflow_tpu.data.criteo import load_criteo_h5, make_dlrm_arrays
+from flexflow_tpu.data.criteo import (
+    CriteoStreamSource,
+    load_criteo_h5,
+    make_dlrm_arrays,
+)
+from flexflow_tpu.data.stream import (
+    ArrayStreamSource,
+    H5StreamSource,
+    StreamingLoader,
+    StreamReaderError,
+    StreamSource,
+    SyntheticStreamSource,
+    ThrottledSource,
+    shard_for_host,
+)
+from flexflow_tpu.data.trace import ProductionTraceSource
 
 __all__ = [
     "ArrayDataLoader",
+    "ArrayStreamSource",
+    "CriteoStreamSource",
+    "DeviceMemoryError",
     "DeviceResidentLoader",
+    "H5StreamSource",
     "PrefetchLoader",
+    "ProductionTraceSource",
+    "StreamReaderError",
+    "StreamSource",
+    "StreamingLoader",
+    "SyntheticStreamSource",
+    "ThrottledSource",
     "load_csv_matrix",
     "load_feature_csvs",
     "synthetic_arrays",
     "load_criteo_h5",
     "make_dlrm_arrays",
+    "shard_for_host",
 ]

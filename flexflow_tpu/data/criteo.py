@@ -16,18 +16,91 @@ from typing import Dict, Optional
 import numpy as np
 
 
-def load_criteo_h5(path: str, max_samples: Optional[int] = None) -> Dict[str, np.ndarray]:
-    """Read the reference's H5 schema (``dlrm.cc:239-281``)."""
+#: Rows per HDF5 read in load_criteo_h5 — bounds peak RSS to one chunk
+#: of the SOURCE dtype over the preallocated target arrays (a whole-
+#: file slurp of int64 X_cat transiently doubled memory at the cast).
+H5_CHUNK_ROWS = 65536
+
+
+def load_criteo_h5(path: str, max_samples: Optional[int] = None,
+                   chunk_rows: int = H5_CHUNK_ROWS) -> Dict[str, np.ndarray]:
+    """Read the reference's H5 schema (``dlrm.cc:239-281``) in chunks.
+
+    Target-dtype arrays are preallocated at the ``max_samples`` cut and
+    filled chunk by chunk, so rows past the cut are never read and the
+    transient footprint is one source-dtype chunk, not the whole file.
+    """
     import h5py
 
     with h5py.File(path, "r") as f:
         n = f["y"].shape[0]
         if max_samples is not None:
             n = min(n, max_samples)
-        x_int = np.asarray(f["X_int"][:n], dtype=np.float32)
-        x_cat = np.asarray(f["X_cat"][:n], dtype=np.int64)
-        y = np.asarray(f["y"][:n], dtype=np.float32)
+        x_int = np.empty((n,) + f["X_int"].shape[1:], dtype=np.float32)
+        x_cat = np.empty((n,) + f["X_cat"].shape[1:], dtype=np.int64)
+        y = np.empty((n,), dtype=np.float32)
+        for lo in range(0, n, chunk_rows):
+            hi = min(lo + chunk_rows, n)
+            x_int[lo:hi] = f["X_int"][lo:hi]
+            x_cat[lo:hi] = f["X_cat"][lo:hi]
+            y[lo:hi] = f["y"][lo:hi]
     return {"X_int": x_int, "X_cat": x_cat, "y": y.reshape(-1, 1)}
+
+
+class CriteoStreamSource:
+    """Out-of-core DLRM source: the reference H5 schema re-keyed to
+    `build_dlrm` input names chunk-by-chunk (the streaming counterpart
+    of ``make_dlrm_arrays``; same transforms, same per-chunk vocab
+    validation), so the dataset never materializes in host RAM."""
+
+    def __init__(self, path: str, dlrm_config, max_samples: Optional[int] = None):
+        from flexflow_tpu.data.stream import H5StreamSource
+
+        self._h5 = H5StreamSource(
+            path, keys=["X_int", "X_cat", "y"], max_samples=max_samples)
+        self.num_samples = self._h5.num_samples
+        self._vocabs = list(dlrm_config.embedding_size)
+        self._uniform = len(set(self._vocabs)) == 1
+        dense_dim = self._h5.specs()["X_int"][0]
+        num_tables = self._h5.specs()["X_cat"][0][0]
+        assert num_tables == len(self._vocabs), (
+            f"dataset has {num_tables} sparse features, config expects "
+            f"{len(self._vocabs)}")
+        self._dense_dim = dense_dim
+
+    def specs(self):
+        out = {
+            "dense_input": (self._dense_dim, np.dtype(np.float32)),
+            "label": ((1,), np.dtype(np.float32)),
+        }
+        if self._uniform:
+            out["sparse_input"] = ((len(self._vocabs),), np.dtype(np.int32))
+        else:
+            for i in range(len(self._vocabs)):
+                out[f"sparse_{i}"] = ((1,), np.dtype(np.int32))
+        return out
+
+    def read(self, start: int, stop: int) -> Dict[str, np.ndarray]:
+        raw = self._h5.read(start, stop)
+        cat = raw["X_cat"]
+        for i, v in enumerate(self._vocabs):
+            hi = int(cat[:, i].max(initial=0))
+            assert hi < v, (
+                f"sparse feature {i}: dataset id {hi} >= configured vocab "
+                f"{v} (--arch-embedding-size mismatch)")
+        out: Dict[str, np.ndarray] = {
+            "dense_input": np.asarray(raw["X_int"], dtype=np.float32),
+            "label": np.asarray(raw["y"], dtype=np.float32).reshape(-1, 1),
+        }
+        if self._uniform:
+            out["sparse_input"] = cat.astype(np.int32)
+        else:
+            for i in range(len(self._vocabs)):
+                out[f"sparse_{i}"] = cat[:, i : i + 1].astype(np.int32)
+        return out
+
+    def close(self):
+        self._h5.close()
 
 
 def make_dlrm_arrays(

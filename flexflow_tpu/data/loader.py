@@ -13,8 +13,10 @@ consumer op's sharding, so each chip receives only its shard over PCIe
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
@@ -153,7 +155,17 @@ class PrefetchLoader:
             raise item
         return item
 
-    def close(self) -> None:
+    def queue_depths(self) -> Dict[str, int]:
+        """Staged-batch gauge for the input_wait telemetry event; folds
+        in the source's own depths (e.g. a StreamingLoader's reader
+        queue) so both edges of the pipeline are visible."""
+        depths = {"h2d": self._q.qsize()}
+        nested = getattr(self._source, "queue_depths", None)
+        if callable(nested):
+            depths.update(nested())
+        return depths
+
+    def close(self, join_timeout_s: float = 5.0) -> None:
         self._stop.set()
         self._terminal = self._terminal or StopIteration()
         # Unblock a worker stuck on a full queue.
@@ -162,6 +174,51 @@ class PrefetchLoader:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        # Join with a bounded timeout so a closed loader can't leave a
+        # _place H2D in flight during interpreter teardown.  One more
+        # drain after the worker's final put (it may have been blocked
+        # on a full queue again between our drain and its stop check).
+        deadline = time.monotonic() + join_timeout_s
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._thread.join(timeout=min(remaining, 0.1))
+
+
+class DeviceMemoryError(RuntimeError):
+    """Staging the dataset would not fit per-device memory.
+
+    Raised by ``DeviceResidentLoader`` BEFORE any ``device_put`` (an
+    up-front estimate, not a mid-staging OOM), with the two escape
+    hatches named: the host loader path (drop ``--zc-dataset``) or the
+    streaming tier (``--stream-dataset``, DATA.md)."""
+
+
+def _device_bytes_limit() -> Optional[int]:
+    """Per-device memory budget for the zc staging estimate.
+
+    ``FF_DEVICE_MEM_BYTES`` overrides (tests, relay quirks); otherwise
+    the device's own ``memory_stats()['bytes_limit']`` when the backend
+    reports one (CPU backends report none -> check is inert)."""
+    env = os.environ.get("FF_DEVICE_MEM_BYTES")
+    if env:
+        return int(env)
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
 
 
 class DeviceResidentLoader(ArrayDataLoader):
@@ -198,6 +255,19 @@ class DeviceResidentLoader(ArrayDataLoader):
             )
         self._ex = executor
         self._rep = executor.plan.replicated()
+        # Up-front staging estimate: the dataset is REPLICATED, so every
+        # device holds all of it.  Refuse with a named error before the
+        # first device_put rather than OOMing mid-staging.
+        staged = sum(int(np.asarray(v).nbytes) for v in arrays.values())
+        limit = _device_bytes_limit()
+        if limit is not None and staged > limit:
+            raise DeviceMemoryError(
+                f"--zc-dataset would stage {staged / 1e9:.2f} GB "
+                f"replicated per device, over the {limit / 1e9:.2f} GB "
+                f"per-device budget.  Use the host loader path (drop "
+                f"--zc-dataset) or the streaming tier (--stream-dataset "
+                f"with --shuffle-window, DATA.md)."
+            )
         #: the staged (replicated) dataset — one H2D per array, total.
         self.device_arrays = {
             k: jax.device_put(v, self._rep) for k, v in arrays.items()

@@ -1,0 +1,103 @@
+"""Production-trace generator for the streaming DLRM stress test.
+
+Real recommendation traffic differs from the uniform synthetic arrays
+in two ways that matter to the data plane (DATA.md):
+
+- **Embedding-id skew**: lookups follow a power law -- a few hot ids
+  dominate -- so gather locality and cache behavior diverge from
+  uniform draws.  ``ProductionTraceSource`` draws ids from a bounded
+  Zipf(alpha) per table (rejection-free: unbounded Zipf draws clamped
+  into the vocab, which preserves the head of the distribution).
+- **Bursty arrival**: input availability stalls in bursts (upstream
+  feature joins, log shipping).  ``burst_every``/``burst_s`` stall
+  every Nth chunk read, turning the run input-bound on a schedule --
+  the reproducible trigger for the ``input_wait`` starvation telemetry.
+
+Generation is block-deterministic exactly like ``SyntheticStreamSource``
+(block ``b`` seeds ``default_rng([seed, b])``), so reads reproduce at
+any chunk boundary and the checkpoint-restore replay contract holds.
+Wired into ``apps/dlrm.py`` as ``--prod-trace`` (``--trace DIR`` was
+already taken by the XProf flag) with ``--trace-alpha``/``--trace-burst``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from flexflow_tpu.data.stream import StreamSource
+
+__all__ = ["ProductionTraceSource"]
+
+
+class ProductionTraceSource(StreamSource):
+    """DLRM-shaped rows with power-law ids and bursty read pacing.
+
+    Emits ``dense_input`` (float32, ``(rows, dense_dim)``), ``label``
+    (float32, ``(rows, 1)``, Bernoulli ~ctr) and ``sparse_input``
+    (int32, ``(rows, num_tables)``) for uniform vocabs, matching
+    ``make_dlrm_arrays``'s key layout; per-table vocabs come from
+    ``vocab_sizes``.
+    """
+
+    def __init__(self, num_samples: int, dense_dim: int,
+                 vocab_sizes: List[int], alpha: float = 1.2,
+                 seed: int = 0, ctr: float = 0.25,
+                 burst_every: int = 0, burst_s: float = 0.0,
+                 block: int = 4096):
+        if alpha <= 1.0:
+            raise ValueError(f"zipf alpha must be > 1.0, got {alpha}")
+        self.num_samples = int(num_samples)
+        self.dense_dim = int(dense_dim)
+        self.vocab_sizes = [int(v) for v in vocab_sizes]
+        self.alpha = float(alpha)
+        self.seed = int(seed)
+        self.ctr = float(ctr)
+        self.burst_every = int(burst_every)
+        self.burst_s = float(burst_s)
+        self.block = int(block)
+        self._reads = 0
+
+    def specs(self):
+        return {
+            "dense_input": ((self.dense_dim,), np.dtype(np.float32)),
+            "label": ((1,), np.dtype(np.float32)),
+            "sparse_input": ((len(self.vocab_sizes),), np.dtype(np.int32)),
+        }
+
+    def _gen_block(self, b: int) -> Dict[str, np.ndarray]:
+        lo = b * self.block
+        rows = min(self.block, self.num_samples - lo)
+        rng = np.random.default_rng([self.seed, b])
+        dense = rng.standard_normal((rows, self.dense_dim)).astype(np.float32)
+        label = (rng.random((rows, 1)) < self.ctr).astype(np.float32)
+        cols = []
+        for t, vocab in enumerate(self.vocab_sizes):
+            # Bounded Zipf: clamp the unbounded draw into [0, vocab);
+            # the head (hot ids) is exact, the clamped tail collapses
+            # onto the last id -- fine for a load-skew stress test.
+            ids = np.minimum(rng.zipf(self.alpha, size=rows), vocab) - 1
+            cols.append(ids.astype(np.int32))
+        sparse = np.stack(cols, axis=1)
+        return {"dense_input": dense, "label": label, "sparse_input": sparse}
+
+    def read(self, start: int, stop: int) -> Dict[str, np.ndarray]:
+        self._reads += 1
+        if self.burst_every > 0 and self.burst_s > 0 \
+                and self._reads % self.burst_every == 0:
+            time.sleep(self.burst_s)
+        stop = min(stop, self.num_samples)
+        parts: Dict[str, List[np.ndarray]] = {
+            k: [] for k in ("dense_input", "label", "sparse_input")}
+        b = start // self.block
+        while b * self.block < stop:
+            blk = self._gen_block(b)
+            lo = max(start - b * self.block, 0)
+            hi = min(stop - b * self.block, self.block)
+            for k, v in blk.items():
+                parts[k].append(v[lo:hi])
+            b += 1
+        return {k: (p[0] if len(p) == 1 else np.concatenate(p))
+                for k, p in parts.items()}

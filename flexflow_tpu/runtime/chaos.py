@@ -101,20 +101,31 @@ def fit_once(
     iters: int = ITERS,
     save_every: int = SAVE_EVERY,
     factory: Optional[Callable[[], Callable]] = None,
+    loader_factory: Optional[Callable[[], object]] = None,
 ) -> Dict:
-    """One ResilientTrainer run against ``ck_dir`` (async saves on)."""
-    with CheckpointManager(ck_dir, async_save=True) as ck:
-        rt = ResilientTrainer(
-            (factory or tiny_factory)(), ck,
-            policy=FailurePolicy(max_restarts=3),
-            fault_injector=injector,
-        )
-        return rt.fit(
-            iterations=iters,
-            batch_fn=chaos_batch_fn,
-            save_every=save_every,
-            steps_per_call=k,
-        )
+    """One ResilientTrainer run against ``ck_dir`` (async saves on).
+
+    ``loader_factory`` switches the run onto the streaming data plane:
+    batches come from ``next(loader)`` and checkpoints carry the
+    loader cursor (the ``loader_fault`` scenario's substrate)."""
+    loader = loader_factory() if loader_factory is not None else None
+    try:
+        with CheckpointManager(ck_dir, async_save=True) as ck:
+            rt = ResilientTrainer(
+                (factory or tiny_factory)(), ck,
+                policy=FailurePolicy(max_restarts=3),
+                fault_injector=injector,
+            )
+            return rt.fit(
+                iterations=iters,
+                batch_fn=None if loader is not None else chaos_batch_fn,
+                save_every=save_every,
+                steps_per_call=k,
+                loader=loader,
+            )
+    finally:
+        if loader is not None:
+            loader.close()
 
 
 def trajectory(losses: Dict[int, float], iters: int) -> np.ndarray:
@@ -284,6 +295,63 @@ def scenario_pipeline_superstep_nan(root: str) -> Tuple[bool, str]:
     )
 
 
+class _FaultingSource:
+    """StreamSource wrapper: one OSError out of the reader thread at
+    the ``fail_on``-th raw read; every other read delegates to the
+    (deterministic) inner source, so replayed reads are bit-identical."""
+
+    def __init__(self, source, fail_on: int):
+        self.source, self.fail_on, self.reads = source, fail_on, 0
+        self.num_samples = source.num_samples
+
+    def specs(self):
+        return self.source.specs()
+
+    def read(self, start: int, stop: int):
+        self.reads += 1
+        if self.reads == self.fail_on:
+            raise OSError(f"injected disk fault at read {self.reads}")
+        return self.source.read(start, stop)
+
+    def close(self):
+        self.source.close()
+
+
+def scenario_loader_fault(root: str) -> Tuple[bool, str]:
+    """A disk fault inside the streaming data plane: the reader
+    thread's second raw read raises OSError, which surfaces at the
+    step-8 ``next(loader)`` (the epoch-1 window admit) as a
+    recoverable fault.  Recovery restores the step-8 checkpoint PLUS
+    its ``loader`` item, rewinds the stream with ``load_state_dict``
+    (fresh reader thread, replayed raw reads), and the recovered
+    trajectory is bit-identical to an unfaulted streaming run."""
+    from flexflow_tpu.data.stream import ArrayStreamSource, StreamingLoader
+
+    rng = np.random.default_rng(0)
+    arrays = {
+        "x": rng.standard_normal((64, 16)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(64,)).astype(np.int32),
+    }
+
+    def make_loader(fail_on: int = 0):
+        src: object = ArrayStreamSource(arrays)
+        if fail_on:
+            src = _FaultingSource(src, fail_on)
+        return StreamingLoader(src, batch_size=8, shuffle=True, seed=3)
+
+    base = fit_once(os.path.join(root, "loader_base"),
+                    loader_factory=make_loader)
+    if base["restarts"] != 0:
+        return False, "loader_fault: unfaulted streaming run restarted"
+    out = fit_once(os.path.join(root, "loader_fault"),
+                   loader_factory=lambda: make_loader(fail_on=2))
+    if out["restarts"] != 1:
+        return False, (f"loader_fault: expected 1 restart, "
+                       f"got {out['restarts']}")
+    return _compare("loader_fault", trajectory(base["losses"], ITERS),
+                    trajectory(out["losses"], ITERS), out)
+
+
 def _serving_setup():
     """Tiny transformer LM serving stack shared by the baseline and
     faulted runs of the serving chaos scenario (one instance = shared
@@ -357,6 +425,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "corrupt_checkpoint": scenario_corrupt_checkpoint,
     "force_save_kill": scenario_force_save_kill,
     "pipeline_superstep_nan": scenario_pipeline_superstep_nan,
+    "loader_fault": scenario_loader_fault,
     "serving_decode_fault": scenario_serving_decode_fault,
 }
 

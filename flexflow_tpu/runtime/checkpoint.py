@@ -145,22 +145,29 @@ class CheckpointManager:
 
     # -- write -------------------------------------------------------------
 
-    def _items(self, params, opt_state, state) -> Dict[str, Any]:
+    def _items(self, params, opt_state, state, loader=None) -> Dict[str, Any]:
         """Empty subtrees (momentum-less opt_state, stateless models)
         are simply omitted — orbax rejects empty items — and
         reconstituted from the restore TEMPLATES (a leafless structure
         carries no data, so the template IS the snapshot; returning
         ``{}`` instead would lose container structure like the
-        pipeline's per-stage ``{si: {}}`` state dicts)."""
+        pipeline's per-stage ``{si: {}}`` state dicts).  ``loader`` is
+        the OPTIONAL streaming-loader cursor item
+        (``StreamingLoader.state_dict()``, fixed-shape numpy) — absent
+        on non-streaming runs, so old checkpoints and new ones stay
+        mutually restorable."""
         ocp = _ocp()
         items: Dict[str, Any] = {"params": ocp.args.StandardSave(params)}
         if opt_state is not None and jax.tree.leaves(opt_state):
             items["opt_state"] = ocp.args.StandardSave(opt_state)
         if state and jax.tree.leaves(state):
             items["state"] = ocp.args.StandardSave(state)
+        if loader and jax.tree.leaves(loader):
+            items["loader"] = ocp.args.StandardSave(loader)
         return items
 
-    def save(self, step: int, params, opt_state, state, force: bool = False) -> bool:
+    def save(self, step: int, params, opt_state, state, force: bool = False,
+             loader=None) -> bool:
         """Persist one training snapshot.  ``force`` bypasses orbax's
         save-interval gating and — when the step already exists —
         replaces the stale snapshot crash-safely (a run resumed from an
@@ -171,7 +178,7 @@ class CheckpointManager:
         I/O seconds (async saves return after the copy-out, so ``io_s``
         is what the train loop actually paid, not the disk write)."""
         t0 = time.perf_counter()
-        saved = self._save(step, params, opt_state, state, force)
+        saved = self._save(step, params, opt_state, state, force, loader)
         _telemetry.current().emit(
             "ckpt_save", step=int(step),
             io_s=round(time.perf_counter() - t0, 6),
@@ -180,9 +187,10 @@ class CheckpointManager:
         )
         return saved
 
-    def _save(self, step: int, params, opt_state, state, force: bool) -> bool:
+    def _save(self, step: int, params, opt_state, state, force: bool,
+              loader=None) -> bool:
         ocp = _ocp()
-        items = self._items(params, opt_state, state)
+        items = self._items(params, opt_state, state, loader)
         if step in self._mgr.all_steps():
             try:
                 torn = "params" not in set(self._mgr.item_metadata(step).keys())
@@ -283,13 +291,20 @@ class CheckpointManager:
         self,
         templates: Tuple[Any, Any, Any],
         step: Optional[int] = None,
-    ) -> Tuple[int, Any, Any, Any]:
+        loader_template: Optional[Any] = None,
+    ):
         """Restore ``(step, params, opt_state, state)``.
 
         ``templates`` is a fresh ``Executor.init()`` result: restored
         arrays adopt the templates' shapes/dtypes/shardings, which is
         what makes restore work across a *different* mesh or strategy
         than the one that saved (orbax reshards on load).
+
+        With ``loader_template`` (``stream.loader_state_template()``)
+        the return grows a fifth element: the snapshot's streaming-
+        loader cursor, or ``None`` when the step carries no loader item
+        (a non-streaming or pre-streaming checkpoint — the train→serve
+        and old-checkpoint handoffs stay intact).
 
         With ``step=None`` (latest), a torn or unreadable step
         directory is skipped with a warning and the previous step is
@@ -301,21 +316,22 @@ class CheckpointManager:
         success and ``ckpt_torn`` for every skipped unreadable step.
         """
         t0 = time.perf_counter()
-        out = self._restore(templates, step)
+        out = self._restore(templates, step, loader_template)
         _telemetry.current().emit(
             "ckpt_restore", step=int(out[0]),
             io_s=round(time.perf_counter() - t0, 6),
         )
-        return out
+        return out if loader_template is not None else out[:4]
 
     def _restore(
         self,
         templates: Tuple[Any, Any, Any],
         step: Optional[int] = None,
-    ) -> Tuple[int, Any, Any, Any]:
+        loader_template: Optional[Any] = None,
+    ) -> Tuple[int, Any, Any, Any, Any]:
         self.wait_until_finished()  # async saves must be durable & visible
         if step is not None:
-            return self._restore_step(step, templates)
+            return self._restore_step(step, templates, loader_template)
         steps = sorted(self._mgr.all_steps(), reverse=True)
         if not steps:
             raise FileNotFoundError(
@@ -324,7 +340,7 @@ class CheckpointManager:
         last_err: Optional[Exception] = None
         for s in steps:
             try:
-                return self._restore_step(s, templates)
+                return self._restore_step(s, templates, loader_template)
             # Deliberately narrow: only torn/missing-file errors mean
             # "try an older step".  A ValueError here is a template
             # mismatch (changed model, wrong shapes) — a programmer
@@ -349,8 +365,9 @@ class CheckpointManager:
         ) from last_err
 
     def _restore_step(
-        self, step: int, templates: Tuple[Any, Any, Any]
-    ) -> Tuple[int, Any, Any, Any]:
+        self, step: int, templates: Tuple[Any, Any, Any],
+        loader_template: Optional[Any] = None,
+    ) -> Tuple[int, Any, Any, Any, Any]:
         ocp = _ocp()
         t_params, t_opt, t_state = templates
         # Which items this snapshot contains — through the same orbax
@@ -368,12 +385,16 @@ class CheckpointManager:
             items["opt_state"] = ocp.args.StandardRestore(t_opt)
         if "state" in present:
             items["state"] = ocp.args.StandardRestore(t_state)
+        want_loader = loader_template is not None and "loader" in present
+        if want_loader:
+            items["loader"] = ocp.args.StandardRestore(loader_template)
         restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
         # Absent items were leafless at save time: the template is the
         # exact snapshot (None stays None, {si: {}} keeps its stages).
         opt_state = restored["opt_state"] if "opt_state" in present else t_opt
         state = restored["state"] if "state" in present else t_state
-        return step, restored["params"], opt_state, state
+        loader = restored["loader"] if want_loader else None
+        return step, restored["params"], opt_state, state, loader
 
     def close(self) -> None:
         self.wait_until_finished()

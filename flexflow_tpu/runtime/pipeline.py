@@ -389,6 +389,23 @@ class PipelineExecutor:
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
+        if (getattr(self.config, "lazy_sparse_optimizer", False)
+                or getattr(self.optimizer, "lazy_sparse", False)):
+            # Loudly reject rather than silently fall back to the dense
+            # update: the row-sparse embedding path (sparse_rows /
+            # sparse_apply + scatter_add_rows) dispatches through the
+            # full-mesh executor's sparse protocol, and layer-wise
+            # strategies would need the gathered rows + lazy momentum
+            # carried per-stage over each stage's own submesh.
+            raise PlacementError(
+                "--lazy-sparse-opt supports the full-mesh Executor only: "
+                "row-sparse updates are per-op over the op's full-mesh "
+                "placement, and layer-wise strategies would need the "
+                "sparse protocol carried PER-STAGE (each stage's tables "
+                "and lazy momentum on that stage's own devices) — not "
+                "implemented (open ROADMAP item); drop the flag to take "
+                "the dense update path on pipeline strategies"
+            )
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps

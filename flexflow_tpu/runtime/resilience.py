@@ -288,20 +288,52 @@ class ResilientTrainer:
 
     # -- internals ---------------------------------------------------------
 
-    def _fresh_state(self, ex: Executor, seed: int):
+    def _fresh_state(self, ex: Executor, seed: int, loader=None,
+                     initial: bool = False):
         params, opt_state, state = ex.init(seed=seed)
         try:
-            step, params, opt_state_r, state_r = self.checkpoint.restore(
-                templates=(params, opt_state, state)
-            )
+            if loader is not None:
+                from flexflow_tpu.data.stream import loader_state_template
+
+                step, params, opt_state_r, state_r, ls = (
+                    self.checkpoint.restore(
+                        templates=(params, opt_state, state),
+                        loader_template=loader_state_template(),
+                    )
+                )
+                if ls is not None:
+                    # Rewind the streaming loader to the snapshot's
+                    # cursor: replayed steps re-pull the exact batches
+                    # (deterministic replay through the data plane).
+                    loader.load_state_dict(ls)
+                else:
+                    logger.warning(
+                        "checkpoint step %d carries no loader item "
+                        "(pre-streaming snapshot); rewinding the "
+                        "streaming loader to its start — replayed "
+                        "batches may differ from the original run", step,
+                    )
+                    loader.load_state_dict(self._loader_origin)
+            else:
+                step, params, opt_state_r, state_r = self.checkpoint.restore(
+                    templates=(params, opt_state, state)
+                )
             logger.info("resumed from checkpoint step %d", step)
             return step, params, (
                 opt_state_r if opt_state_r is not None else opt_state
             ), (state_r or state)
         except FileNotFoundError:
+            if loader is not None and not initial:
+                # No snapshot yet: recovery replays from step 0, so the
+                # loader rewinds to its construction-time cursor.  The
+                # INITIAL call skips this — the loader is already there,
+                # and rewinding would pointlessly tear down its reader
+                # thread (discarding prefetched windows).
+                loader.load_state_dict(self._loader_origin)
             return 0, params, opt_state, state
 
-    def _recover(self, ex: Optional[Executor], seed: int, why: BaseException):
+    def _recover(self, ex: Optional[Executor], seed: int, why: BaseException,
+                 loader=None):
         self.restarts += 1
         self.total_restarts += 1
         if self.restarts > self.policy.max_restarts:
@@ -324,7 +356,7 @@ class ResilientTrainer:
         # runtime faults get a fresh executor (new mesh/jit) instead.
         if ex is None or not isinstance(why, StepFailure):
             ex = self.executor_factory()
-        step, params, opt_state, state = self._fresh_state(ex, seed)
+        step, params, opt_state, state = self._fresh_state(ex, seed, loader)
         _telemetry.current().emit("replay", from_step=int(step))
         return ex, step, params, opt_state, state
 
@@ -333,11 +365,12 @@ class ResilientTrainer:
     def fit(
         self,
         iterations: int,
-        batch_fn: Callable[[int], Dict[str, Any]],
+        batch_fn: Optional[Callable[[int], Dict[str, Any]]] = None,
         save_every: int = 10,
         seed: int = 0,
         steps_per_call: int = 1,
         check_every: Optional[int] = None,
+        loader=None,
     ) -> Dict[str, Any]:
         """Run ``iterations`` steps with detection + recovery.
 
@@ -345,6 +378,17 @@ class ResilientTrainer:
         steps after a rollback see the same data (deterministic resume,
         which the reference cannot do at all) — the recovered loss
         trajectory is bit-identical to an unfaulted run's.
+
+        ``loader`` (instead of ``batch_fn``) drives the run from a
+        ``StreamingLoader``: each step pulls ``next(loader)``, every
+        checkpoint carries the loader cursor+rng as a ``loader`` item,
+        and a rollback rewinds the loader with ``load_state_dict``
+        before replaying — so the replayed steps re-pull bit-identical
+        batches straight from the out-of-core source (the reader
+        thread's raw reads are deterministic; see DATA.md).  The
+        loader is driven directly, NOT through a ``PrefetchLoader``
+        (disk overlap still comes from its reader thread): the
+        consumer-side cursor then matches the step count exactly.
 
         ``steps_per_call=k > 1`` fuses K steps into one compiled
         superstep dispatch (``Executor.build_superstep``): the stacked
@@ -373,22 +417,34 @@ class ResilientTrainer:
         ``ResilientTrainer(...).fit()`` gets the same JSONL stream as
         an app-routed one.
         """
+        if batch_fn is None and loader is None:
+            raise ValueError("ResilientTrainer.fit needs batch_fn or loader")
+        if batch_fn is not None and loader is not None:
+            raise ValueError(
+                "ResilientTrainer.fit takes batch_fn OR loader, not both"
+            )
         ex = self.executor_factory()
         with _telemetry.maybe_run(getattr(ex, "config", None)):
             return self._fit(ex, iterations, batch_fn, save_every, seed,
-                             steps_per_call, check_every)
+                             steps_per_call, check_every, loader)
 
     def _fit(
         self,
         ex,
         iterations: int,
-        batch_fn: Callable[[int], Dict[str, Any]],
+        batch_fn: Optional[Callable[[int], Dict[str, Any]]],
         save_every: int,
         seed: int,
         steps_per_call: int,
         check_every: Optional[int],
+        loader=None,
     ) -> Dict[str, Any]:
         injector = FaultInjector.wrap(self.fault_injector)
+        # Rewind target for recoveries that land before the first save
+        # (and for pre-streaming checkpoints without a loader item).
+        self._loader_origin = (
+            loader.state_dict() if loader is not None else None
+        )
         k = relay_safe_steps(steps_per_call, log=logger)
         # The k=1 fence period is the same relay hazard as the
         # superstep length (an unfenced dependent dispatch chain):
@@ -409,7 +465,9 @@ class ResilientTrainer:
                 "--pipeline-compiled); host-driven layer-wise "
                 "strategies compose with resilience at steps_per_call=1"
             )
-        step, params, opt_state, state = self._fresh_state(ex, seed)
+        step, params, opt_state, state = self._fresh_state(
+            ex, seed, loader, initial=True
+        )
         if step >= iterations:
             # A restarted job whose checkpoint already reached the
             # target (e.g. preempted on the final step): nothing to
@@ -441,8 +499,10 @@ class ResilientTrainer:
                 try:
                     if k == 1:
                         injector.before_step(step)
+                        raw = (next(loader) if loader is not None
+                               else batch_fn(step))
                         batch = ex.shard_batch(
-                            injector.poison_batch(step, batch_fn(step))
+                            injector.poison_batch(step, raw)
                         )
                         params, opt_state, state, metrics = ex.train_step(
                             params, opt_state, state, batch
@@ -456,7 +516,9 @@ class ResilientTrainer:
                             validate_pending()
                             if at_save:
                                 self.checkpoint.save(
-                                    step, params, opt_state, state
+                                    step, params, opt_state, state,
+                                    loader=(loader.state_dict()
+                                            if loader is not None else None),
                                 )
                                 injector.after_save(step, self.checkpoint)
                                 # Durable forward progress: the budget
@@ -468,9 +530,9 @@ class ResilientTrainer:
                         group = []
                         for i in range(n):
                             injector.before_step(step + i)
-                            group.append(
-                                injector.poison_batch(step + i, batch_fn(step + i))
-                            )
+                            raw = (next(loader) if loader is not None
+                                   else batch_fn(step + i))
+                            group.append(injector.poison_batch(step + i, raw))
                         fn = sstep_fns.get(n)
                         if fn is None:
                             fn = sstep_fns[n] = ex.build_superstep(n)
@@ -498,7 +560,11 @@ class ResilientTrainer:
                         if save_every and step // save_every > prev // save_every:
                             # Superstep granularity: save at the first
                             # boundary past each save_every multiple.
-                            self.checkpoint.save(step, params, opt_state, state)
+                            self.checkpoint.save(
+                                step, params, opt_state, state,
+                                loader=(loader.state_dict()
+                                        if loader is not None else None),
+                            )
                             injector.after_save(step, self.checkpoint)
                             self.restarts = 0
                     if trig:
@@ -514,7 +580,7 @@ class ResilientTrainer:
                 except self.policy.recoverable as e:  # noqa: PERF203
                     pending = []
                     new_ex, step, params, opt_state, state = self._recover(
-                        ex, seed, e
+                        ex, seed, e, loader
                     )
                     if new_ex is not ex:
                         ex, sstep_fns = new_ex, {}  # stale jits died with it
@@ -524,7 +590,11 @@ class ResilientTrainer:
         # save-interval gating (force-replace is crash-safe now).  The
         # flush fence makes it durable before the process exits.
         if step not in self.checkpoint.all_steps():
-            self.checkpoint.save(step, params, opt_state, state, force=True)
+            self.checkpoint.save(
+                step, params, opt_state, state, force=True,
+                loader=(loader.state_dict()
+                        if loader is not None else None),
+            )
         self.checkpoint.wait_until_finished()
         self.executor = ex
         return _telemetry.current().fold_stats({

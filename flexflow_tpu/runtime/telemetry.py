@@ -74,7 +74,7 @@ HEARTBEAT_FILE_EVERY_S = 1.0
 #: High-rate event types that may buffer; everything else flushes
 #: immediately (fences, checkpoints, faults, rollbacks, stalls are
 #: exactly the events a postmortem cannot afford to lose).
-_BUFFERED_EVENTS = frozenset({"step"})
+_BUFFERED_EVENTS = frozenset({"step", "input_wait"})
 
 #: Fence labels excluded from fence_ms calibration fitting: ``warmup``
 #: fences include the first-call compile, ``final`` drains the whole
@@ -106,6 +106,9 @@ class _NullTelemetry:
         pass
 
     def record_step(self, step, loss=None, wall_s=None, **fields) -> None:
+        pass
+
+    def record_input_wait(self, step, wall_s, **depths) -> None:
         pass
 
     def add_programs(self, n: int, steps: int = 1) -> None:
@@ -235,6 +238,12 @@ class Telemetry:
         #: (superstep) they include device execution.  Either way they
         #: are measured host-side and add no ``device_get``.
         self.step_times: List[float] = []
+        #: Per-step input-starvation waits (s): time the training loop
+        #: blocked on ``next(batches)`` in steady state (warmup pulls
+        #: excluded).  Feeds the input_wait percentiles in
+        #: :meth:`step_summary`; populated ONLY by instrumented batch
+        #: pulls, so synthetic fixed-batch runs carry no block at all.
+        self.input_waits: List[float] = []
         #: (label, wall_s) of every fence — the calibration feed for
         #: the execution autotuner's fence_ms constant (the MINIMUM
         #: non-warmup/final fence is the round-trip floor estimate;
@@ -346,6 +355,22 @@ class Telemetry:
                     self._last_flush = now
             self._last_label = "step"
         self.heartbeat(f"step:{step}")
+
+    def record_input_wait(self, step, wall_s, **depths) -> None:
+        """Input starvation: the wall time one steady-state
+        ``next(batches)`` blocked the training loop, plus queue-depth
+        gauges at the moment of the pull (``h2d`` = staged device
+        batches in the PrefetchLoader, ``reader`` = raw windows in the
+        StreamingLoader's queue — both edges of the pipeline, DATA.md).
+        High-rate and host-side only: buffers like ``step`` events,
+        never fences.  A starving run reads as rising input_wait with
+        both gauges pinned at 0."""
+        # The accumulator stores the SAME rounded value the event
+        # carries, so the summary's input_wait_s_total reconciles with
+        # the event stream exactly (the accounting audit).
+        w = round(float(wall_s), 6)
+        self.input_waits.append(w)
+        self.emit("input_wait", step=int(step), wall_s=w, **depths)
 
     def fence(self, value, label: str = "fence"):
         """Host-readback fence: heartbeats on both edges (so the
@@ -482,6 +507,19 @@ class Telemetry:
             out["step_ms_p50"] = round(pct(0.50) * 1e3, 3)
             out["step_ms_p95"] = round(pct(0.95) * 1e3, 3)
             out["step_ms_max"] = round(ts[-1] * 1e3, 3)
+        if self.input_waits:
+            ws = sorted(self.input_waits)
+
+            def wpct(p: float) -> float:
+                return ws[min(len(ws) - 1, int(round(p * (len(ws) - 1))))]
+
+            # input_wait_s_total is the accounting hook: it must equal
+            # the sum of the run's input_wait event wall_s exactly
+            # (audited like programs/step, tests/test_data_stream.py).
+            out["input_wait_ms_p50"] = round(wpct(0.50) * 1e3, 3)
+            out["input_wait_ms_p95"] = round(wpct(0.95) * 1e3, 3)
+            out["input_waits"] = len(ws)
+            out["input_wait_s_total"] = round(sum(ws), 6)
         return out
 
     def fold_stats(self, stats: Dict[str, Any]) -> Dict[str, Any]:

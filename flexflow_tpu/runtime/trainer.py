@@ -210,6 +210,9 @@ class Trainer:
         batches, owned_prefetch = self._batch_source(
             batches, warmup + iterations, prefetch
         )
+        # Input-starvation gauges (PrefetchLoader h2d queue + any nested
+        # StreamingLoader reader queue); None for synthetic/sync paths.
+        depth_fn = getattr(batches, "queue_depths", None)
 
         # Preemption (SIGTERM/SIGINT) with a checkpoint attached: finish
         # the in-flight step, save at the boundary, exit cleanly so a
@@ -250,7 +253,18 @@ class Trainer:
                 start = time.perf_counter()
                 t_prev = start
                 for it in range(iterations):
-                    batch = next(batches)
+                    if tel.enabled:
+                        # Steady-state input wait: how long this pull
+                        # blocked the loop (0 when the prefetch queue
+                        # had a batch staged).  Host-side timing only —
+                        # no fence, zero cost when telemetry is off.
+                        t_in = time.perf_counter()
+                        batch = next(batches)
+                        tel.record_input_wait(
+                            start_step + it, time.perf_counter() - t_in,
+                            **(depth_fn() if depth_fn else {}))
+                    else:
+                        batch = next(batches)
                     # StepTraceAnnotation: XProf device timelines group
                     # by train step, so --trace captures correlate with
                     # the telemetry JSONL's step events (no-op unless a
@@ -409,6 +423,9 @@ class Trainer:
         from flexflow_tpu.data.loader import PrefetchLoader
 
         owned_prefetch = None
+        # Captured before the grouping wrappers below hide the source;
+        # an owned loader overrides it further down.
+        depth_fn = getattr(batches, "queue_depths", None)
         if batches is None:
             host = self._synthetic_host_batch()
             fixed: Dict[int, Any] = {}
@@ -451,6 +468,7 @@ class Trainer:
             elif prefetch > 0:
                 owned_prefetch = PrefetchLoader(groups(), place, depth=prefetch)
                 batches = iter(owned_prefetch)
+                depth_fn = owned_prefetch.queue_depths
             else:
                 batches = (place(g) for g in groups())
 
@@ -485,7 +503,14 @@ class Trainer:
                     if n not in step_fns:
                         step_fns[n] = ex.build_superstep(n, accum_steps)
                     t_call = time.perf_counter()
-                    superbatch = next(batches)
+                    if tel.enabled:
+                        superbatch = next(batches)
+                        tel.record_input_wait(
+                            start_step + steps_done,
+                            time.perf_counter() - t_call,
+                            **(depth_fn() if depth_fn else {}))
+                    else:
+                        superbatch = next(batches)
                     with StepTraceAnnotation("superstep",
                                              step_num=start_step + steps_done):
                         params, opt_state, state, ms = step_fns[n](
@@ -652,6 +677,7 @@ class Trainer:
         batches, owned_prefetch = self._batch_source(
             batches, warmup + iterations, prefetch
         )
+        depth_fn = getattr(batches, "queue_depths", None)
 
         from flexflow_tpu.runtime.resilience import PreemptionHandler
 
@@ -684,7 +710,14 @@ class Trainer:
                     walls = []
                     for i in range(n):
                         t_disp = time.perf_counter()
-                        batch = next(batches)
+                        if tel.enabled:
+                            batch = next(batches)
+                            tel.record_input_wait(
+                                start_step + steps_done + i,
+                                time.perf_counter() - t_disp,
+                                **(depth_fn() if depth_fn else {}))
+                        else:
+                            batch = next(batches)
                         with StepTraceAnnotation(
                             "train", step_num=start_step + steps_done + i
                         ):

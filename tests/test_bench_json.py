@@ -100,6 +100,17 @@ def stubbed_bench(monkeypatch):
         }),
     )
     monkeypatch.setattr(
+        bench, "bench_data_plane",
+        lambda n, t: chatty({
+            "array_samples_per_s": 1000.0, "zc_samples_per_s": 1200.0,
+            "stream_samples_per_s": 1100.0, "stream_vs_zc": 0.917,
+            "input_wait_ms_p50": 0.05, "input_wait_ms_p95": 0.4,
+            "throttled_stream_samples_per_s": 900.0,
+            "throttled_unprefetched_samples_per_s": 450.0,
+            "throttled_overlap_speedup": 2.0,
+        }),
+    )
+    monkeypatch.setattr(
         bench, "bench_op_parallel_speedup",
         lambda n: {"op_parallel_speedup_sim": 1.5},
     )
@@ -163,6 +174,19 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert search["predicted_ms_per_step"] == 1.1
     assert search["search_wall_s"] == 0.5
     assert search["calibrated"] is True
+    # The streaming data-plane leg (DATA.md): per-tier samples/s,
+    # input-starvation percentiles, and the throttled-source overlap
+    # A/B (reader thread + prefetch hiding disk latency).
+    dp = record["extra"]["data_plane"]
+    assert dp["array_samples_per_s"] == 1000.0
+    assert dp["zc_samples_per_s"] == 1200.0
+    assert dp["stream_samples_per_s"] == 1100.0
+    assert dp["stream_vs_zc"] == 0.917
+    assert dp["input_wait_ms_p50"] == 0.05
+    assert dp["input_wait_ms_p95"] == 0.4
+    assert dp["throttled_stream_samples_per_s"] == 900.0
+    assert dp["throttled_unprefetched_samples_per_s"] == 450.0
+    assert dp["throttled_overlap_speedup"] == 2.0
     # The chatter landed on stderr, not stdout.
     assert "tp = " in err.getvalue()
 
@@ -178,6 +202,7 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     monkeypatch.setattr(stubbed_bench, "bench_telemetry", boom)
     monkeypatch.setattr(stubbed_bench, "bench_serving", boom)
     monkeypatch.setattr(stubbed_bench, "bench_search", boom)
+    monkeypatch.setattr(stubbed_bench, "bench_data_plane", boom)
     out, err = io.StringIO(), io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     monkeypatch.setattr(sys, "stderr", err)
@@ -191,3 +216,4 @@ def test_bench_stdout_json_even_when_legs_fail(stubbed_bench, monkeypatch):
     assert "leg exploded" in record["extra"]["telemetry_error"]
     assert "leg exploded" in record["extra"]["serving_error"]
     assert "leg exploded" in record["extra"]["search_error"]
+    assert "leg exploded" in record["extra"]["data_plane_error"]

@@ -1,0 +1,468 @@
+"""Streaming data plane tests (DATA.md): out-of-core loaders,
+determinism contracts, checkpointed cursors, starvation telemetry.
+
+The load-bearing pins:
+- StreamingLoader over the same arrays/seed with window >= dataset is
+  BIT-IDENTICAL to ArrayDataLoader, across epoch wraps (the composed
+  epoch-permutation contract).
+- Per-host shards are disjoint and covering.
+- A mid-epoch checkpoint of the loader cursor+rng restores
+  bit-identically through CheckpointManager's ``loader`` item — even
+  into a fresh loader built with a different constructor seed.
+- The chaos ``loader_fault`` scenario: a reader-thread OSError
+  surfaces at next(), ResilientTrainer rolls back, rewinds the stream,
+  and the recovered trajectory is bit-identical.
+- ``input_wait`` telemetry accounting reconciles exactly: the summary
+  total equals the sum of the emitted events' wall_s.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data.loader import (
+    ArrayDataLoader,
+    DeviceMemoryError,
+    DeviceResidentLoader,
+    PrefetchLoader,
+)
+from flexflow_tpu.data.stream import (
+    ArrayStreamSource,
+    StreamingLoader,
+    StreamReaderError,
+    SyntheticStreamSource,
+    ThrottledSource,
+    loader_state_template,
+    shard_for_host,
+)
+from flexflow_tpu.graph import FFModel
+from flexflow_tpu.optim import SGDOptimizer
+from flexflow_tpu.runtime.executor import Executor
+from flexflow_tpu.runtime.telemetry import Telemetry
+from flexflow_tpu.runtime.trainer import Trainer
+
+
+def _arrays(rows=64, width=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.standard_normal((rows, width)).astype(np.float32),
+        "label": rng.integers(0, 4, size=(rows,)).astype(np.int32),
+    }
+
+
+def _mlp_executor(batch=8, width=6):
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor((batch, width), name="x")
+    lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+    t = ff.dense(x, 16, activation="relu", name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    ff.softmax(t, lbl, name="softmax")
+    return Executor(ff, optimizer=SGDOptimizer(lr=0.1))
+
+
+# -- determinism contracts -------------------------------------------------
+
+
+def test_streaming_bit_identical_to_array_loader_across_wraps():
+    """Window >= dataset: the streaming loader IS ArrayDataLoader,
+    bit-for-bit, including the composed reshuffle at every epoch wrap
+    (3 epochs here)."""
+    arrays = _arrays()
+    ref = ArrayDataLoader(arrays, batch_size=8, shuffle=True, seed=5)
+    sl = StreamingLoader(ArrayStreamSource(arrays), 8, shuffle=True, seed=5)
+    try:
+        for i in range(24):  # 64 rows / batch 8 -> 8 steps/epoch
+            want, got = ref.next_batch(), next(sl)
+            assert sorted(want) == sorted(got)
+            for k in want:
+                np.testing.assert_array_equal(want[k], got[k], err_msg=f"batch {i} key {k}")
+    finally:
+        sl.close()
+
+
+def test_streaming_unshuffled_and_windowed_cover_every_row():
+    arrays = {"a": np.arange(40).reshape(40, 1).astype(np.float32)}
+    for window in (0, 10):
+        sl = StreamingLoader(ArrayStreamSource(arrays), 8, shuffle=True,
+                             seed=1, shuffle_window=window)
+        try:
+            seen = np.concatenate([next(sl)["a"][:, 0] for _ in range(5)])
+        finally:
+            sl.close()
+        assert sorted(seen.tolist()) == list(range(40))
+
+
+def test_windowed_shuffle_stays_within_windows():
+    """W < shard: shuffling is bounded to the window — row i can only
+    appear inside its own window's span (the out-of-core contract)."""
+    arrays = {"a": np.arange(32).reshape(32, 1).astype(np.float32)}
+    sl = StreamingLoader(ArrayStreamSource(arrays), 8, shuffle=True,
+                         seed=2, shuffle_window=8)
+    try:
+        for w in range(4):
+            batch = next(sl)["a"][:, 0]
+            assert sorted(batch.tolist()) == list(range(8 * w, 8 * w + 8))
+    finally:
+        sl.close()
+
+
+def test_shard_disjointness():
+    n = 67
+    spans = [shard_for_host(n, h, 4) for h in range(4)]
+    rows = [set(range(lo, hi)) for lo, hi in spans]
+    assert all(len(r) == 67 // 4 for r in rows)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not rows[i] & rows[j]
+    arrays = {"a": np.arange(n).reshape(n, 1).astype(np.float32)}
+    served = []
+    for h in range(4):
+        sl = StreamingLoader(ArrayStreamSource(arrays), 4, shuffle=True,
+                             seed=9, host_id=h, num_hosts=4)
+        try:
+            served.append({int(v) for _ in range(4)
+                           for v in next(sl)["a"][:, 0]})
+        finally:
+            sl.close()
+    for i in range(4):
+        assert served[i] <= rows[i]
+        for j in range(i + 1, 4):
+            assert not served[i] & served[j]
+
+
+def test_synthetic_source_chunk_invariant():
+    src = SyntheticStreamSource(
+        {"x": ((3,), np.float32), "ids": ((2,), np.int32)},
+        num_samples=50, seed=4, int_high={"ids": 10}, block=8)
+    whole = src.read(0, 50)
+    parts = [src.read(0, 13), src.read(13, 37), src.read(37, 50)]
+    for k in whole:
+        np.testing.assert_array_equal(
+            whole[k], np.concatenate([p[k] for p in parts]))
+    assert whole["ids"].max() < 10
+    assert whole["x"].dtype == np.float32
+
+
+# -- checkpointed cursor ---------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [0, 16], ids=["composed", "windowed"])
+def test_checkpoint_roundtrip_midepoch(tmp_path, window):
+    """CheckpointManager carries the loader cursor+rng as a ``loader``
+    item; restoring into a FRESH loader (different constructor seed —
+    the restored state must win) replays bit-identically mid-epoch."""
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+    arrays = _arrays()
+    sl = StreamingLoader(ArrayStreamSource(arrays), 8, shuffle=True,
+                         seed=5, shuffle_window=window)
+    params = {"w": np.zeros(2, np.float32)}
+    try:
+        for _ in range(11):  # mid-epoch-2 (8 steps/epoch)
+            next(sl)
+        with CheckpointManager(str(tmp_path)) as ck:
+            ck.save(11, params, None, {}, loader=sl.state_dict())
+        want = [next(sl) for _ in range(8)]
+    finally:
+        sl.close()
+
+    fresh = StreamingLoader(ArrayStreamSource(arrays), 8, shuffle=True,
+                            seed=777, shuffle_window=window)
+    try:
+        with CheckpointManager(str(tmp_path)) as ck:
+            step, _, _, _, ls = ck.restore(
+                templates=(params, None, {}),
+                loader_template=loader_state_template())
+        assert step == 11 and ls is not None
+        fresh.load_state_dict(ls)
+        for i, w in enumerate(want):
+            got = next(fresh)
+            for k in w:
+                np.testing.assert_array_equal(w[k], got[k],
+                                              err_msg=f"batch {i} key {k}")
+    finally:
+        fresh.close()
+
+
+def test_checkpoint_without_loader_item_restores_none(tmp_path):
+    """Pre-streaming checkpoints restore with loader=None (backward
+    compatible in both directions)."""
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+    params = {"w": np.ones(2, np.float32)}
+    with CheckpointManager(str(tmp_path)) as ck:
+        ck.save(3, params, None, {})
+        step, p, _, _, ls = ck.restore(
+            templates=(params, None, {}),
+            loader_template=loader_state_template())
+        assert step == 3 and ls is None
+        # And the 4-tuple API is untouched.
+        step4 = ck.restore(templates=(params, None, {}))
+        assert len(step4) == 4
+
+
+# -- resilience / chaos ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_loader_fault(tmp_path):
+    """The full chaos scenario: reader-thread OSError surfaces at
+    next(), ResilientTrainer restores the checkpoint + loader item,
+    rewinds the stream, and recovers bit-identically."""
+    from flexflow_tpu.runtime.chaos import run_matrix
+
+    results = run_matrix(str(tmp_path), names=["loader_fault"])
+    assert results, "loader_fault scenario missing from the matrix"
+    ok, name, detail = results[0]
+    assert ok, detail
+
+
+def test_reader_error_surfaces_at_next():
+    """Recoverable reader exceptions (OSError/RuntimeError) surface
+    as-is; anything else is wrapped in StreamReaderError."""
+
+    class Boom(ArrayStreamSource):
+        def __init__(self, arrays, exc):
+            super().__init__(arrays)
+            self._exc = exc
+
+        def read(self, start, stop):
+            raise self._exc
+
+    arrays = _arrays(rows=16)
+    sl = StreamingLoader(Boom(arrays, OSError("disk gone")), 8, seed=0)
+    with pytest.raises(OSError, match="disk gone"):
+        next(sl)
+    sl2 = StreamingLoader(Boom(arrays, KeyError("k")), 8, seed=0)
+    with pytest.raises(StreamReaderError, match="reader thread failed"):
+        next(sl2)
+
+
+# -- starvation telemetry --------------------------------------------------
+
+
+def test_input_wait_accounting_matches_events(tmp_path):
+    """The folded input-wait stats reconcile EXACTLY with the emitted
+    input_wait events: total == sum(event wall_s), count == #events,
+    and the queue-depth gauges carry both edges (reader + h2d)."""
+    arrays = _arrays(rows=96, width=6)
+    ex = _mlp_executor(batch=8, width=6)
+    throttled = ThrottledSource(ArrayStreamSource(arrays), per_row_s=2e-4)
+    sl = StreamingLoader(throttled, 8, shuffle=True, seed=1,
+                         shuffle_window=16)
+    batches = PrefetchLoader(iter(sl), ex.shard_batch)
+    with Telemetry(str(tmp_path)) as tel:
+        stats = Trainer(ex).fit(iterations=8, batches=batches, warmup=1)
+        path = tel.path
+    batches.close()
+    sl.close()
+
+    summary = stats["telemetry"]
+    assert summary["input_waits"] == 8  # steady-state steps only
+    events = []
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("ev") == "input_wait":
+                events.append(ev)
+    assert len(events) == 8
+    total = round(sum(ev["wall_s"] for ev in events), 6)
+    assert summary["input_wait_s_total"] == pytest.approx(total, abs=1e-9)
+    assert summary["input_wait_ms_p95"] >= summary["input_wait_ms_p50"] >= 0
+    assert {"h2d", "reader"} <= set(events[0])
+
+
+def test_telemetry_off_stats_unchanged():
+    """Streaming with telemetry OFF: zero added keys, zero events —
+    the off path stays the pinned 5-key stats dict."""
+    arrays = _arrays(rows=64)
+    ex = _mlp_executor()
+    sl = StreamingLoader(ArrayStreamSource(arrays), 8, shuffle=True, seed=1)
+    batches = PrefetchLoader(iter(sl), ex.shard_batch)
+    stats = Trainer(ex).fit(iterations=4, batches=batches, warmup=1)
+    batches.close()
+    sl.close()
+    assert sorted(stats) == [
+        "batch_size", "elapsed_s", "iterations", "loss", "samples_per_s"]
+
+
+def test_queue_depth_gauges_nest():
+    arrays = _arrays(rows=32)
+    ex = _mlp_executor()
+    sl = StreamingLoader(ArrayStreamSource(arrays), 8, seed=0)
+    pf = PrefetchLoader(iter(sl), ex.shard_batch)
+    try:
+        depths = pf.queue_depths()
+        assert set(depths) == {"h2d", "reader"}
+        assert all(isinstance(v, int) for v in depths.values())
+    finally:
+        pf.close()
+        sl.close()
+
+
+# -- end-to-end: DLRM trajectory ------------------------------------------
+
+
+@pytest.mark.slow
+def test_dlrm_streaming_loss_bit_identical():
+    """The acceptance pin: DLRM trained from the streaming tier
+    (window >= dataset, same seed) produces a final loss bit-identical
+    to the ArrayDataLoader path — identical batch streams + identical
+    init means identical trajectory."""
+    from flexflow_tpu.data import make_dlrm_arrays
+    from flexflow_tpu.models import DLRMConfig, build_dlrm, dlrm_strategy
+
+    cfg = DLRMConfig(sparse_feature_size=4, embedding_size=[32] * 4,
+                     mlp_bot=[8, 4], mlp_top=[4 + 4 * 4, 8, 1])
+    arrays = make_dlrm_arrays(cfg, num_samples=64)
+
+    def run(streaming):
+        ff = build_dlrm(batch_size=8, dlrm=cfg)
+        ex = Executor(ff, strategy=dlrm_strategy(8, cfg))
+        if streaming:
+            sl = StreamingLoader(ArrayStreamSource(arrays), 8,
+                                 shuffle=True, seed=5)
+            src = iter(sl)
+        else:
+            sl = None
+            src = iter(ArrayDataLoader(arrays, 8, shuffle=True, seed=5))
+        batches = PrefetchLoader(src, ex.shard_batch)
+        try:
+            return Trainer(ex).fit(iterations=12, batches=batches,
+                                   warmup=0)["loss"]
+        finally:
+            batches.close()
+            if sl is not None:
+                sl.close()
+
+    a, b = run(streaming=False), run(streaming=True)
+    assert a == b  # bit-identical, not approx
+
+
+# -- satellites ------------------------------------------------------------
+
+
+def test_criteo_chunked_reader_and_stream_source(tmp_path):
+    import h5py
+
+    from flexflow_tpu.data.criteo import (
+        CriteoStreamSource,
+        load_criteo_h5,
+        make_dlrm_arrays,
+    )
+    from flexflow_tpu.models import DLRMConfig
+
+    path = str(tmp_path / "c.h5")
+    rng = np.random.default_rng(0)
+    with h5py.File(path, "w") as f:
+        f.create_dataset("X_int",
+                         data=rng.standard_normal((20, 4)).astype(np.float32))
+        f.create_dataset("X_cat", data=rng.integers(0, 16, size=(20, 3)))
+        f.create_dataset("y",
+                         data=rng.integers(0, 2, size=20).astype(np.float32))
+
+    # Chunked load == one-shot load; max_samples stops at the cut.
+    whole = load_criteo_h5(path)
+    chunked = load_criteo_h5(path, chunk_rows=7)
+    for k in whole:
+        np.testing.assert_array_equal(whole[k], chunked[k])
+    cut = load_criteo_h5(path, max_samples=10, chunk_rows=4)
+    for k in whole:
+        np.testing.assert_array_equal(whole[k][:10], cut[k])
+
+    dlrm = DLRMConfig(sparse_feature_size=2, embedding_size=[16, 16, 16],
+                      mlp_bot=[4, 2], mlp_top=[2 + 3 * 2, 4, 1])
+    ref = make_dlrm_arrays(dlrm, num_samples=20, path=path)
+    src = CriteoStreamSource(path, dlrm)
+    assert src.num_samples == 20
+    got = src.read(5, 17)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k][5:17], got[k], err_msg=k)
+    src.close()
+
+
+def test_device_resident_loader_memory_estimate(monkeypatch):
+    arrays = _arrays(rows=64)
+    staged = sum(v.nbytes for v in arrays.values())
+    ex = _mlp_executor()
+    monkeypatch.setenv("FF_DEVICE_MEM_BYTES", str(staged // 2))
+    with pytest.raises(DeviceMemoryError, match="--stream-dataset"):
+        DeviceResidentLoader(arrays, 8, ex, shuffle=True, seed=0)
+    # A budget that fits stages normally.
+    monkeypatch.setenv("FF_DEVICE_MEM_BYTES", str(staged * 100))
+    dl = DeviceResidentLoader(arrays, 8, ex, shuffle=True, seed=0)
+    assert next(iter(dl)) is not None
+
+
+def test_prefetch_close_joins_bounded():
+    """close() returns within its bounded timeout even when the worker
+    is wedged inside a slow source read."""
+
+    def slow():
+        yield {"a": np.zeros((2, 2), np.float32)}
+        time.sleep(30)
+        yield {"a": np.zeros((2, 2), np.float32)}
+
+    pf = PrefetchLoader(slow(), lambda b: b)
+    next(pf)
+    t0 = time.monotonic()
+    pf.close(join_timeout_s=0.3)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_pipeline_refuses_lazy_sparse():
+    from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+    from flexflow_tpu.runtime.pipeline import PipelineExecutor, PlacementError
+
+    cfg = FFConfig(batch_size=8)
+    cfg.lazy_sparse_optimizer = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 6), name="x")
+    lbl = ff.create_tensor((8,), dtype=np.int32, name="label")
+    t = ff.dense(x, 16, activation="relu", name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    ff.softmax(t, lbl, name="softmax")
+    store = StrategyStore(8, {
+        "fc1": ParallelConfig(n=4, device_ids=tuple(range(4))),
+        "fc2": ParallelConfig(n=4, device_ids=tuple(range(4, 8))),
+        "softmax": ParallelConfig(n=4, device_ids=tuple(range(4, 8))),
+    })
+    with pytest.raises(PlacementError, match="--lazy-sparse-opt"):
+        PipelineExecutor(ff, store, microbatches=2)
+
+
+def test_trace_source_shapes_and_skew():
+    from flexflow_tpu.data.trace import ProductionTraceSource
+
+    src = ProductionTraceSource(200, dense_dim=4, vocab_sizes=[50, 50],
+                                alpha=1.2, seed=0)
+    specs = src.specs()
+    assert set(specs) == {"dense_input", "label", "sparse_input"}
+    got = src.read(0, 200)
+    assert got["dense_input"].shape == (200, 4)
+    assert got["label"].shape == (200, 1)
+    assert got["sparse_input"].shape == (200, 2)
+    ids = got["sparse_input"]
+    assert ids.min() >= 0 and ids.max() < 50
+    # Power-law skew: the most frequent id dominates a uniform draw.
+    _, counts = np.unique(ids, return_counts=True)
+    assert counts.max() > 3 * counts.mean()
+    # Chunk invariance (block-deterministic generation).
+    np.testing.assert_array_equal(
+        got["sparse_input"][30:60], src.read(30, 60)["sparse_input"])
+    with pytest.raises(ValueError, match="alpha"):
+        ProductionTraceSource(10, dense_dim=2, vocab_sizes=[5], alpha=1.0)
+
+
+def test_stream_validation_errors():
+    arrays = _arrays(rows=8)
+    with pytest.raises(ValueError, match="batch_size"):
+        StreamingLoader(ArrayStreamSource(arrays), 0)
+    with pytest.raises(ValueError, match="shard"):
+        StreamingLoader(ArrayStreamSource(arrays), 9)
+    with pytest.raises(ValueError, match="shuffle_window"):
+        StreamingLoader(ArrayStreamSource(arrays), 4, shuffle_window=-1)

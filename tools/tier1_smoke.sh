@@ -3,7 +3,8 @@
 # ops/oracles, strategy numerics, the pipeline runtime (incl. the
 # chunked-scan dispatch + pipeline-superstep numerics,
 # test_pipeline_chunk.py), superstep execution, the resilience/
-# checkpoint subsystem, the run-telemetry layer, and the
+# checkpoint subsystem, the run-telemetry layer, the streaming data
+# plane (test_data_stream.py, DATA.md), and the
 # strategy/execution search — ~5 min on the 8-dev virtual CPU mesh,
 # vs ~14 min+ for the full suite.  Cases marked @pytest.mark.slow are
 # excluded here as in the tier-1 budget run; they stay covered by the
@@ -28,6 +29,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
     tests/test_telemetry.py \
+    tests/test_data_stream.py \
     tests/test_serving.py \
     tests/test_search.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"
