@@ -1064,6 +1064,16 @@ def main():
             if k in extra:
                 extra[k] = None
 
+    # Box-state fingerprint (git sha, jax/jaxlib, platform, devices,
+    # host): lets obs.compare pair this artifact against other runs.
+    # Last so its default_backend()/device_count() probes reflect the
+    # backend the legs actually ran on (every field degrades to None).
+    try:
+        from flexflow_tpu.obs.registry import box_fingerprint
+        extra["fingerprint"] = box_fingerprint()
+    except Exception as e:
+        extra["fingerprint_error"] = f"{type(e).__name__}: {e}"
+
     result = {
         "metric": "alexnet_imgs_per_sec_per_chip",
         "value": round(per_chip, 2),

@@ -33,6 +33,10 @@ Rule catalog (ANALYSIS.md has the full rationale table):
 - FF007 ``timeout=``-killed subprocesses in ``tools/`` — killing a
   TPU-claim holder wedges the tunnel for hours; only the sanctioned
   short health probe may do this (suppressed there, with rationale).
+- FF008 telemetry ``emit`` with an unregistered event name — every
+  event type must be a row in the OBSERVABILITY.md schema table
+  (``obs/events.py::EVENT_CATALOG``); an ad-hoc name is silent
+  schema drift the reader cannot validate.
 """
 
 from __future__ import annotations
@@ -346,6 +350,60 @@ def _check_tool_subprocess_timeout(tree: ast.AST, path: str):
     return out
 
 
+# -- FF008 ------------------------------------------------------------------
+
+#: The registered telemetry event names (kept in sync with
+#: ``flexflow_tpu/obs/events.py::EVENT_CATALOG`` by ``tests/test_obs.py``
+#: — lint must stay import-free, same precedent as RELAY_CAP).
+FF008_EVENT_NAMES = frozenset({
+    "run_start", "run_end",
+    "step", "input_wait", "superstep", "fence", "compiled_step",
+    "program_cost",
+    "ckpt_save", "ckpt_restore", "ckpt_torn",
+    "fault", "rollback", "replay", "preempt",
+    "stall", "stall_recovered", "profile_skipped",
+    "analysis", "search",
+    "request_start", "prefill", "decode_superstep", "request_end",
+    "serving_program",
+})
+
+#: Receiver names that mark an ``.emit(...)`` call as a telemetry
+#: emission (vs some unrelated emit API).
+_TELEMETRY_RECEIVERS = frozenset({"tel", "telemetry", "_telemetry"})
+
+
+def _is_telemetry_emit(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute) or node.func.attr != "emit":
+        return False
+    recv = node.func.value
+    if isinstance(recv, ast.Name):
+        return recv.id in _TELEMETRY_RECEIVERS
+    if isinstance(recv, ast.Call):
+        # `_telemetry.current().emit(...)` / `telemetry.current().emit(...)`
+        return _dotted(recv.func).split(".")[-1] == "current"
+    return False
+
+
+def _check_emit_event_names(tree: ast.AST, path: str):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_telemetry_emit(node):
+            continue
+        if not node.args:
+            continue
+        name = node.args[0]
+        if not isinstance(name, ast.Constant) or \
+                not isinstance(name.value, str):
+            continue  # dynamic name: the reader flags it at read time
+        if name.value not in FF008_EVENT_NAMES:
+            out.append((node.lineno,
+                        f"unregistered telemetry event {name.value!r}: "
+                        f"every emitted name must be a row in the "
+                        f"OBSERVABILITY.md schema table "
+                        f"(obs/events.py EVENT_CATALOG)"))
+    return out
+
+
 RULES: List[Rule] = [
     Rule(
         "FF001", "block_until_ready on a runtime path",
@@ -396,6 +454,14 @@ RULES: List[Rule] = [
         "the tunnel for hours",
         lambda p: p.startswith("tools/") and p.endswith(".py"),
         _check_tool_subprocess_timeout,
+    ),
+    Rule(
+        "FF008", "unregistered telemetry event name",
+        "OBSERVABILITY.md: the event-name catalog (obs/events.py) is "
+        "the schema; an ad-hoc emit name is silent schema drift",
+        lambda p: p.endswith(".py") and not _is_test(p)
+        and p != "flexflow_tpu/runtime/telemetry.py",
+        _check_emit_event_names,
     ),
 ]
 

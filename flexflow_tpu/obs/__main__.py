@@ -1,0 +1,147 @@
+"""CLI for the run-analytics subsystem (OBSERVABILITY.md).
+
+- ``python -m flexflow_tpu.obs report RUN`` — one run's narrative:
+  regimes, where time went, faults/rollbacks, starvation.  RUN is a
+  run-log path or a telemetry dir (dir -> its latest run).
+- ``python -m flexflow_tpu.obs compare A B [--gate]`` — cross-run
+  drift table + verdict; ``--gate`` exits 1 on any ``drift:*`` verdict
+  (the CI/measure-tool form of the round-6 check).
+- ``python -m flexflow_tpu.obs history DIR`` — the run-registry table.
+
+Stdlib + reader only — usable offline on any box holding the logs; no
+jax initialization.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from flexflow_tpu.obs.compare import compare_paths
+from flexflow_tpu.obs.reader import RunLog, resolve_run
+from flexflow_tpu.obs.registry import format_history, history
+
+
+def _fmt_block(d, indent="  ") -> str:
+    return "\n".join(f"{indent}{k}: {d[k]}" for k in d)
+
+
+def cmd_report(args) -> int:
+    path = resolve_run(args.run)
+    if path is None:
+        print(f"report: no run log under {args.run!r}", file=sys.stderr)
+        return 2
+    log = RunLog.load(path)
+    if log.read_error:
+        print(f"report: cannot read {path}: {log.read_error}",
+              file=sys.stderr)
+        return 2
+    print(f"run {log.run_id or '?'}  ({path})")
+    print(f"exit: {log.exit}"
+          + ("  [torn tail line]" if log.torn_tail else ""))
+    if log.malformed:
+        print(f"malformed records dropped: {log.malformed}")
+    if log.unknown_events:
+        print("unknown event types: " + ", ".join(log.unknown_events))
+    rs = log.run_start
+    if rs is not None:
+        meta = {k: v for k, v in rs.data.items()
+                if k not in ("ts", "seq", "ev", "run_id", "pid",
+                             "fingerprint")}
+        if meta:
+            print("meta:")
+            print(_fmt_block(meta))
+    if log.fingerprint:
+        print("fingerprint:")
+        print(_fmt_block(log.fingerprint))
+    summary = log.summary()
+    if summary:
+        print("summary" + ("" if log.complete
+                           else " (reconstructed from events)") + ":")
+        print(_fmt_block(summary))
+    cal = log.calibration()
+    if cal:
+        print("calibration:")
+        print(_fmt_block(cal))
+    # Resilience narrative: what went wrong and what recovery did.
+    for ev_name in ("fault", "rollback", "replay", "preempt", "stall",
+                    "ckpt_torn"):
+        evs = log.select(ev_name)
+        if evs:
+            print(f"{ev_name} x{len(evs)}: "
+                  + "; ".join(
+                      str({k: v for k, v in e.data.items()
+                           if k not in ("ts", "seq", "ev")})
+                      for e in evs[:5])
+                  + (" ..." if len(evs) > 5 else ""))
+    costs = log.select("program_cost")
+    if costs:
+        print("program costs (first build):")
+        for e in costs:
+            extra = {k: v for k, v in e.data.items()
+                     if k not in ("ts", "seq", "ev", "kind", "flops",
+                                  "bytes_accessed", "transcendentals")}
+            print(f"  {e.get('kind')}: "
+                  f"{float(e.get('flops', 0.0)) / 1e9:.3f} GF, "
+                  f"{float(e.get('bytes_accessed', 0.0)) / 1e6:.1f} MB"
+                  + (f"  {extra}" if extra else ""))
+    ts = log.trace_summary()
+    if ts:
+        print(f"trace summary (device total "
+              f"{ts.get('device_ms_total')} ms):")
+        for row in ts.get("top_ops", []):
+            print(f"  {row['op']:<40} {row['device_ms']:>10.3f} ms "
+                  f"x{row['count']}")
+        for name, a in (ts.get("annotations") or {}).items():
+            print(f"  step '{name}': {a['count']} windows, host "
+                  f"{a['host_ms']} ms, device {a['device_ms']} ms")
+    search = log.first("search")
+    if search is not None:
+        print("execution search: "
+              + str({k: v for k, v in search.data.items()
+                     if k not in ("ts", "seq", "ev")}))
+    return 0
+
+
+def cmd_compare(args) -> int:
+    try:
+        result = compare_paths(args.a, args.b)
+    except FileNotFoundError as e:
+        print(f"compare: {e}", file=sys.stderr)
+        return 2
+    print(result.format())
+    if args.gate and not result.ok:
+        return 1
+    return 0
+
+
+def cmd_history(args) -> int:
+    print(format_history(history(args.dir)))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.obs",
+        description="Run analytics: report / compare / history "
+                    "(OBSERVABILITY.md 'Reading across runs').",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("report", help="one run's narrative")
+    pr.add_argument("run", help="run-log path or telemetry dir")
+    pr.set_defaults(fn=cmd_report)
+    pc = sub.add_parser("compare", help="drift table + verdict")
+    pc.add_argument("a", help="baseline run log or telemetry dir")
+    pc.add_argument("b", help="candidate run log or telemetry dir")
+    pc.add_argument("--gate", action="store_true",
+                    help="exit 1 on any drift:* verdict")
+    pc.set_defaults(fn=cmd_compare)
+    ph = sub.add_parser("history", help="run-registry table")
+    ph.add_argument("dir", help="telemetry dir holding runs.jsonl")
+    ph.set_defaults(fn=cmd_history)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

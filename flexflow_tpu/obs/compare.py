@@ -1,0 +1,240 @@
+"""Cross-run drift detection + the paired measurement protocol.
+
+Two halves, both promotions of protocols that already existed as tool
+locals:
+
+- :func:`compare_runs` — diff two runs' summary/calibration metrics
+  against relative thresholds and emit a verdict (``ok`` /
+  ``drift:<metric>``).  The PIPELINE_OVERHEAD.md round-6 incident (a
+  ~1.5x box-state drift that silently invalidated every recorded
+  number and had to be untangled by hand-rerun A/Bs) as a checked
+  property: ``python -m flexflow_tpu.obs compare A B`` reads it as
+  ``drift:step_ms_p50`` in one command, and the fingerprint diff says
+  whether the box itself changed.
+- :func:`paired_measure` — the measure_telemetry.py paired-median +
+  A/A-control protocol (each rep runs both variants back to back with
+  order alternating between reps; the statistic is the median of
+  per-pair relative deltas, read against an A/A control run under the
+  same pairing), now the ONE implementation both
+  ``tools/measure_telemetry.py`` (delta-% form) and
+  ``tools/measure_data.py`` (ratio form) cite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Any, Callable, Dict, List, Optional
+
+from flexflow_tpu.obs.reader import RunLog, resolve_run
+from flexflow_tpu.obs.registry import fingerprint_diff
+
+#: Relative-drift thresholds per metric (|b-a|/|a| past which the
+#: verdict flips), in verdict priority order.  Counter metrics
+#: (fences/step, programs/step) are ACCOUNTING — any change is drift;
+#: wall-time metrics carry the box's run-to-run noise (the A/A control
+#: in measure_telemetry reads 1-15% on this box), so their thresholds
+#: sit well above noise and well below round-6's ~1.5x.
+DEFAULT_THRESHOLDS: Dict[str, float] = {
+    "fences_per_step": 0.01,
+    "programs_per_step": 0.01,
+    "step_ms_p50": 0.25,
+    "step_ms_p95": 0.35,
+    "dispatch_ms_per_program": 0.50,
+    "fence_ms": 0.50,
+    "input_wait_ms_p50": 1.00,
+}
+
+#: Metrics read from the run summary vs the calibration block.
+_SUMMARY_METRICS = ("fences_per_step", "programs_per_step",
+                    "step_ms_p50", "step_ms_p95", "input_wait_ms_p50")
+_CALIBRATION_METRICS = ("dispatch_ms_per_program", "fence_ms")
+
+
+@dataclasses.dataclass
+class MetricRow:
+    metric: str
+    a: Optional[float]
+    b: Optional[float]
+    rel: Optional[float]       # |b-a|/|a|; None when not comparable
+    threshold: float
+    drifted: bool
+
+
+@dataclasses.dataclass
+class CompareResult:
+    """Two runs diffed: per-metric rows, the box-state fingerprint
+    delta, and the verdict (first drifted metric in threshold order)."""
+
+    a_id: Optional[str]
+    b_id: Optional[str]
+    rows: List[MetricRow]
+    fingerprint_delta: List[str]
+    verdict: str
+
+    @property
+    def ok(self) -> bool:
+        return self.verdict == "ok"
+
+    def format(self) -> str:
+        lines = [
+            f"compare: {self.a_id or '?'}  vs  {self.b_id or '?'}",
+            f"{'metric':<26} {'a':>10} {'b':>10} {'drift':>8} "
+            f"{'threshold':>10}",
+        ]
+        for r in self.rows:
+            a = "-" if r.a is None else f"{r.a:.4g}"
+            b = "-" if r.b is None else f"{r.b:.4g}"
+            rel = "-" if r.rel is None else f"{r.rel * 100:+.1f}%".replace(
+                "+", "" if r.rel < 0 else "+")
+            mark = "  <-- DRIFT" if r.drifted else ""
+            lines.append(f"{r.metric:<26} {a:>10} {b:>10} {rel:>8} "
+                         f"{r.threshold * 100:>9.0f}%{mark}")
+        if self.fingerprint_delta:
+            lines.append("fingerprint delta:")
+            for d in self.fingerprint_delta:
+                lines.append(f"  {d}")
+        else:
+            lines.append("fingerprint: identical box state")
+        lines.append(f"verdict: {self.verdict}")
+        return "\n".join(lines)
+
+
+def _rel(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    if a is None or b is None:
+        return None
+    if a == 0.0:
+        return 0.0 if b == 0.0 else float("inf")
+    return (b - a) / abs(a)
+
+
+def compare_runs(a: RunLog, b: RunLog,
+                 thresholds: Optional[Dict[str, float]] = None,
+                 ) -> CompareResult:
+    """Diff run ``b`` against baseline ``a``.  A metric present in only
+    one run is reported but never drifts (regimes differ legitimately —
+    a pipeline run has programs/step, a full-mesh run does not); the
+    verdict is the FIRST drifted metric in threshold-table order."""
+    th = dict(DEFAULT_THRESHOLDS)
+    if thresholds:
+        th.update(thresholds)
+    sa, sb = a.summary(), b.summary()
+    ca, cb = a.calibration(), b.calibration()
+    rows: List[MetricRow] = []
+    verdict = "ok"
+    for metric in th:
+        src_a, src_b = (
+            (ca, cb) if metric in _CALIBRATION_METRICS else (sa, sb)
+        )
+        va, vb = src_a.get(metric), src_b.get(metric)
+        va = None if va is None else float(va)
+        vb = None if vb is None else float(vb)
+        rel = _rel(va, vb)
+        drifted = rel is not None and abs(rel) > th[metric]
+        rows.append(MetricRow(metric=metric, a=va, b=vb, rel=rel,
+                              threshold=th[metric], drifted=drifted))
+        if drifted and verdict == "ok":
+            verdict = f"drift:{metric}"
+    return CompareResult(
+        a_id=a.run_id, b_id=b.run_id, rows=rows,
+        fingerprint_delta=fingerprint_diff(a.fingerprint, b.fingerprint),
+        verdict=verdict,
+    )
+
+
+def compare_paths(path_a: str, path_b: str,
+                  thresholds: Optional[Dict[str, float]] = None,
+                  ) -> CompareResult:
+    """CLI form: each argument is a run log or a telemetry dir (the
+    dir resolves to its latest run)."""
+    ra = resolve_run(path_a)
+    rb = resolve_run(path_b)
+    if ra is None or rb is None:
+        missing = path_a if ra is None else path_b
+        raise FileNotFoundError(f"no run log under {missing!r}")
+    la, lb = RunLog.load(ra), RunLog.load(rb)
+    for path, log in ((ra, la), (rb, lb)):
+        if log.read_error:
+            raise FileNotFoundError(
+                f"cannot read run log {path!r}: {log.read_error}"
+            )
+    return compare_runs(la, lb, thresholds=thresholds)
+
+
+# -- paired measurement protocol ----------------------------------------------
+
+
+@dataclasses.dataclass
+class PairedResult:
+    """One paired A/B: per-rep leg values plus both statistic forms
+    (delta-% for overhead bars, ratio for throughput bars) and their
+    A/A controls.  ``a`` is the baseline leg in both forms:
+    ``delta_pct = (b-a)/a*100`` and ``ratio = a/b``."""
+
+    a: List[float]
+    b: List[float]
+    delta_pct: List[float]
+    ratio: List[float]
+    aa_pct: List[float]
+    aa_ratio: List[float]
+
+    @property
+    def median_a(self) -> float:
+        return statistics.median(self.a)
+
+    @property
+    def median_b(self) -> float:
+        return statistics.median(self.b)
+
+    @property
+    def median_delta_pct(self) -> float:
+        return statistics.median(self.delta_pct)
+
+    @property
+    def median_ratio(self) -> float:
+        return statistics.median(self.ratio)
+
+    @property
+    def median_aa_pct(self) -> float:
+        return statistics.median(self.aa_pct) if self.aa_pct else 0.0
+
+    @property
+    def median_aa_ratio(self) -> float:
+        return statistics.median(self.aa_ratio) if self.aa_ratio else 1.0
+
+
+def paired_measure(
+    make_a: Callable[[int], float],
+    make_b: Callable[[int], float],
+    reps: int,
+    control: Optional[Callable[[int], float]] = None,
+) -> PairedResult:
+    """The paired-median protocol: each rep runs both legs back to
+    back with ORDER ALTERNATING between reps (drift cancels to first
+    order inside a pair) and the statistic is the median of per-pair
+    relative deltas (the median rejects the box's occasional 2x
+    outlier runs).  ``control`` (run twice per rep, same alternation
+    formula) gives the A/A floor to read the A/B number against —
+    on this box an uncontrolled A/A reads 1-15% "overhead" from
+    ordering alone."""
+    res = PairedResult(a=[], b=[], delta_pct=[], ratio=[],
+                       aa_pct=[], aa_ratio=[])
+    for r in range(reps):
+        legs = [("a", make_a), ("b", make_b)]
+        if r % 2:
+            legs.reverse()  # cancel drift inside the pair
+        pair: Dict[str, float] = {}
+        for kind, fn in legs:
+            pair[kind] = float(fn(r))
+        res.a.append(pair["a"])
+        res.b.append(pair["b"])
+        res.delta_pct.append((pair["b"] - pair["a"]) / pair["a"] * 100)
+        res.ratio.append(pair["a"] / pair["b"])
+        if control is not None:
+            c1 = float(control(r))
+            c2 = float(control(r))
+            res.aa_pct.append(
+                ((c2 - c1) if r % 2 == 0 else (c1 - c2)) / c1 * 100
+            )
+            res.aa_ratio.append((c2 / c1) if r % 2 == 0 else (c1 / c2))
+    return res

@@ -1,0 +1,63 @@
+"""The registered telemetry event-name catalog (OBSERVABILITY.md).
+
+ONE name set, living next to the schema table's code home: the write
+side (``runtime/telemetry.py``) emits these, the read side
+(``obs/reader.py``) validates against them, and fflint rule FF008
+(``analysis/lint.py``) rejects ``emit`` call sites outside the
+telemetry module that use a name not registered here — the
+schema-drift guard.  Adding an event = add the OBSERVABILITY.md row
+AND the name here (the lint module keeps a dependency-free copy,
+sync-pinned by ``tests/test_obs.py``).
+
+This module imports nothing (no jax) so every reader — the obs CLI,
+the lint sync pin, offline tools — can load it anywhere.
+"""
+
+from __future__ import annotations
+
+#: Every event type the runtime may emit, one per OBSERVABILITY.md
+#: schema row.  frozenset: membership is the only operation.
+EVENT_CATALOG = frozenset({
+    # lifecycle
+    "run_start",
+    "run_end",
+    # training loop
+    "step",
+    "input_wait",
+    "superstep",
+    "fence",
+    "compiled_step",
+    "program_cost",
+    # checkpoint / resilience
+    "ckpt_save",
+    "ckpt_restore",
+    "ckpt_torn",
+    "fault",
+    "rollback",
+    "replay",
+    "preempt",
+    # watchdog / profiling
+    "stall",
+    "stall_recovered",
+    "profile_skipped",
+    # static analysis + execution search
+    "analysis",
+    "search",
+    # serving (SERVING.md)
+    "request_start",
+    "prefill",
+    "decode_superstep",
+    "request_end",
+    "serving_program",
+})
+
+#: ``run_end.exit`` classifications (the reader adds ``truncated`` for
+#: logs that never reached ``run_end`` at all).
+EXIT_CLEAN = "clean"
+EXIT_PREEMPT = "preempt"
+EXIT_TRUNCATED = "truncated"
+
+
+def exit_exception(exc_type_name: str) -> str:
+    """The ``exception:<type>`` exit form for ``run_end.exit``."""
+    return f"exception:{exc_type_name}"

@@ -1,0 +1,166 @@
+"""Device-time attribution from a perfetto trace (stdlib-only).
+
+``--trace DIR --telemetry DIR2`` together close the ROADMAP XProf
+follow-on: the profiler writes a perfetto trace
+(``plugins/profile/<ts>/perfetto_trace.json.gz``,
+``create_perfetto_trace=True``), this module parses it with stdlib
+gzip+json (NO TensorFlow/TensorBoard dependency), and the trainer
+folds the result into ``run_end`` as its ``trace_summary`` block:
+
+- ``top_ops``: top-N op names by summed device-lane duration — the
+  "where did device time go" answer the reference always had from
+  per-task cudaEvent timing.
+- ``annotations``: per-``StepTraceAnnotation`` name (``train`` /
+  ``superstep``), event count, summed host wall, and the device time
+  that overlapped those windows — the host/device split per step.
+
+Lane classification: a perfetto process named ``/device:...`` is a
+device; on the CPU backend (tests' 8-dev virtual mesh) there is no
+``/device:`` process — XLA execution shows up under threads named
+``tf_XLA...``, so a thread whose name contains ``XLA`` counts as a
+device-side stand-in.  Infra events (``Foo::Bar`` scopes, ``$``-keyed
+internals, the annotation events themselves) are excluded from op
+totals.
+"""
+
+from __future__ import annotations
+
+import bisect
+import glob
+import gzip
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+_log = logging.getLogger("ff.obs")
+
+#: How many ops the ``top_ops`` table keeps.
+DEFAULT_TOP_N = 10
+
+
+def find_perfetto_trace(log_dir: str) -> Optional[str]:
+    """Newest ``perfetto_trace.json.gz`` under an XProf log dir."""
+    pattern = os.path.join(
+        log_dir, "plugins", "profile", "*", "perfetto_trace.json.gz"
+    )
+    paths = glob.glob(pattern)
+    if not paths:
+        # A caller may hand the session dir directly.
+        paths = glob.glob(
+            os.path.join(log_dir, "**", "perfetto_trace.json.gz"),
+            recursive=True,
+        )
+    if not paths:
+        return None
+    return max(paths, key=os.path.getmtime)
+
+
+def _load_events(path: str) -> List[Dict[str, Any]]:
+    with gzip.open(path, "rt") as f:
+        doc = json.load(f)
+    ev = doc.get("traceEvents", [])
+    return ev if isinstance(ev, list) else []
+
+
+def _is_infra(name: str) -> bool:
+    return "::" in name or name.startswith("$")
+
+
+def summarize_trace(path: str, top_n: int = DEFAULT_TOP_N) -> Dict[str, Any]:
+    """Parse one perfetto trace file into the ``trace_summary`` block.
+    Durations are perfetto microseconds, reported as ms (3 dp)."""
+    events = _load_events(path)
+    pnames: Dict[Any, str] = {}
+    tnames: Dict[Tuple[Any, Any], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name":
+            pnames[e.get("pid")] = str(args.get("name", ""))
+        elif e.get("name") == "thread_name":
+            tnames[(e.get("pid"), e.get("tid"))] = str(args.get("name", ""))
+
+    def device_lane(pid, tid) -> bool:
+        if pnames.get(pid, "").startswith("/device:"):
+            return True
+        return "XLA" in tnames.get((pid, tid), "")
+
+    op_totals: Dict[str, float] = {}
+    op_counts: Dict[str, int] = {}
+    device_ops: List[Tuple[float, float]] = []  # (ts, dur) us
+    annotations: Dict[str, Dict[str, Any]] = {}
+    ann_windows: Dict[str, List[Tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        dur = float(e.get("dur", 0.0))
+        ts = float(e.get("ts", 0.0))
+        args = e.get("args") or {}
+        if "step_num" in args:
+            # A StepTraceAnnotation window (host wall of one step).
+            a = annotations.setdefault(
+                name, {"count": 0, "host_ms": 0.0, "device_ms": 0.0}
+            )
+            a["count"] += 1
+            a["host_ms"] += dur
+            ann_windows.setdefault(name, []).append((ts, ts + dur))
+            continue
+        if not device_lane(e.get("pid"), e.get("tid")):
+            continue
+        device_ops.append((ts, dur))
+        if _is_infra(name) or not name:
+            continue
+        op_totals[name] = op_totals.get(name, 0.0) + dur
+        op_counts[name] = op_counts.get(name, 0) + 1
+
+    # Device time inside each annotation window (attribute by the op
+    # event's START time — an op belongs to the step that launched it).
+    for aname, windows in ann_windows.items():
+        windows.sort()
+        starts = [w[0] for w in windows]
+        dev_us = 0.0
+        for ts, dur in device_ops:
+            i = bisect.bisect_right(starts, ts) - 1
+            if i >= 0 and ts < windows[i][1]:
+                dev_us += dur
+        annotations[aname]["device_ms"] = round(dev_us / 1e3, 3)
+    for a in annotations.values():
+        a["host_ms"] = round(a["host_ms"] / 1e3, 3)
+
+    top = sorted(op_totals.items(), key=lambda kv: -kv[1])[:top_n]
+    return {
+        "trace_file": path,
+        "device_ms_total": round(sum(d for _, d in device_ops) / 1e3, 3),
+        "top_ops": [
+            {"op": name, "device_ms": round(us / 1e3, 3),
+             "count": op_counts[name]}
+            for name, us in top
+        ],
+        "annotations": annotations,
+    }
+
+
+def summarize_trace_dir(log_dir: str,
+                        top_n: int = DEFAULT_TOP_N,
+                        ) -> Optional[Dict[str, Any]]:
+    """The trainer's entry point: newest perfetto trace under the
+    XProf dir -> summary block, or None (with one warning) when the
+    trace is absent or unparsable — attribution must never fail the
+    run that produced it."""
+    try:
+        path = find_perfetto_trace(log_dir)
+        if path is None:
+            _log.warning(
+                "trace summary: no perfetto_trace.json.gz under %s "
+                "(profiler too old, or the trace was not written?)",
+                log_dir,
+            )
+            return None
+        return summarize_trace(path, top_n=top_n)
+    except (OSError, ValueError, KeyError) as e:
+        _log.warning("trace summary: cannot parse trace under %s: %s",
+                     log_dir, e)
+        return None

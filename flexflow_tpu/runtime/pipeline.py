@@ -959,6 +959,9 @@ class PipelineExecutor:
         no fence floor at all)."""
         if self.compiled:
             fn = self.build_compiled_step()
+            _telemetry.current().program_cost(
+                "pipeline_compiled_step", fn,
+                (params, opt_state, state, batch), S=len(self.stages))
             self.note_fused_dispatch()
             return fn(params, opt_state, state, batch)
         if self.chunk > 1:
@@ -1014,6 +1017,9 @@ class PipelineExecutor:
                 inputs = self._put_stage_many(si, vals)
                 stage_inputs[mi][si] = inputs
                 fwd_state[mi][si] = stage_state[si]
+                _telemetry.current().program_cost(
+                    "pipeline_stage_fwd", self._fwd_fns[si],
+                    (params[si], stage_state[si], inputs), stage=si)
                 outs, _, _, new_state = self._fwd_fns[si](
                     params[si], stage_state[si], inputs
                 )
@@ -1022,6 +1028,10 @@ class PipelineExecutor:
                 continue
             douts = self._collect_douts(si, dout_back[mi], boundary[mi],
                                         stacked=False)
+            _telemetry.current().program_cost(
+                "pipeline_stage_bwd", self._bwd_fns[si],
+                (params[si], fwd_state[mi][si], stage_inputs[mi][si],
+                 douts, dloss_seed), stage=si)
             dparams, dxs, mets, _ = self._bwd_fns[si](
                 params[si], fwd_state[mi][si], stage_inputs[mi][si],
                 douts, dloss_seed,
@@ -1094,6 +1104,9 @@ class PipelineExecutor:
                 }
                 inputs = self._put_stage_many_chunk(si, vals)
                 stage_inputs[ci][si] = inputs
+                _telemetry.current().program_cost(
+                    "pipeline_stage_fwd_chunk", self._fwd_chunk_fns[si],
+                    (params[si], stage_state[si], inputs), stage=si)
                 outs, pres, new_state = self._fwd_chunk_fns[si](
                     params[si], stage_state[si], inputs
                 )
@@ -1111,6 +1124,10 @@ class PipelineExecutor:
                          else self._zero_metrics(
                              si, params[si], pre_states[ci][si],
                              stage_inputs[ci][si]))
+            _telemetry.current().program_cost(
+                "pipeline_stage_bwd_chunk", self._bwd_chunk_fns[si],
+                (params[si], pre_states[ci][si], stage_inputs[ci][si],
+                 douts, dloss_seed, g_acc, m_acc), stage=si)
             g, mets, dxs = self._bwd_chunk_fns[si](
                 params[si], pre_states[ci][si], stage_inputs[ci][si],
                 douts, dloss_seed, g_acc, m_acc,

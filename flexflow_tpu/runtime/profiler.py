@@ -146,11 +146,16 @@ def report(profiles: List[OpProfile]) -> str:
 
 
 @contextlib.contextmanager
-def trace(log_dir: str):
+def trace(log_dir: str, perfetto: bool = False):
     """Capture a TensorBoard/XProf trace of everything run inside the
     block (the jitted step as XLA executes it — fusions, collectives,
-    real device timelines).  View with ``tensorboard --logdir``."""
-    jax.profiler.start_trace(log_dir)
+    real device timelines).  View with ``tensorboard --logdir``.
+
+    ``perfetto=True`` additionally writes ``perfetto_trace.json.gz``
+    (plain gzip+json, no TensorBoard needed to read it) — what
+    ``obs/trace.py`` parses into the ``run_end`` ``trace_summary``
+    device-time attribution when telemetry is on."""
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=perfetto)
     try:
         yield
     finally:

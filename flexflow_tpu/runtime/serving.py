@@ -581,6 +581,10 @@ class Server:
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :plen] = np.asarray(r.prompt, np.int32)
                 t0 = time.perf_counter()
+                tel.program_cost(
+                    "prefill", ex.build_prefill(bucket),
+                    (self.params, self.op_state, padded, np.int32(plen)),
+                    bucket=bucket)
                 rows, tok0, okf = ex.build_prefill(bucket)(
                     self.params, self.op_state, padded,
                     np.int32(plen),
@@ -633,6 +637,10 @@ class Server:
                 [sl.last_tok if sl else 0 for sl in slots], np.int32
             )
             t_call = time.perf_counter()
+            tel.program_cost(
+                "decode_superstep", decode_fn,
+                (self.params, self.op_state, caches, pos_vec, tok_vec),
+                k=k)
             caches, _pos, _tok, (toks, oks) = decode_fn(
                 self.params, self.op_state, caches, pos_vec, tok_vec
             )

@@ -114,6 +114,12 @@ class _NullTelemetry:
     def add_programs(self, n: int, steps: int = 1) -> None:
         pass
 
+    def program_cost(self, kind, fn, args=(), **meta) -> None:
+        pass
+
+    def attach_trace_summary(self, log_dir) -> None:
+        pass
+
     def heartbeat(self, label: str = "beat") -> None:
         pass
 
@@ -222,10 +228,19 @@ class Telemetry:
         self._seq = 0
         self._f = None
         self.path: Optional[str] = None
+        self._dir = directory
+        self.meta: Dict[str, Any] = dict(meta or {})
         if directory:
             os.makedirs(directory, exist_ok=True)
             self.path = os.path.join(directory, f"run-{self.run_id}.jsonl")
             self._f = open(self.path, "a")
+        #: Box-state identity stamped onto run_start and the run index
+        #: (the round-6 drift attribution; cached per process —
+        #: obs/registry.py).  Lazy import: obs must stay loadable
+        #: without the runtime stack and vice versa.
+        from flexflow_tpu.obs.registry import box_fingerprint
+
+        self.fingerprint: Dict[str, Any] = box_fingerprint()
         #: Dispatch/fence counters: ``fences`` and ``steps`` feed
         #: fences/step; ``host_programs``/``program_steps`` hold the
         #: pipeline's folded ``last_schedule`` lengths (programs/step).
@@ -292,10 +307,18 @@ class Telemetry:
         self._stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
         self._prev_current: Optional[Telemetry] = None
+        #: run_end.exit bookkeeping: a recorded ``preempt`` event makes
+        #: the whole run's outcome ``preempt`` (the SIGTERM emergency
+        #: path exits by exception, but the preemption IS the cause).
+        self._preempted = False
+        self.exit_status: Optional[str] = None
+        #: program_cost dedup: one event per (kind, program identity).
+        self._cost_seen: set = set()
+        self._trace_summary: Optional[Dict[str, Any]] = None
         if self._hb_path:
             self._touch_heartbeat()
         self.emit("run_start", run_id=self.run_id, pid=os.getpid(),
-                  **(meta or {}))
+                  fingerprint=self.fingerprint, **(meta or {}))
         if self._stall_deadline > 0:
             self._watchdog = threading.Thread(
                 target=self._watch, name="ff-telemetry-watchdog", daemon=True
@@ -322,6 +345,8 @@ class Telemetry:
                     self._f.flush()
                     self._last_flush = now
             self._last_label = ev
+            if ev == "preempt":
+                self._preempted = True
 
     def record_step(self, step, loss=None, wall_s=None, **fields) -> None:
         """One completed training step: a ``step`` event plus the
@@ -397,6 +422,54 @@ class Telemetry:
         ``1/k``."""
         self.counts["host_programs"] += int(n)
         self.counts["program_steps"] += int(steps)
+
+    def program_cost(self, kind: str, fn, args=(), **meta) -> None:
+        """One ``program_cost`` event per compiled program at first
+        build: XLA's static flops/bytes estimate from
+        ``Lowered.cost_analysis()`` — device-side attribution that
+        exists even without a trace (OBSERVABILITY.md).
+
+        ``Lowered`` (not ``Compiled``): probing this jaxlib showed
+        ``lowered.compile()`` performs a genuine SECOND XLA compile
+        (~36 ms, not shared with the jit call's cache) while
+        ``lower()`` after a warm call is ~1 ms and its cost_analysis
+        reports the same flops — the < 2% overhead bar decides.
+        Deduped per (kind, program identity); never raises — cost
+        attribution must not break the program it describes."""
+        key = (kind, id(fn))
+        if key in self._cost_seen:
+            return
+        self._cost_seen.add(key)
+        try:
+            lower = getattr(fn, "lower", None)
+            if lower is None:
+                return
+            ca = lower(*args).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if not isinstance(ca, dict):
+                return
+            self.emit(
+                "program_cost", kind=kind,
+                flops=float(ca.get("flops", 0.0)),
+                bytes_accessed=float(ca.get("bytes accessed", 0.0)),
+                transcendentals=float(ca.get("transcendentals", 0.0)),
+                **meta,
+            )
+        except Exception as e:
+            _log.debug("program_cost(%s): cost analysis unavailable: %s",
+                       kind, e)
+
+    def attach_trace_summary(self, log_dir: str) -> None:
+        """Fold device-time attribution from an XProf perfetto trace
+        (``--trace DIR`` + telemetry together) into the coming
+        ``run_end`` — the ROADMAP XProf follow-on.  Parsing failures
+        warn and attach nothing."""
+        from flexflow_tpu.obs.trace import summarize_trace_dir
+
+        summary = summarize_trace_dir(log_dir)
+        if summary is not None:
+            self._trace_summary = summary
 
     # -- heartbeat / watchdog ----------------------------------------------
 
@@ -569,19 +642,49 @@ class Telemetry:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, exc_type=None) -> None:
+        """End the run: classify the outcome (``clean`` /
+        ``exception:<type>`` / ``preempt`` — a crashed run is now
+        distinguishable from a truncated log), emit ``run_end`` with
+        the summary/calibration blocks (+ ``trace_summary`` when
+        attribution was attached), and append the run to the registry
+        index (obs/registry.py)."""
         if self._closed:
             return
         self._stop.set()
         if self._watchdog is not None:
             self._watchdog.join(timeout=2.0)
-        self.emit("run_end", summary=self.step_summary(),
-                  calibration=self.calibration_summary())
+        from flexflow_tpu.obs.events import (
+            EXIT_CLEAN,
+            EXIT_PREEMPT,
+            exit_exception,
+        )
+
+        if self._preempted:
+            self.exit_status = EXIT_PREEMPT
+        elif exc_type is not None:
+            self.exit_status = exit_exception(
+                getattr(exc_type, "__name__", str(exc_type))
+            )
+        else:
+            self.exit_status = EXIT_CLEAN
+        end_fields: Dict[str, Any] = {
+            "summary": self.step_summary(),
+            "calibration": self.calibration_summary(),
+            "exit": self.exit_status,
+        }
+        if self._trace_summary is not None:
+            end_fields["trace_summary"] = self._trace_summary
+        self.emit("run_end", **end_fields)
         with self._lock:
             self._closed = True
             if self._f is not None:
                 self._f.close()
                 self._f = None
+        if self._dir:
+            from flexflow_tpu.obs.registry import append_run, index_record
+
+            append_run(self._dir, index_record(self))
 
     def __enter__(self) -> "Telemetry":
         global _current
@@ -589,9 +692,9 @@ class Telemetry:
         _current = self
         return self
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, exc_type=None, exc=None, tb=None) -> None:
         global _current
         if _current is self:
             _current = self._prev_current
         self._prev_current = None
-        self.close()
+        self.close(exc_type)

@@ -244,7 +244,10 @@ class Trainer:
                 # per-task cudaEvent prints could not give).
                 from flexflow_tpu.runtime.profiler import trace
 
-                trace_ctx = trace(ex.config.trace_dir)
+                # perfetto sidecar only when telemetry will consume it
+                # (the run_end trace_summary attribution, obs/trace.py).
+                trace_ctx = trace(ex.config.trace_dir,
+                                  perfetto=tel.enabled)
             ckpt_s = 0.0  # checkpoint I/O time, excluded from throughput
             with trace_ctx:
                 # Both timestamps live INSIDE the trace context so neither
@@ -265,6 +268,20 @@ class Trainer:
                             **(depth_fn() if depth_fn else {}))
                     else:
                         batch = next(batches)
+                    if it == 0 and tel.enabled:
+                        # program_cost at first (timed) dispatch: XLA's
+                        # static flops/bytes for the step program —
+                        # lowering only, the args are not consumed.
+                        if accum_steps > 1:
+                            tel.program_cost(
+                                "accum_step", accum_fn,
+                                (params, opt_state, state,
+                                 ex.stack_microbatches(batch, accum_steps)),
+                                accum_steps=accum_steps)
+                        else:
+                            tel.program_cost(
+                                "train_step", step_fn,
+                                (params, opt_state, state, batch))
                     # StepTraceAnnotation: XProf device timelines group
                     # by train step, so --trace captures correlate with
                     # the telemetry JSONL's step events (no-op unless a
@@ -304,6 +321,10 @@ class Trainer:
                 final_m = tel.fence(m, "final")
                 elapsed = time.perf_counter() - start - ckpt_s
 
+            if ex.config.trace_dir and tel.enabled:
+                # Device-time attribution: parse the perfetto trace the
+                # block above just wrote into run_end's trace_summary.
+                tel.attach_trace_summary(ex.config.trace_dir)
             self.metrics.update(final_m)
             if checkpoint is not None:
                 checkpoint.save(start_step + completed, params, opt_state, state)
@@ -492,7 +513,8 @@ class Trainer:
             if ex.config.trace_dir:
                 from flexflow_tpu.runtime.profiler import trace
 
-                trace_ctx = trace(ex.config.trace_dir)
+                trace_ctx = trace(ex.config.trace_dir,
+                                  perfetto=tel.enabled)
             ckpt_s = 0.0
             timed = plan[warm_calls:]
             steps_done = 0
@@ -511,6 +533,10 @@ class Trainer:
                             **(depth_fn() if depth_fn else {}))
                     else:
                         superbatch = next(batches)
+                    if steps_done == 0 and tel.enabled:
+                        tel.program_cost(
+                            "superstep", step_fns[n],
+                            (params, opt_state, state, superbatch), k=n)
                     with StepTraceAnnotation("superstep",
                                              step_num=start_step + steps_done):
                         params, opt_state, state, ms = step_fns[n](
@@ -559,6 +585,8 @@ class Trainer:
                         break  # emergency save at this superstep boundary
                 elapsed = time.perf_counter() - start - ckpt_s
 
+            if ex.config.trace_dir and tel.enabled:
+                tel.attach_trace_summary(ex.config.trace_dir)
             if checkpoint is not None:
                 checkpoint.save(start_step + steps_done, params, opt_state, state)
                 if hasattr(checkpoint, "wait_until_finished"):
@@ -697,7 +725,8 @@ class Trainer:
             if ex.config.trace_dir:
                 from flexflow_tpu.runtime.profiler import trace
 
-                trace_ctx = trace(ex.config.trace_dir)
+                trace_ctx = trace(ex.config.trace_dir,
+                                  perfetto=tel.enabled)
             ckpt_s = 0.0
             steps_done = 0
             supersteps = 0
@@ -767,6 +796,8 @@ class Trainer:
                         break  # emergency save at this boundary
                 elapsed = time.perf_counter() - start - ckpt_s
 
+            if ex.config.trace_dir and tel.enabled:
+                tel.attach_trace_summary(ex.config.trace_dir)
             if checkpoint is not None:
                 checkpoint.save(
                     start_step + steps_done, params, opt_state, state

@@ -17,7 +17,6 @@ against wall clock either.
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 import os
 from typing import Any, Dict, List, Optional, Tuple
@@ -409,52 +408,39 @@ class Calibration:
 
     @staticmethod
     def from_jsonl(path: str) -> "Calibration":
-        """Load one run's JSONL telemetry; falls back LOUDLY to the
-        uncalibrated defaults on a missing/unreadable file."""
-        events = []
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        events.append(json.loads(line))
-                    except ValueError:
-                        continue  # torn tail line of a crashed run
-        except OSError as e:
+        """Load one run's JSONL telemetry (via the ONE log parser,
+        ``obs.reader.RunLog`` — truncation-tolerant exactly as before);
+        falls back LOUDLY to the uncalibrated defaults on a
+        missing/unreadable file."""
+        from flexflow_tpu.obs.reader import RunLog
+
+        log = RunLog.load(path)
+        if log.read_error is not None:
             _log.warning(
                 "calibration: cannot read %s (%s); using uncalibrated "
-                "roofline/dispatch defaults", path, e,
+                "roofline/dispatch defaults", path, log.read_error,
             )
             return Calibration()
-        if not events:
+        if not log.events:
             _log.warning(
                 "calibration: %s holds no events; using uncalibrated "
                 "defaults", path,
             )
             return Calibration()
-        return Calibration.from_events(events, source=path)
+        return Calibration.from_events(log.iter_raw(), source=path)
 
     @staticmethod
     def from_dir(directory: str,
                  exclude: Optional[str] = None) -> "Calibration":
         """Latest ``run-*.jsonl`` under ``directory`` (excluding e.g.
-        the ACTIVE run's own file); uncalibrated defaults when none."""
-        try:
-            names = sorted(
-                n for n in os.listdir(directory)
-                if n.startswith("run-") and n.endswith(".jsonl")
-            )
-        except OSError:
-            names = []
-        paths = [os.path.join(directory, n) for n in names]
-        if exclude is not None:
-            ex = os.path.abspath(exclude)
-            paths = [p for p in paths if os.path.abspath(p) != ex]
-        if not paths:
+        the ACTIVE run's own file; selection rule shared with
+        ``obs.reader.latest_run``); uncalibrated defaults when none."""
+        from flexflow_tpu.obs.reader import latest_run
+
+        path = latest_run(directory, exclude=exclude)
+        if path is None:
             return Calibration()
-        return Calibration.from_jsonl(max(paths, key=os.path.getmtime))
+        return Calibration.from_jsonl(path)
 
     @staticmethod
     def from_path(path: str) -> "Calibration":

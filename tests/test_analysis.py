@@ -179,6 +179,26 @@ class TestLintRules:
             lint.lint_source(aliased, "tools/planted.py")
         )
 
+    def test_ff008_unregistered_event_name(self):
+        bad = 'tel.emit("made_up_event", x=1)\n'
+        assert "FF008" in _ids(lint.lint_source(bad, "planted.py"))
+        bad = '_telemetry.current().emit("nope")\n'
+        assert "FF008" in _ids(lint.lint_source(bad, "planted.py"))
+        # Registered names, dynamic names, unrelated emit APIs: clean.
+        ok = 'tel.emit("fault", mode="raise", step=2)\n'
+        assert "FF008" not in _ids(lint.lint_source(ok, "planted.py"))
+        ok = "tel.emit(name, x=1)\n"
+        assert "FF008" not in _ids(lint.lint_source(ok, "planted.py"))
+        ok = 'signal_bus.emit("made_up_event")\n'
+        assert "FF008" not in _ids(lint.lint_source(ok, "planted.py"))
+        # The emitter module itself is the one sanctioned home.
+        assert "FF008" not in _ids(lint.lint_source(
+            bad, "flexflow_tpu/runtime/telemetry.py"
+        ))
+        # The catalog copy is dependency-free; tests/test_obs.py pins
+        # it equal to obs.events.EVENT_CATALOG.
+        assert "run_start" in lint.FF008_EVENT_NAMES
+
     def test_planted_violation_in_temp_module(self, tmp_path):
         """End-to-end through lint_paths: a temp module on disk."""
         mod = tmp_path / "planted.py"

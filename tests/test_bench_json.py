@@ -187,6 +187,15 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert dp["throttled_stream_samples_per_s"] == 900.0
     assert dp["throttled_unprefetched_samples_per_s"] == 450.0
     assert dp["throttled_overlap_speedup"] == 2.0
+    # The box-state fingerprint (obs/registry.py): pairs this artifact
+    # with telemetry runs for cross-run drift detection.  Every field
+    # present; values may be None on a degraded box but the schema is
+    # pinned here.
+    fp = record["extra"]["fingerprint"]
+    assert set(fp) == {"git_sha", "jax", "jaxlib", "platform",
+                       "devices", "host"}
+    assert fp["jax"] is not None
+    assert fp["platform"] == "cpu"
     # The chatter landed on stderr, not stdout.
     assert "tp = " in err.getvalue()
 

@@ -190,7 +190,14 @@ def test_chaos_log_reconstructs_run(tmp_path):
             out = rt.fit(iterations=iters, batch_fn=chaos_batch_fn,
                          save_every=8, steps_per_call=8)
     assert out["restarts"] == 1
-    events = _events(tel.path)
+    # The chaos log is read back through THE log reader (obs.reader):
+    # schema-validated events, replay-aware step reconstruction.
+    from flexflow_tpu.obs.reader import RunLog
+
+    log = RunLog.load(tel.path)
+    assert log.complete and log.exit == "clean"
+    assert not log.malformed and not log.unknown_events
+    events = list(log.iter_raw())
     tss = [e["ts"] for e in events]
     assert tss == sorted(tss)  # monotonic across fault/rollback/replay
     kinds = [e["ev"] for e in events]
@@ -212,11 +219,9 @@ def test_chaos_log_reconstructs_run(tmp_path):
     assert all(e["io_s"] >= 0 for e in saves + restores)
     assert all(e["async"] for e in saves)
     # Replaying the log alone reproduces the live run: last step event
-    # per index IS the validated loss (replays overwrite).
-    replayed = {}
-    for e in events:
-        if e["ev"] == "step":
-            replayed[e["step"]] = e["loss"]
+    # per index IS the validated loss (replays overwrite) — the exact
+    # semantics of RunLog.losses().
+    replayed = log.losses()
     assert sorted(replayed) == list(range(iters))
     assert replayed == out["losses"]
     assert replayed[iters - 1] == out["loss"]
@@ -358,8 +363,11 @@ def test_resilient_trainer_self_installs_from_config(tmp_path):
             iterations=4, batch_fn=chaos_batch_fn, save_every=4,
         )
     assert "telemetry" in out and out["telemetry"]["steps"] == 4
-    logs = [p for p in os.listdir(tmp_path / "tel") if p.endswith(".jsonl")]
+    # ONE run log; the registry index (runs.jsonl, obs/registry.py)
+    # rides alongside and deliberately misses the run-*.jsonl glob.
+    logs = [p for p in os.listdir(tmp_path / "tel") if p.startswith("run-")]
     assert len(logs) == 1
+    assert os.path.exists(tmp_path / "tel" / "runs.jsonl")
 
 
 def test_pipeline_clip_norm_fence_is_instrumented():
@@ -393,7 +401,7 @@ def test_two_runs_same_second_get_distinct_files(tmp_path):
     with Telemetry(str(tmp_path)) as b:
         pass
     assert a.path != b.path
-    assert len([p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]) == 2
+    assert len([p for p in os.listdir(tmp_path) if p.startswith("run-")]) == 2
 
 
 def test_cli_flags(tmp_path):
@@ -411,7 +419,7 @@ def test_config_wires_trainer(tmp_path):
     ex.config.stall_deadline_s = 0.0
     stats = Trainer(ex).fit(iterations=2, warmup=1)
     assert "telemetry" in stats
-    logs = [p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]
+    logs = [p for p in os.listdir(tmp_path) if p.startswith("run-")]
     assert len(logs) == 1
     events = _events(os.path.join(str(tmp_path), logs[0]))
     assert events[0]["ev"] == "run_start" and events[-1]["ev"] == "run_end"

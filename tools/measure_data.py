@@ -33,9 +33,7 @@ axon sitecustomize dropped, per CLAUDE.md; --tpu keeps the relay on
 PYTHONPATH and runs on the live chip.)
 """
 
-import json
 import os
-import statistics
 import subprocess
 import sys
 import tempfile
@@ -162,22 +160,21 @@ def child(argv):
             yield ex.shard_batch(src.read(pos, pos + batch))
             pos += batch
 
+    # The alternating-order paired protocol lives in
+    # obs.compare.paired_measure (shared with measure_telemetry.py);
+    # here the statistic is the RATIO form, control = two B legs.
+    from flexflow_tpu.obs.compare import paired_measure
+
     def paired_ratio(name, make_a, make_b, bar):
         """Median over reps of (A samples/s) / (B samples/s), with an
         A/A control run under the same alternating-order pairing."""
-        ratios, aa = [], []
-        for r in range(reps):
-            legs = [("a", make_a), ("b", make_b)]
-            if r % 2:
-                legs.reverse()  # cancel drift inside the pair
-            pair = {}
-            for kind, mk in legs:
-                pair[kind] = fit(mk())["samples_per_s"]
-            ratios.append(pair["a"] / pair["b"])
-            c1 = fit(make_b())["samples_per_s"]
-            c2 = fit(make_b())["samples_per_s"]
-            aa.append((c2 / c1) if r % 2 == 0 else (c1 / c2))
-        med, ctl = statistics.median(ratios), statistics.median(aa)
+        res = paired_measure(
+            make_a=lambda r: fit(make_a())["samples_per_s"],
+            make_b=lambda r: fit(make_b())["samples_per_s"],
+            reps=reps,
+            control=lambda r: fit(make_b())["samples_per_s"],
+        )
+        med, ctl = res.median_ratio, res.median_aa_ratio
         ok = "PASS" if med >= bar else "FAIL"
         print(f"{name:<22} {med:>7.3f}x  (bar >= {bar}x, a_a "
               f"{ctl:.3f}x) {ok}")
@@ -203,18 +200,16 @@ def child(argv):
                         inline_throttled_batches, bar=1.3):
         failures += 1
 
-    # Input-wait audit: JSONL events vs the folded summary, exact.
+    # Input-wait audit: JSONL events vs the folded summary, exact
+    # (parsed through the ONE log reader, obs.reader.RunLog).
+    from flexflow_tpu.obs.reader import RunLog
+
     with tempfile.TemporaryDirectory(prefix="data_ab_") as d:
         tel = Telemetry(os.path.join(d, "audit"))
         path = tel.path
         stats = fit(throttled_stream_batches(), tel=tel)
         summary = stats.get("telemetry", {})
-        events = []
-        with open(path) as f:
-            for line in f:
-                rec = json.loads(line)
-                if rec.get("ev") == "input_wait":
-                    events.append(rec)
+        events = RunLog.load(path).select("input_wait")
         total = round(sum(e["wall_s"] for e in events), 6)
         n_ok = summary.get("input_waits") == len(events)
         t_ok = summary.get("input_wait_s_total") == total

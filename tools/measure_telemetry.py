@@ -30,7 +30,6 @@ PYTHONPATH and runs on the live chip.)
 """
 
 import os
-import statistics
 import subprocess
 import sys
 import tempfile
@@ -146,33 +145,29 @@ def child(argv):
     else:
         print(f"pipeline regime skipped: {nd} device(s)", file=sys.stderr)
 
+    # The paired-median + A/A-control protocol now lives in
+    # obs.compare.paired_measure (this tool's local copy, promoted);
+    # ``a`` is the OFF leg, ``b`` the ON leg, the control runs two OFF
+    # legs under the same alternation.
+    from flexflow_tpu.obs.compare import paired_measure
+
     print(f"{'regime':<10} {'off ms/step':>12} {'on ms/step':>12} "
           f"{'overhead':>9} {'a_a_pct':>8}   (median of {reps} paired "
           f"A/B deltas, {iters} iters, {nd} devices)")
     for name, run in regimes:
-        offs, ons, deltas, aa = [], [], [], []
         with tempfile.TemporaryDirectory(prefix="tel_ab_") as d:
-            for r in range(reps):
-                legs = [
-                    ("off", lambda: run(None)),
-                    ("on", lambda r=r: run(os.path.join(d, f"{name}_{r}"))),
-                ]
-                if r % 2:
-                    legs.reverse()  # cancel drift inside the pair
-                pair = {}
-                for kind, fn in legs:
-                    pair[kind] = fn()["elapsed_s"] / iters * 1e3
-                offs.append(pair["off"])
-                ons.append(pair["on"])
-                deltas.append((pair["on"] - pair["off"]) / pair["off"] * 100)
-                # A/A control pair: two OFF runs, same pairing protocol.
-                c1 = run(None)["elapsed_s"] / iters * 1e3
-                c2 = run(None)["elapsed_s"] / iters * 1e3
-                aa.append(((c2 - c1) if r % 2 == 0 else (c1 - c2)) / c1 * 100)
-        print(f"{name:<10} {statistics.median(offs):>12.3f} "
-              f"{statistics.median(ons):>12.3f} "
-              f"{statistics.median(deltas):>8.2f}% "
-              f"{statistics.median(aa):>7.2f}%")
+            res = paired_measure(
+                make_a=lambda r: run(None)["elapsed_s"] / iters * 1e3,
+                make_b=lambda r, name=name: run(
+                    os.path.join(d, f"{name}_{r}")
+                )["elapsed_s"] / iters * 1e3,
+                reps=reps,
+                control=lambda r: run(None)["elapsed_s"] / iters * 1e3,
+            )
+        print(f"{name:<10} {res.median_a:>12.3f} "
+              f"{res.median_b:>12.3f} "
+              f"{res.median_delta_pct:>8.2f}% "
+              f"{res.median_aa_pct:>7.2f}%")
 
     # Deterministic accounting: this box's A/B wall clock swings more
     # between identical sessions than the cost being measured, so the

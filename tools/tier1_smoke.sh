@@ -29,6 +29,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
     tests/test_telemetry.py \
+    tests/test_obs.py \
     tests/test_data_stream.py \
     tests/test_serving.py \
     tests/test_search.py \
