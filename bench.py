@@ -780,6 +780,48 @@ def bench_serving(n_chips: int, on_tpu: bool):
     out["programs_per_decode_superstep"] = k8_stats[
         "programs_per_decode_superstep"
     ]
+
+    # Scheduler A/B (SERVING.md "Scheduler policy"): the same bursty
+    # open-loop workload under FIFO vs the SLO policy (tier+EDF
+    # admission, adaptive K, preemption).  All latency columns are
+    # VIRTUAL-clock values (deterministic, box-independent) — the
+    # scheduling win, not wall noise.
+    from flexflow_tpu.serving import (
+        ScheduledServer,
+        SchedulerPolicy,
+        WorkloadSpec,
+        make_workload,
+    )
+
+    def workload():
+        return make_workload(WorkloadSpec(
+            n_requests=2 * n_req, vocab=vocab,
+            prompt_len=(4, max_seq // 4), max_new=(2, max_new),
+            mean_gap_ms=2.0, burst=n_req, priorities=2, slo_ms=60.0,
+            seed=13,
+        ))
+
+    def run_sched(policy):
+        srv = ScheduledServer(sex, params, state, decode_steps=8,
+                              policy=policy)
+        _, stats = srv.run(workload())
+        return stats
+
+    slo = run_sched(SchedulerPolicy(name="slo"))
+    fifo = run_sched(SchedulerPolicy.fifo())
+    out["queue_wait_ms_p50"] = slo["queue_wait_ms_p50"]
+    out["queue_wait_ms_p95"] = slo["queue_wait_ms_p95"]
+    out["queue_wait_ms_p99"] = slo["queue_wait_ms_p99"]
+    out["e2e_ms_p99"] = slo["e2e_ms_p99"]
+    out["slo_attainment"] = slo["slo_attainment"]
+    out["request_sheds"] = slo["request_sheds"]
+    out["request_preempts"] = slo["request_preempts"]
+    out["fifo_queue_wait_ms_p99"] = fifo["queue_wait_ms_p99"]
+    out["fifo_slo_attainment"] = fifo["slo_attainment"]
+    out["fifo_vs_slo_queue_wait_p99"] = round(
+        fifo["queue_wait_ms_p99"] / max(slo["queue_wait_ms_p99"], 1e-9),
+        3,
+    )
     return out
 
 

@@ -365,6 +365,7 @@ FF008_EVENT_NAMES = frozenset({
     "analysis", "search",
     "request_start", "prefill", "decode_superstep", "request_end",
     "serving_program",
+    "sched_decision", "request_preempt", "request_shed",
 })
 
 #: Receiver names that mark an ``.emit(...)`` call as a telemetry

@@ -10,6 +10,11 @@ fused decode supersteps (one dispatch + one ``jax.device_get`` fence
 per K tokens across the whole slot batch), admit/evict between
 supersteps.
 
+Any scheduler flag below routes the run through the SLO-aware
+scheduler (``flexflow_tpu/serving/``, SERVING.md "Scheduler policy"):
+open-loop arrivals on a deterministic virtual clock, priority/EDF
+admission, adaptive decode-K, preemption and load shedding.
+
 Flags beyond the common set:
   --max-seq N        serving context length (cache rows per slot; 64)
   --max-batch N      decode slots (4)
@@ -18,11 +23,29 @@ Flags beyond the common set:
   --requests N       synthetic request count (8)
   --prompt-len LO:HI prompt length range (4:12)
   --max-new N        generation budget per request (16)
-  --arrival-every N  one request eligible every N decode supersteps
-                     (0 = all at start, the burst pattern)
   --eos ID           greedy EOS token id (unset = budget-bounded)
   --no-decode-kernel force the pure-jnp decode oracle (A/B, tests)
   --vocab --d-model --heads --layers   model shape (transformer app)
+
+Scheduler flags (each enables the scheduled path):
+  --sched POLICY     fifo | slo (default slo when another scheduler
+                     flag is present)
+  --workload-trace   zipf/bursty open-loop workload (data/trace.py
+                     shape) instead of the uniform stream
+  --trace-alpha A    zipf skew for prompt/output lengths (1.5)
+  --mean-gap-ms X    mean inter-arrival gap, virtual ms (8.0)
+  --burst N          requests arriving back-to-back per burst (4)
+  --slo-ms X         tier-0 SLO deadline, virtual ms (tier t gets
+                     X*(t+1); unset = best-effort)
+  --priorities N     priority tiers, 0 = highest (1)
+  --shed-depth N     shed waiting requests past this queue depth (0 =
+                     off)
+  --serve-auto       search (buckets x K x max_batch x policy knobs)
+                     against the calibrated serving latency model and
+                     run the winner (--calibration feeds constants)
+  --arrival-every N  DEPRECATED superstep-index arrival knob: now an
+                     alias for a uniform workload trace with one
+                     arrival per modeled superstep interval
 
 Example::
 
@@ -32,10 +55,11 @@ Example::
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-from flexflow_tpu.apps.common import check_help, pop_int
+from flexflow_tpu.apps.common import check_help, pop_float, pop_int
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.models.transformer import build_transformer_lm
 
@@ -52,11 +76,20 @@ def _pop_str(argv, flag, default):
     return val
 
 
-def _dry_run(sex, decode_steps: int) -> int:
+def _pop_flag(argv, flag):
+    if flag in argv:
+        argv.remove(flag)
+        return True
+    return False
+
+
+def _dry_run(sex, decode_ks) -> int:
     """Compute-free serving validation: eval_shape every prefill
-    bucket and the fused decode superstep, print the program/cache
-    table (the --dry-run contract of the training apps)."""
-    table = sex.abstract_programs(decode_steps=decode_steps)
+    bucket and every decode-superstep width the scheduler may
+    dispatch, print the program/cache table (the --dry-run contract of
+    the training apps)."""
+    decode_ks = sorted(set(decode_ks))
+    table = sex.abstract_programs(decode_steps=decode_ks[-1])
     print(f"{'program':<18} {'shape':<28} notes")
     for name, aval in sorted(table["cache"].items()):
         print(f"{'cache ' + name:<18} {str(tuple(aval.shape)):<28} "
@@ -65,16 +98,20 @@ def _dry_run(sex, decode_steps: int) -> int:
         print(f"{'prefill L=' + str(bucket):<18} "
               f"{'(1, ' + str(bucket) + ') -> token':<28} "
               f"1 dispatch + 1 fence per admission")
-    toks = table["decode"]
-    print(f"{'decode k=' + str(decode_steps):<18} "
-          f"{str(tuple(toks.shape)) + ' tokens':<28} "
-          f"1 dispatch + 1 fence per {decode_steps} tokens")
+    for k in decode_ks:
+        shape = (k,) + tuple(table["decode"].shape[1:])
+        print(f"{'decode k=' + str(k):<18} "
+              f"{str(shape) + ' tokens':<28} "
+              f"1 dispatch + 1 fence per {k} tokens")
     # The program audit over the exact serving programs this run would
-    # build (purity + K-tokens-per-dispatch accounting, ANALYSIS.md).
+    # build (purity + K-tokens-per-dispatch accounting, ANALYSIS.md) —
+    # every decode width the scheduler may choose is audited.
     from flexflow_tpu import analysis
     from flexflow_tpu.runtime import telemetry as _telemetry
 
-    violations = analysis.audit_serving(sex, decode_steps=decode_steps)
+    violations = []
+    for k in decode_ks:
+        violations += analysis.audit_serving(sex, decode_steps=k)
     print(analysis.summary_line(violations))
     for v in violations:
         print(f"  {v}")
@@ -84,6 +121,24 @@ def _dry_run(sex, decode_steps: int) -> int:
     )
     print("DRY RUN OK (no device compute)")
     return 0
+
+
+def _latency_model(cfg: FFConfig):
+    """Calibrated serving latency model: dispatch/fence constants via
+    the ``-s auto`` calibration resolution (``--calibration`` wins,
+    else the latest run under the telemetry dir), per-token slopes
+    fitted from that run's own serving events when it has any."""
+    from flexflow_tpu.apps.common import _resolve_calibration
+    from flexflow_tpu.obs.reader import RunLog
+    from flexflow_tpu.serving import ServingLatencyModel
+
+    cal = _resolve_calibration(cfg)
+    model = ServingLatencyModel.from_calibration(cal)
+    if cal.source and os.path.isfile(cal.source):
+        model = model.fit_events(
+            RunLog.load(cal.source).iter_raw(), source=cal.source
+        )
+    return model
 
 
 def main(argv=None) -> int:
@@ -102,14 +157,25 @@ def main(argv=None) -> int:
     layers = pop_int(argv, "--layers", 4)
     plen_s = _pop_str(argv, "--prompt-len", "4:12")
     buckets_s = _pop_str(argv, "--buckets", "")
-    no_kernel = "--no-decode-kernel" in argv
-    if no_kernel:
-        argv.remove("--no-decode-kernel")
+    no_kernel = _pop_flag(argv, "--no-decode-kernel")
+    # Scheduler flags (SERVING.md "Scheduler policy"): any of them
+    # routes through the SLO-aware scheduled path.
+    sched_s = _pop_str(argv, "--sched", "")
+    workload_trace = _pop_flag(argv, "--workload-trace")
+    trace_alpha = pop_float(argv, "--trace-alpha", 1.5)
+    mean_gap_ms = pop_float(argv, "--mean-gap-ms", 8.0)
+    burst = pop_int(argv, "--burst", 4)
+    slo_ms = pop_float(argv, "--slo-ms", 0.0)
+    priorities = pop_int(argv, "--priorities", 0)
+    shed_depth = pop_int(argv, "--shed-depth", 0)
+    serve_auto = _pop_flag(argv, "--serve-auto")
     cfg = FFConfig.parse_args(argv)
     try:
         lo, hi = (int(v) for v in plen_s.split(":"))
     except ValueError:
         raise SystemExit("--prompt-len expects LO:HI")
+    if sched_s and sched_s not in ("fifo", "slo"):
+        raise SystemExit(f"--sched expects fifo|slo, got {sched_s!r}")
     if buckets_s:
         buckets = tuple(int(b) for b in buckets_s.split(","))
     else:
@@ -117,6 +183,36 @@ def main(argv=None) -> int:
                                 max_seq}))
     buckets = tuple(b for b in buckets if b <= max_seq)
 
+    use_sched = bool(
+        sched_s or workload_trace or slo_ms > 0 or priorities > 0
+        or shed_depth > 0 or serve_auto or arrival_every > 0
+    )
+    if not use_sched:
+        return _run_legacy(
+            cfg, max_seq=max_seq, max_batch=max_batch,
+            decode_steps=decode_steps, n_requests=n_requests,
+            max_new=max_new, eos=eos, vocab=vocab, d_model=d_model,
+            heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
+            no_kernel=no_kernel,
+        )
+    return _run_scheduled(
+        cfg, max_seq=max_seq, max_batch=max_batch,
+        decode_steps=decode_steps, n_requests=n_requests,
+        max_new=max_new, eos=eos, vocab=vocab, d_model=d_model,
+        heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
+        no_kernel=no_kernel, policy_name=sched_s or "slo",
+        workload_trace=workload_trace, trace_alpha=trace_alpha,
+        mean_gap_ms=mean_gap_ms, burst=burst, slo_ms=slo_ms,
+        priorities=max(priorities, 1), shed_depth=shed_depth,
+        serve_auto=serve_auto, arrival_every=arrival_every,
+    )
+
+
+def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
+                max_new, eos, vocab, d_model, heads, layers, lo, hi,
+                buckets, no_kernel) -> int:
+    """The closed-loop FIFO path, unchanged — still the chaos decode-
+    fault harness and the scheduler's numerics oracle."""
     from flexflow_tpu.runtime import telemetry as _telemetry
     from flexflow_tpu.runtime.serving import (
         Server,
@@ -136,7 +232,7 @@ def main(argv=None) -> int:
         # Inside maybe_run so the dry run's `analysis` audit event
         # lands in the JSONL stream when telemetry is armed.
         with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
-            return _dry_run(sex, decode_steps)
+            return _dry_run(sex, [decode_steps])
 
     with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
         if cfg.ckpt_dir:
@@ -147,8 +243,7 @@ def main(argv=None) -> int:
             params, state = sex.init(cfg.seed)
         requests = synthetic_requests(
             n_requests, vocab, prompt_len=(lo, hi),
-            max_new_tokens=max_new, arrival_every=arrival_every,
-            seed=cfg.seed,
+            max_new_tokens=max_new, seed=cfg.seed,
         )
         srv = Server(sex, params, state, decode_steps=decode_steps,
                      eos_id=None if eos < 0 else eos)
@@ -164,6 +259,153 @@ def main(argv=None) -> int:
     print(f"decode supersteps = {stats['decode_supersteps']} "
           f"(k={stats['decode_steps_per_call']}, 1 dispatch + 1 fence "
           f"per superstep)")
+    return _report_failures(results, stats)
+
+
+def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
+                   max_new, eos, vocab, d_model, heads, layers, lo, hi,
+                   buckets, no_kernel, policy_name, workload_trace,
+                   trace_alpha, mean_gap_ms, burst, slo_ms, priorities,
+                   shed_depth, serve_auto, arrival_every) -> int:
+    from flexflow_tpu.runtime import telemetry as _telemetry
+    from flexflow_tpu.runtime.serving import ServingExecutor
+    from flexflow_tpu.runtime.trainer import relay_safe_steps
+    from flexflow_tpu.serving import (
+        ScheduledServer,
+        SchedulerPolicy,
+        ServingConfig,
+        SlotShape,
+        WorkloadSpec,
+        make_workload,
+        search_serving_config,
+        uniform_workload,
+    )
+
+    decode_steps = relay_safe_steps(decode_steps, what="decode_steps")
+    base_slo = slo_ms if slo_ms > 0 else float("inf")
+    if policy_name == "fifo":
+        policy = SchedulerPolicy.fifo()
+    else:
+        policy = SchedulerPolicy(name="slo", shed_depth=shed_depth)
+
+    with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
+        model = _latency_model(cfg)
+        if workload_trace:
+            requests = make_workload(WorkloadSpec(
+                n_requests=n_requests, vocab=vocab,
+                prompt_len=(lo, hi), prompt_alpha=trace_alpha,
+                max_new=(1, max_new), output_alpha=trace_alpha,
+                mean_gap_ms=mean_gap_ms, burst=burst,
+                priorities=priorities, slo_ms=base_slo, seed=cfg.seed,
+            ))
+        else:
+            every_ms = 0.0
+            if arrival_every > 0:
+                # The deprecated superstep-index knob, mapped onto the
+                # virtual clock: one arrival per N modeled supersteps.
+                every_ms = arrival_every * model.decode_ms(decode_steps)
+                print("WARNING: --arrival-every is deprecated; it now "
+                      "aliases a uniform workload trace (one arrival "
+                      f"per {every_ms:.2f} virtual ms). Use "
+                      "--workload-trace / serving.workload instead.")
+            requests = uniform_workload(
+                n_requests, vocab, prompt_len=(lo, hi),
+                max_new_tokens=max_new, every_ms=every_ms,
+                seed=cfg.seed, slo_ms=base_slo,
+            )
+
+        choice = None
+        if serve_auto:
+            baseline = ServingConfig(
+                buckets=buckets, decode_steps=decode_steps,
+                max_batch=max_batch, max_seq=max_seq, policy=policy,
+            )
+            res = search_serving_config(requests, baseline, model)
+            choice = res.chosen
+            if choice.config.to_json() == baseline.to_json():
+                print("serve-auto: the app's default serving config "
+                      "already wins the searched space; keeping it")
+            print(res.describe())
+            print(f"serve-auto: {model.describe()}")
+            buckets = choice.config.buckets
+            decode_steps = choice.config.decode_steps
+            max_batch = choice.config.max_batch
+            policy = choice.config.policy
+            _telemetry.current().emit(
+                "search", kind="serving",
+                chosen=choice.config.to_json(),
+                baseline=res.baseline.config.to_json(),
+                predicted_p99_ms=round(choice.predicted_p99_ms, 4),
+                baseline_predicted_p99_ms=round(
+                    res.baseline.predicted_p99_ms, 4),
+                predicted_dispatches=choice.predicted_dispatches,
+                latency_model=model.to_json(),
+                candidates=len(res.candidates),
+                wall_s=round(res.wall_s, 3),
+            )
+
+        ff = build_transformer_lm(
+            batch_size=max_batch, seq_len=max_seq, vocab_size=vocab,
+            d_model=d_model, num_heads=heads, num_layers=layers,
+            config=cfg,
+        )
+        sex = ServingExecutor(
+            ff, cfg, max_batch=max_batch, max_seq=max_seq,
+            buckets=buckets,
+            decode_kernel=False if no_kernel else None,
+        )
+        srv_proto = ScheduledServer.simulated(
+            SlotShape(max_batch=max_batch, max_seq=max_seq,
+                      buckets=buckets),
+            decode_steps=decode_steps, policy=policy,
+            latency_model=model,
+        )
+        if cfg.dry_run:
+            return _dry_run(sex, srv_proto._k_candidates)
+
+        if cfg.ckpt_dir:
+            step, params, state = sex.restore(cfg.ckpt_dir)
+            print(f"restored training checkpoint step {step} "
+                  f"from {cfg.ckpt_dir}")
+        else:
+            params, state = sex.init(cfg.seed)
+        srv = ScheduledServer(
+            sex, params, state, decode_steps=decode_steps,
+            eos_id=None if eos < 0 else eos, policy=policy,
+            latency_model=model,
+        )
+        t0 = time.perf_counter()
+        results, stats = srv.run(requests)
+        elapsed = time.perf_counter() - t0
+
+    print(f"policy = {policy.describe()}")
+    print(f"requests = {stats['requests']} "
+          f"completed = {stats['completed']} failed = {stats['failed']} "
+          f"shed = {stats['request_sheds']} "
+          f"preempted = {stats['request_preempts']}")
+    print(f"time = {elapsed:.4f}s")
+    print(f"tokens/s = {stats['tokens_per_s']:.1f}")
+    print(f"queue wait p50 = {stats['queue_wait_ms_p50']:.1f} ms "
+          f"p95 = {stats['queue_wait_ms_p95']:.1f} ms "
+          f"p99 = {stats['queue_wait_ms_p99']:.1f} ms (virtual)")
+    print(f"e2e p50 = {stats['e2e_ms_p50']:.1f} ms "
+          f"p99 = {stats['e2e_ms_p99']:.1f} ms (virtual)")
+    if "slo_attainment" in stats:
+        print(f"SLO attainment = {stats['slo_attainment'] * 100:.1f}%")
+    print(f"decode supersteps = {stats['decode_supersteps']} "
+          f"(k<={stats['decode_steps_per_call']}, 1 dispatch + 1 fence "
+          f"per superstep)")
+    if choice is not None:
+        print(f"serve-auto: predicted e2e p99 "
+              f"{choice.predicted_p99_ms:.3f} ms, measured "
+              f"{stats['e2e_ms_p99']:.3f} ms (virtual clock); "
+              f"predicted dispatches {choice.predicted_dispatches}, "
+              f"executed "
+              f"{stats['prefills'] + stats['decode_supersteps']}")
+    return _report_failures(results, stats)
+
+
+def _report_failures(results, stats) -> int:
     if stats["failed"]:
         for rid in sorted(results):
             r = results[rid]

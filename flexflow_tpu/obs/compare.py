@@ -38,6 +38,15 @@ from flexflow_tpu.obs.registry import fingerprint_diff
 DEFAULT_THRESHOLDS: Dict[str, float] = {
     "fences_per_step": 0.01,
     "programs_per_step": 0.01,
+    # Serving-scheduler accounting and virtual-clock latency rows
+    # (SERVING.md): sheds/preempts are decision COUNTS and the
+    # queue-wait/SLO metrics are deterministic virtual-clock values,
+    # so any change is a scheduling regression, not box noise.
+    "request_sheds": 0.01,
+    "request_preempts": 0.01,
+    "queue_wait_ms_p50": 0.01,
+    "queue_wait_ms_p99": 0.01,
+    "slo_attainment": 0.01,
     "step_ms_p50": 0.25,
     "step_ms_p95": 0.35,
     "dispatch_ms_per_program": 0.50,
@@ -47,6 +56,9 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
 
 #: Metrics read from the run summary vs the calibration block.
 _SUMMARY_METRICS = ("fences_per_step", "programs_per_step",
+                    "request_sheds", "request_preempts",
+                    "queue_wait_ms_p50", "queue_wait_ms_p99",
+                    "slo_attainment",
                     "step_ms_p50", "step_ms_p95", "input_wait_ms_p50")
 _CALIBRATION_METRICS = ("dispatch_ms_per_program", "fence_ms")
 

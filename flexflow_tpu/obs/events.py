@@ -49,6 +49,10 @@ EVENT_CATALOG = frozenset({
     "decode_superstep",
     "request_end",
     "serving_program",
+    # serving scheduler (SERVING.md "Scheduler policy")
+    "sched_decision",
+    "request_preempt",
+    "request_shed",
 })
 
 #: ``run_end.exit`` classifications (the reader adds ``truncated`` for

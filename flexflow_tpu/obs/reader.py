@@ -237,7 +237,9 @@ class RunLog:
         block when the log has one."""
         step_walls: List[float] = []
         input_waits: List[float] = []
-        steps = fences = 0
+        queue_waits: List[float] = []
+        slo_oks: List[bool] = []
+        steps = fences = sheds = preempts = 0
         for e in self.events:
             if e.ev == "step":
                 steps += 1
@@ -248,6 +250,19 @@ class RunLog:
                 fences += 1
             elif e.ev == "input_wait":
                 input_waits.append(float(e["wall_s"]))
+            elif e.ev == "request_end":
+                # Scheduler-era request_end events carry the rounded
+                # virtual-clock split (SERVING.md); legacy ones don't,
+                # and then no serving rows are reconstructed.
+                qw = e.get("queue_wait_ms")
+                if qw is not None:
+                    queue_waits.append(float(qw))
+                if e.get("slo_ok") is not None:
+                    slo_oks.append(bool(e["slo_ok"]))
+            elif e.ev == "request_shed":
+                sheds += 1
+            elif e.ev == "request_preempt":
+                preempts += 1
         out: Dict[str, Any] = {"steps": steps, "fences": fences}
         out["fences_per_step"] = round(fences / max(steps, 1), 4)
         if step_walls:
@@ -261,6 +276,19 @@ class RunLog:
             out["input_wait_ms_p95"] = round(_pct(ws, 0.95) * 1e3, 3)
             out["input_waits"] = len(ws)
             out["input_wait_s_total"] = round(sum(ws), 6)
+        if queue_waits:
+            # Percentiles over the events' already-rounded ms values —
+            # the scheduler's note_summary computes the same numbers
+            # from the same rounded inputs, so run_end and
+            # reconstruction agree bit-for-bit.
+            qs = sorted(queue_waits)
+            out["queue_wait_ms_p50"] = round(_pct(qs, 0.50), 3)
+            out["queue_wait_ms_p95"] = round(_pct(qs, 0.95), 3)
+            out["queue_wait_ms_p99"] = round(_pct(qs, 0.99), 3)
+            out["request_sheds"] = sheds
+            out["request_preempts"] = preempts
+        if slo_oks:
+            out["slo_attainment"] = round(sum(slo_oks) / len(slo_oks), 4)
         return out
 
     def summary(self) -> Dict[str, Any]:

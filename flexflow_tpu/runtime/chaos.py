@@ -417,6 +417,73 @@ def scenario_serving_decode_fault(root: str) -> Tuple[bool, str]:
                   "unfaulted run")
 
 
+def scenario_serving_overload_shed(root: str) -> Tuple[bool, str]:
+    """Scheduler overload shedding as a fault-injection property
+    (SERVING.md "Scheduler policy"): a bursty workload drives the
+    waiting queue past ``shed_depth``, the scheduler sheds the
+    worst-tier/latest-deadline requests — and because every shed
+    decision runs on the deterministic virtual clock, the SAME
+    requests are shed on every replay (decision log equality), while
+    every surviving request's token sequence stays byte-identical to a
+    no-shedding run of just the survivors (per-request outputs depend
+    only on prompt + params — scheduling may reorder, never corrupt).
+    """
+    from flexflow_tpu.serving import (
+        ScheduledServer,
+        SchedulerPolicy,
+        WorkloadSpec,
+        make_workload,
+    )
+
+    def overload():
+        # 10 requests in back-to-back bursts of 5 against 2 slots and
+        # shed_depth 3 — the queue must spill.
+        return make_workload(WorkloadSpec(
+            n_requests=10, vocab=32, prompt_len=(3, 6), max_new=(2, 8),
+            mean_gap_ms=1.0, burst=5, priorities=2, slo_ms=30.0,
+            seed=11,
+        ))
+
+    policy = SchedulerPolicy(name="slo", preempt=False, shed_depth=3)
+    sex, params, state = _serving_setup()
+
+    def run_shedding():
+        srv = ScheduledServer(sex, params, state, decode_steps=4,
+                              policy=policy)
+        results, stats = srv.run(overload())
+        return srv.decisions, results, stats
+
+    dec_a, res_a, stats_a = run_shedding()
+    shed_a = sorted(rid for rid, r in res_a.items()
+                    if r.error and r.error.startswith("shed"))
+    if not shed_a:
+        return False, "overload_shed: burst never tripped shed_depth"
+    other_err = [rid for rid, r in res_a.items()
+                 if r.error and rid not in shed_a]
+    if other_err:
+        return False, (f"overload_shed: non-shed errors on {other_err}")
+    dec_b, res_b, _ = run_shedding()
+    shed_b = sorted(rid for rid, r in res_b.items()
+                    if r.error and r.error.startswith("shed"))
+    if shed_a != shed_b or dec_a != dec_b:
+        return False, (f"overload_shed: replay DIVERGED — shed "
+                       f"{shed_a} vs {shed_b}")
+    survivors = [r for r in overload() if r.id not in shed_a]
+    no_shed = SchedulerPolicy(name="slo", preempt=False, shed_depth=0)
+    res_c, _ = ScheduledServer(sex, params, state, decode_steps=4,
+                               policy=no_shed).run(survivors)
+    if any(r.error for r in res_c.values()):
+        return False, "overload_shed: survivors-only run had errors"
+    for rid in res_c:
+        if res_a[rid].tokens != res_c[rid].tokens:
+            return False, (f"overload_shed: survivor {rid}'s tokens "
+                           f"DIVERGED from the no-shedding run")
+    return True, (f"overload_shed: requests {shed_a} shed "
+                  f"deterministically across replays; all "
+                  f"{len(res_c)} survivors byte-identical to the "
+                  f"no-shedding run")
+
+
 SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "raised_fault": scenario_raised_fault,
     "nan_batch": scenario_nan_batch,
@@ -427,6 +494,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "pipeline_superstep_nan": scenario_pipeline_superstep_nan,
     "loader_fault": scenario_loader_fault,
     "serving_decode_fault": scenario_serving_decode_fault,
+    "serving_overload_shed": scenario_serving_overload_shed,
 }
 
 

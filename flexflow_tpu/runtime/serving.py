@@ -128,14 +128,30 @@ class ServingFaultInjector:
 
 @dataclasses.dataclass
 class Request:
-    """One generation request.  ``arrival`` is the decode-superstep
-    index at which the request becomes eligible for admission (0 =
-    available at start) — the synthetic closed-loop arrival knob."""
+    """One generation request.
+
+    ``arrival_ms`` / ``priority`` / ``slo_ms`` are the open-loop
+    scheduling fields (``flexflow_tpu/serving/``, SERVING.md): arrival
+    on the scheduler's virtual clock, priority tier (0 = highest), and
+    the end-to-end deadline in virtual ms (inf = best-effort).
+
+    ``arrival`` — the decode-superstep index at which the request
+    becomes eligible in the legacy closed-loop :class:`Server` —
+    is DEPRECATED in favor of workload-driven ``arrival_ms``
+    (``serving/workload.py``); it is kept as an alias for one release
+    so existing closed-loop call sites keep working."""
 
     id: int
     prompt: np.ndarray  # 1-D int32 token ids
     max_new_tokens: int = 16
-    arrival: int = 0
+    arrival: int = 0    # deprecated: superstep-index eligibility knob
+    arrival_ms: float = 0.0
+    priority: int = 0
+    slo_ms: float = float("inf")
+
+    @property
+    def deadline_ms(self) -> float:
+        return self.arrival_ms + self.slo_ms
 
 
 @dataclasses.dataclass
@@ -716,7 +732,22 @@ def synthetic_requests(
     benchmarking: prompt lengths uniform in ``prompt_len`` (inclusive),
     ids uniform over the vocab, one request becoming eligible every
     ``arrival_every`` decode supersteps (0 = all at start — the burst
-    pattern)."""
+    pattern).
+
+    ``arrival_every > 0`` is DEPRECATED: the superstep-index arrival
+    knob is replaced by the open-loop workload generator
+    (``serving/workload.py``; ``uniform_workload`` is the direct
+    alias) — kept for one release."""
+    if arrival_every:
+        import warnings
+
+        warnings.warn(
+            "synthetic_requests(arrival_every=...) and Request.arrival "
+            "are deprecated: use flexflow_tpu.serving.workload "
+            "(uniform_workload / make_workload) arrival_ms-driven "
+            "arrivals instead",
+            DeprecationWarning, stacklevel=2,
+        )
     rng = np.random.default_rng(seed)
     lo, hi = prompt_len
     out = []

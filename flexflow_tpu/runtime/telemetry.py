@@ -123,6 +123,9 @@ class _NullTelemetry:
     def heartbeat(self, label: str = "beat") -> None:
         pass
 
+    def note_summary(self, **fields) -> None:
+        pass
+
     def step_summary(self) -> Dict[str, Any]:
         return {}
 
@@ -264,6 +267,10 @@ class Telemetry:
         #: non-warmup/final fence is the round-trip floor estimate;
         #: search/cost_model.Calibration).
         self.fence_times: List[tuple] = []
+        #: Subsystem-noted summary rows (:meth:`note_summary`), merged
+        #: into :meth:`step_summary` last so the serving scheduler's
+        #: virtual-clock metrics ride the run_end summary block.
+        self._extra_summary: Dict[str, Any] = {}
         self._hb_path = (
             heartbeat_path
             or os.environ.get("FF_HEARTBEAT_FILE")
@@ -558,6 +565,16 @@ class Telemetry:
 
     # -- summaries ----------------------------------------------------------
 
+    def note_summary(self, **fields) -> None:
+        """Stash subsystem-computed summary rows (the serving
+        scheduler's queue-wait percentiles / SLO attainment,
+        SERVING.md) to be merged into :meth:`step_summary` — and so
+        into the ``run_end`` summary block, where ``RunLog.summary``
+        reads them.  Values must already carry their final rounding:
+        ``reconstruct_summary`` recomputes them from raw events and
+        the two must match bit-for-bit."""
+        self._extra_summary.update(fields)
+
     def step_summary(self) -> Dict[str, Any]:
         """Counters + host-side step-time percentiles (p50/p95/max ms,
         nearest-rank) — the block folded into fit stats and bench.py."""
@@ -593,6 +610,7 @@ class Telemetry:
             out["input_wait_ms_p95"] = round(wpct(0.95) * 1e3, 3)
             out["input_waits"] = len(ws)
             out["input_wait_s_total"] = round(sum(ws), 6)
+        out.update(self._extra_summary)
         return out
 
     def fold_stats(self, stats: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,39 @@
+"""SLO-aware serving scheduler (SERVING.md "Scheduler policy").
+
+The layer ABOVE ``runtime/serving.py`` (which owns programs, caches
+and slots): trace-driven open-loop arrivals (``workload``), the
+latency-aware continuous batcher with priorities / preemption /
+shedding on a deterministic virtual clock (``scheduler``), the
+calibrated serving cost model (``latency_model``) and the
+``--serve-auto`` config search (``search``).
+"""
+
+from flexflow_tpu.serving.latency_model import ServingLatencyModel
+from flexflow_tpu.serving.scheduler import (
+    ScheduledServer,
+    SchedulerPolicy,
+    SlotShape,
+)
+from flexflow_tpu.serving.search import (
+    ServingConfig,
+    ServingSearchResult,
+    search_serving_config,
+)
+from flexflow_tpu.serving.workload import (
+    WorkloadSpec,
+    make_workload,
+    uniform_workload,
+)
+
+__all__ = [
+    "ServingLatencyModel",
+    "ScheduledServer",
+    "SchedulerPolicy",
+    "SlotShape",
+    "ServingConfig",
+    "ServingSearchResult",
+    "search_serving_config",
+    "WorkloadSpec",
+    "make_workload",
+    "uniform_workload",
+]

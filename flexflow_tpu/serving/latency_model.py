@@ -1,0 +1,141 @@
+"""Serving latency model: modeled prefill/decode costs in virtual ms.
+
+The SEARCH.md cost-model discipline applied to serving: dispatch and
+fence constants come from :class:`flexflow_tpu.search.cost_model.
+Calibration` (fitted on a run's own JSONL, or the uncalibrated
+defaults), and the per-token compute slopes are fitted from a SERVING
+run's own ``prefill`` / ``decode_superstep`` events when one is
+available (:meth:`ServingLatencyModel.fit_events`).
+
+Program shapes being priced (runtime/serving.py):
+
+- prefill bucket L: one dispatch + one fence + L tokens of
+  full-sequence forward -> ``dispatch_ms + fence_ms + L * prefill_token_ms``
+- decode superstep k: one dispatch + one fence + k fused single-token
+  steps over the whole slot batch ->
+  ``dispatch_ms + fence_ms + k * decode_token_ms``
+  (batch-width-free: the batch dim rides inside the one program).
+
+The scheduler's virtual clock advances by exactly these quantities, so
+"predicted" and "scheduled" time are the same number by construction —
+the honest currency is the DISPATCH/FENCE COUNT, which the telemetry
+accounting audits exactly (tests/test_serving_sched.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Optional
+
+#: Fallback per-token slopes (virtual ms) when no serving run has been
+#: fitted yet — small next to the relay's dispatch floor, which is the
+#: regime the real box measures (BASELINE.md ~16 ms/call).
+DEFAULT_PREFILL_TOKEN_MS = 0.05
+DEFAULT_DECODE_TOKEN_MS = 0.2
+
+
+@dataclasses.dataclass
+class ServingLatencyModel:
+    dispatch_ms: float
+    fence_ms: float
+    prefill_token_ms: float = DEFAULT_PREFILL_TOKEN_MS
+    decode_token_ms: float = DEFAULT_DECODE_TOKEN_MS
+    calibrated: bool = False
+    source: Optional[str] = None
+
+    # -- the two program prices ---------------------------------------------
+
+    def prefill_ms(self, bucket: int) -> float:
+        return self.dispatch_ms + self.fence_ms + \
+            bucket * self.prefill_token_ms
+
+    def decode_ms(self, k: int) -> float:
+        return self.dispatch_ms + self.fence_ms + k * self.decode_token_ms
+
+    def describe(self) -> str:
+        tag = f"calibrated from {self.source}" if self.calibrated else \
+            "uncalibrated defaults"
+        return (f"serving latency model ({tag}): dispatch "
+                f"{self.dispatch_ms:.3f} + fence {self.fence_ms:.3f} ms, "
+                f"prefill {self.prefill_token_ms:.4f} ms/token, decode "
+                f"{self.decode_token_ms:.4f} ms/token")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "dispatch_ms": round(self.dispatch_ms, 4),
+            "fence_ms": round(self.fence_ms, 4),
+            "prefill_token_ms": round(self.prefill_token_ms, 5),
+            "decode_token_ms": round(self.decode_token_ms, 5),
+            "calibrated": self.calibrated,
+            "source": self.source,
+        }
+
+    # -- constructors --------------------------------------------------------
+
+    @staticmethod
+    def from_calibration(cal=None) -> "ServingLatencyModel":
+        """Dispatch/fence constants from an execution-search
+        :class:`Calibration` (None = the uncalibrated defaults);
+        per-token slopes stay at the defaults until a serving run is
+        fitted on top (:meth:`fit_events`)."""
+        if cal is None:
+            from flexflow_tpu.search.cost_model import Calibration
+
+            cal = Calibration()
+        return ServingLatencyModel(
+            dispatch_ms=float(cal.dispatch_ms),
+            fence_ms=float(cal.fence_ms),
+            calibrated=bool(cal.calibrated),
+            source=cal.source,
+        )
+
+    def fit_events(self, events: Iterable[Any],
+                   source: Optional[str] = None) -> "ServingLatencyModel":
+        """Fit the per-token slopes from a serving run's own raw
+        events (``prefill`` carries ``bucket``/``wall_s``;
+        ``decode_superstep`` carries ``k``/``wall_s``): slope = median
+        of ``(wall_ms - dispatch_ms - fence_ms) / tokens``, floored at
+        0 — one robust point per event, no regression machinery.
+        Returns a NEW model; self is untouched."""
+        pf, dc = [], []
+        overhead = self.dispatch_ms + self.fence_ms
+        for ev in events:
+            kind = ev.get("ev")
+            wall = ev.get("wall_s")
+            if wall is None:
+                continue
+            wall_ms = float(wall) * 1e3
+            if kind == "prefill" and ev.get("bucket"):
+                pf.append(max(wall_ms - overhead, 0.0)
+                          / float(ev["bucket"]))
+            elif kind == "decode_superstep" and ev.get("k"):
+                dc.append(max(wall_ms - overhead, 0.0) / float(ev["k"]))
+
+        def med(xs, default):
+            if not xs:
+                return default
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        return ServingLatencyModel(
+            dispatch_ms=self.dispatch_ms,
+            fence_ms=self.fence_ms,
+            prefill_token_ms=med(pf, self.prefill_token_ms),
+            decode_token_ms=med(dc, self.decode_token_ms),
+            calibrated=self.calibrated or bool(pf or dc),
+            source=source or self.source,
+        )
+
+    @staticmethod
+    def from_run(run, cal=None) -> "ServingLatencyModel":
+        """Constants from ``cal`` (or the run's own calibration block)
+        + slopes fitted from the run's serving events.  ``run`` is an
+        ``obs.reader.RunLog``."""
+        if cal is None:
+            from flexflow_tpu.search.cost_model import Calibration
+
+            block = run.calibration()
+            cal = Calibration.from_summary(block, source=run.path) \
+                if block else Calibration()
+        base = ServingLatencyModel.from_calibration(cal)
+        return base.fit_events(run.iter_raw(), source=run.path)

@@ -1,0 +1,636 @@
+"""SLO-aware continuous batcher over the serving runtime.
+
+``runtime/serving.py`` keeps owning programs, caches and slots; this
+layer replaces its closed FIFO admit loop with a latency-aware
+scheduler (SERVING.md "Scheduler policy"):
+
+- **Virtual clock.**  Every decision and every latency number runs on
+  a deterministic clock in modeled ms: admission advances it by
+  ``latency_model.prefill_ms(bucket)``, a decode superstep by
+  ``decode_ms(k)``, and arrivals (``Request.arrival_ms``,
+  ``serving/workload.py``) become visible when the clock passes them.
+  Queue-wait, e2e latency and SLO attainment are all virtual-clock
+  quantities — bit-identical across replays and across boxes, which is
+  what makes the FIFO-vs-SLO A/B (tools/measure_serving.py) and the
+  chaos shed scenario exact.  Wall time is still measured for
+  throughput stats, but no decision ever reads it.
+- **Policies.**  ``fifo`` reproduces the legacy discipline inside the
+  new loop (arrival order, fixed decode k, no priorities/preemption/
+  shedding) — the A/B baseline.  ``slo`` orders admission by
+  (priority tier, deadline) — EDF within tier — adapts the decode
+  fusion width k against the latency model, preempts lowest-tier
+  slots for deadline-infeasible waiters, and sheds past a queue-depth
+  bound.
+- **Adaptive k.**  Per superstep, k minimizes modeled system-time per
+  useful token: ``decode_ms(k) * (active + waiting) / sum_j min(k,
+  remaining_j)`` over a bounded candidate set (compile cache stays
+  small; relay clamp applies).  Deep queues push k down (slots free
+  and admit sooner); drained queues push k up (dispatch amortization,
+  the superstep thesis).
+- **Preemption.**  A waiting request whose deadline is infeasible
+  under natural slot turnover may evict a strictly-lower-tier slot:
+  the victim re-queues with its generated tokens carried, and
+  re-admission re-prefills over (prompt ‖ carried) — per-request
+  greedy outputs stay byte-identical to the unpreempted run (the
+  slot-independence invariant; pinned in tests/test_serving_sched.py).
+- **Shedding.**  Past ``shed_depth`` waiting requests, the worst
+  (largest tier, latest deadline) are refused with a ``request_shed``
+  event — the overload valve, deterministic across replays.
+
+A compute-free **simulate** mode runs the same loop against fabricated
+tokens (no jax, no device): the serving-config search prices
+candidates with the exact decision logic that will run them, and the
+dispatch-count accounting (prefills, supersteps) of a simulated run
+matches the real run's telemetry counters exactly (EOS disabled —
+token VALUES are the only thing simulation cannot know).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.runtime import telemetry as _telemetry
+from flexflow_tpu.runtime.serving import (
+    Request,
+    RequestResult,
+    ServingExecutor,
+)
+from flexflow_tpu.serving.latency_model import ServingLatencyModel
+
+_log = logging.getLogger("ff.serving.sched")
+
+#: Decode-k candidates the adaptive policy may choose from (unioned
+#: with the configured k, filtered to the relay-safe clamp): bounded
+#: so the compiled decode-program cache stays small.
+ADAPTIVE_K_CANDIDATES = (1, 2, 4, 8, 16)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerPolicy:
+    """The scheduler's knobs — everything ``--serve-auto`` may search
+    over beyond the executor shape."""
+
+    name: str = "slo"                 # "fifo" | "slo"
+    adaptive_k: bool = True           # slo only: latency-model k choice
+    preempt: bool = True              # slo only: tiered eviction
+    shed_depth: int = 0               # waiting-queue bound; 0 = off
+    max_preempts_per_request: int = 1
+
+    def __post_init__(self):
+        if self.name not in ("fifo", "slo"):
+            raise ValueError(f"unknown scheduler policy {self.name!r}")
+        if self.shed_depth < 0:
+            raise ValueError("shed_depth must be >= 0")
+
+    @staticmethod
+    def fifo() -> "SchedulerPolicy":
+        return SchedulerPolicy(name="fifo", adaptive_k=False,
+                               preempt=False, shed_depth=0)
+
+    def describe(self) -> str:
+        if self.name == "fifo":
+            return "fifo (arrival order, fixed k)"
+        bits = ["slo (tier+EDF admission"]
+        bits.append("adaptive k" if self.adaptive_k else "fixed k")
+        if self.preempt:
+            bits.append("preempt")
+        if self.shed_depth:
+            bits.append(f"shed>{self.shed_depth}")
+        return ", ".join(bits) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotShape:
+    """The executor surface the simulate mode needs — mirrors the
+    real :class:`ServingExecutor` validation so a config that
+    simulates is a config the executor accepts."""
+
+    max_batch: int
+    max_seq: int
+    buckets: Tuple[int, ...]
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        bks = tuple(sorted(set(int(b) for b in self.buckets)))
+        if not bks or any(b < 1 or b > self.max_seq for b in bks):
+            raise ValueError(
+                f"buckets must be in [1, max_seq]: {list(self.buckets)}"
+            )
+        object.__setattr__(self, "buckets", bks)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest pad "
+            f"bucket {self.buckets[-1]} (max_seq={self.max_seq})"
+        )
+
+
+class _RealEngine:
+    """Device-backed engine: the ServingExecutor program families,
+    with the legacy loop's telemetry discipline (program_cost at call
+    sites, labeled fences)."""
+
+    simulated = False
+
+    def __init__(self, ex: ServingExecutor, params, op_state):
+        self.ex = ex
+        self.params = params
+        self.op_state = op_state
+        self.caches = ex.init_cache()
+
+    def prefill(self, prompt: np.ndarray, bucket: int, slot_i: int):
+        """Pad-to-bucket prefill + cache-row install into ``slot_i``:
+        returns ``(first_token, finite, wall_s)`` after one fence."""
+        tel = _telemetry.current()
+        ex = self.ex
+        plen = len(prompt)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = np.asarray(prompt, np.int32)
+        t0 = time.perf_counter()
+        tel.program_cost(
+            "prefill", ex.build_prefill(bucket),
+            (self.params, self.op_state, padded, np.int32(plen)),
+            bucket=bucket)
+        rows, tok0, okf = ex.build_prefill(bucket)(
+            self.params, self.op_state, padded, np.int32(plen)
+        )
+        tok0, ok = tel.fence((tok0, okf), "prefill")
+        wall = time.perf_counter() - t0
+        if bool(ok):
+            self.caches = ex.install(self.caches, rows, slot_i)
+        return int(tok0), bool(ok), wall
+
+    def decode(self, pos_vec: np.ndarray, tok_vec: np.ndarray, k: int):
+        """One fused k-token superstep over the whole slot batch:
+        ``(tokens (k, B), finite (k, B), wall_s)`` after one fence."""
+        tel = _telemetry.current()
+        fn = self.ex.build_decode_superstep(k)
+        t0 = time.perf_counter()
+        tel.program_cost(
+            "decode_superstep", fn,
+            (self.params, self.op_state, self.caches, pos_vec, tok_vec),
+            k=k)
+        self.caches, _pos, _tok, (toks, oks) = fn(
+            self.params, self.op_state, self.caches, pos_vec, tok_vec
+        )
+        host_toks, host_oks = tel.fence((toks, oks), "decode_superstep")
+        return host_toks, host_oks, time.perf_counter() - t0
+
+
+class _SimEngine:
+    """Compute-free engine: fabricated (finite) tokens, zero wall.
+    Token values are synthetic; decision-relevant quantities (counts,
+    positions, budgets) are exact — see the module docstring."""
+
+    simulated = True
+
+    def __init__(self, shape: SlotShape):
+        self.shape = shape
+
+    def prefill(self, prompt, bucket, slot_i):
+        return 1, True, 0.0
+
+    def decode(self, pos_vec, tok_vec, k):
+        B = len(pos_vec)
+        toks = np.ones((k, B), np.int32)
+        oks = np.ones((k, B), bool)
+        return toks, oks, 0.0
+
+
+@dataclasses.dataclass
+class _SchedSlot:
+    request: Request
+    pos: int
+    last_tok: int
+    tokens: List[int]          # tokens generated THIS occupancy
+    carried: List[int]         # tokens carried over preemptions
+    admit_v: float             # vclock at FIRST admission
+    t_wall0: float
+    prefill_s: float
+    preempts: int = 0
+
+    @property
+    def all_tokens(self) -> List[int]:
+        return self.carried + self.tokens
+
+    def remaining(self, max_seq: int) -> int:
+        budget = self.request.max_new_tokens - len(self.all_tokens)
+        return max(min(budget, max_seq - self.pos), 0)
+
+
+class ScheduledServer:
+    """The scheduling loop.  Construct with a real executor
+    (:meth:`__init__`) or compute-free (:meth:`simulated`); ``run``
+    returns ``(results, stats)`` like the legacy ``Server`` plus the
+    scheduler's decision log on ``self.decisions``."""
+
+    def __init__(
+        self,
+        executor: ServingExecutor,
+        params,
+        op_state,
+        decode_steps: int = 8,
+        eos_id: Optional[int] = None,
+        policy: Optional[SchedulerPolicy] = None,
+        latency_model: Optional[ServingLatencyModel] = None,
+        _engine=None,
+    ):
+        from flexflow_tpu.runtime.trainer import relay_safe_steps
+
+        self.ex = executor
+        self.policy = policy or SchedulerPolicy()
+        self.model = latency_model or ServingLatencyModel.from_calibration()
+        self.decode_steps = relay_safe_steps(
+            decode_steps, what="decode_steps", log=_log
+        )
+        self.eos_id = eos_id
+        self.engine = _engine or _RealEngine(executor, params, op_state)
+        #: The replayable decision trace: one dict per admit / evict /
+        #: shed / reject / decode / advance decision, vclock-stamped.
+        self.decisions: List[Dict[str, Any]] = []
+        # Bounded k candidate set (compile cache stays small).
+        ks = set(ADAPTIVE_K_CANDIDATES) | {self.decode_steps}
+        self._k_candidates = tuple(sorted(
+            k for k in ks if 1 <= k <= self.decode_steps
+        )) if self.policy.adaptive_k else (self.decode_steps,)
+
+    @classmethod
+    def simulated(
+        cls,
+        shape: SlotShape,
+        decode_steps: int = 8,
+        policy: Optional[SchedulerPolicy] = None,
+        latency_model: Optional[ServingLatencyModel] = None,
+    ) -> "ScheduledServer":
+        """The compute-free pricing loop (no jax touched): identical
+        decisions and dispatch counts to a real run of the same
+        (workload, config, policy) with EOS off."""
+        return cls(shape, None, None, decode_steps=decode_steps,
+                   eos_id=None, policy=policy, latency_model=latency_model,
+                   _engine=_SimEngine(shape))
+
+    # -- policy orderings ---------------------------------------------------
+
+    def _admit_key(self, r: Request):
+        if self.policy.name == "fifo":
+            return (r.arrival_ms, r.id)
+        return (r.priority, r.deadline_ms, r.arrival_ms, r.id)
+
+    @staticmethod
+    def _shed_key(r: Request):
+        # Worst-first: largest tier, latest deadline, largest id.
+        return (r.priority, r.deadline_ms, r.id)
+
+    def _choose_k(self, slots, waiting: int) -> int:
+        """Modeled system-time per useful token, argmin over the
+        candidate set (smallest k wins ties)."""
+        active = [sl for sl in slots if sl is not None]
+        if len(self._k_candidates) == 1 or not active:
+            return self.decode_steps
+        rems = [max(sl.remaining(self._max_seq()), 1) for sl in active]
+        payers = len(active) + waiting
+        best_k, best_score = None, None
+        for k in self._k_candidates:
+            useful = sum(min(k, rem) for rem in rems)
+            score = self.model.decode_ms(k) * payers / useful
+            if best_score is None or score < best_score - 1e-12:
+                best_k, best_score = k, score
+        return best_k
+
+    def _max_seq(self) -> int:
+        return self.ex.max_seq
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self, requests: Sequence[Request]):
+        tel = _telemetry.current()
+        ex, pol, model = self.ex, self.policy, self.model
+        B = ex.max_batch
+        vclock = 0.0
+        pending = sorted(requests, key=lambda r: (r.arrival_ms, r.id))
+        waiting: List[Request] = []
+        slots: List[Optional[_SchedSlot]] = [None] * B
+        results: Dict[int, RequestResult] = {}
+        #: id -> (first-admission vclock, generated tokens carried
+        #: across preemptions, preempt count) for re-queued requests.
+        carried: Dict[int, Tuple[float, List[int], int]] = {}
+        qwaits: Dict[int, float] = {}   # id -> queue wait (vclock ms)
+        e2es: Dict[int, float] = {}
+        slo_oks: Dict[int, bool] = {}
+        sheds = preempts = prefills = supersteps = 0
+        total_tokens = 0
+        decode_s = 0.0
+        t_wall0 = time.perf_counter()
+
+        def log(d: str, **fields):
+            rec = {"d": d, "v": round(vclock, 3)}
+            rec.update(fields)
+            self.decisions.append(rec)
+
+        def finish_result(r: Request, toks: List[int], err: Optional[str],
+                          admit_v: Optional[float], wall0: float,
+                          pf_s: float = 0.0):
+            qw = round((admit_v if admit_v is not None else vclock)
+                       - r.arrival_ms, 3)
+            e2e = round(vclock - r.arrival_ms, 3)
+            qwaits[r.id] = qw
+            e2es[r.id] = e2e
+            fields: Dict[str, Any] = {}
+            if math.isfinite(r.slo_ms):
+                ok = err is None and e2e <= r.slo_ms
+                slo_oks[r.id] = ok
+                fields["slo_ok"] = ok
+            results[r.id] = RequestResult(
+                id=r.id, prompt_len=len(r.prompt), tokens=list(toks),
+                error=err, latency_s=time.perf_counter() - wall0,
+                prefill_s=pf_s,
+            )
+            tel.emit("request_end", id=r.id, tokens=len(toks), error=err,
+                     latency_s=round(results[r.id].latency_s, 6),
+                     queue_wait_ms=qw, e2e_ms=e2e, **fields)
+
+        def finish_slot(slot_i: int, err: Optional[str] = None):
+            sl = slots[slot_i]
+            finish_result(sl.request, sl.all_tokens, err, sl.admit_v,
+                          sl.t_wall0, sl.prefill_s)
+            slots[slot_i] = None
+
+        def slot_done(sl: _SchedSlot) -> bool:
+            toks = sl.all_tokens
+            if self.eos_id is not None and toks and \
+                    toks[-1] == self.eos_id:
+                return True
+            if len(toks) >= sl.request.max_new_tokens:
+                return True
+            return sl.pos >= ex.max_seq
+
+        def scan_arrivals():
+            while pending and pending[0].arrival_ms <= vclock + 1e-9:
+                r = pending.pop(0)
+                try:
+                    ex.bucket_for(len(r.prompt))
+                except ValueError as e:
+                    # Infeasible prompt: refuse on arrival with the
+                    # legacy complete start/end event pair.
+                    tel.emit("request_start", id=r.id,
+                             prompt_len=len(r.prompt), bucket=None,
+                             slot=None)
+                    log("reject", id=r.id, reason="no_bucket")
+                    finish_result(r, [], str(e), None, t_wall0)
+                    continue
+                waiting.append(r)
+
+        def projected_free_ms() -> float:
+            """Modeled time until a slot frees by natural turnover."""
+            rems = [sl.remaining(ex.max_seq) for sl in slots
+                    if sl is not None]
+            if not rems:
+                return 0.0
+            k = self._choose_k(slots, len(waiting))
+            return model.decode_ms(k) * math.ceil(max(min(rems), 1) / k)
+
+        def try_preempt(cand: Request) -> Optional[int]:
+            """Evict a strictly-lower-tier slot for a deadline-
+            infeasible waiter; None = no eviction."""
+            nonlocal preempts
+            if pol.name != "slo" or not pol.preempt:
+                return None
+            if not math.isfinite(cand.deadline_ms):
+                return None
+            slack = cand.deadline_ms - vclock
+            bucket = ex.bucket_for(len(cand.prompt))
+            need = model.prefill_ms(bucket) + model.decode_ms(
+                self._k_candidates[0]
+            ) * math.ceil(max(cand.max_new_tokens, 1)
+                          / self._k_candidates[0])
+            if slack >= projected_free_ms() + need or slack < need:
+                # Feasible by waiting, or already lost: don't evict.
+                return None
+            victims = [
+                (sl.request.priority, sl.request.deadline_ms,
+                 sl.request.id, i)
+                for i, sl in enumerate(slots)
+                if sl is not None
+                and sl.request.priority > cand.priority
+                and sl.preempts < pol.max_preempts_per_request
+                and len(sl.request.prompt) + len(sl.all_tokens)
+                    <= ex.buckets[-1]
+            ]
+            if not victims:
+                return None
+            _, _, vid, slot_i = max(victims)
+            sl = slots[slot_i]
+            carried[vid] = (sl.admit_v, sl.all_tokens, sl.preempts + 1)
+            preempts += 1
+            tel.emit("request_preempt", id=vid, slot=slot_i,
+                     tier=sl.request.priority, by=cand.id,
+                     tokens_kept=len(sl.all_tokens),
+                     vclock_ms=round(vclock, 3))
+            log("evict", id=vid, slot=slot_i, by=cand.id,
+                kept=len(sl.all_tokens))
+            # Re-queue at its original key; the freed slot admits cand.
+            waiting.append(sl.request)
+            slots[slot_i] = None
+            return slot_i
+
+        def admit(r: Request, slot_i: int):
+            nonlocal vclock, prefills, total_tokens
+            waiting.remove(r)
+            admit_v0, prior, n_pre = carried.pop(r.id, (vclock, [], 0))
+            # Re-prefill over (prompt ‖ carried) — loss-free resume.
+            full = np.concatenate([
+                np.asarray(r.prompt, np.int32),
+                np.asarray(prior, np.int32),
+            ]) if prior else np.asarray(r.prompt, np.int32)
+            bucket = ex.bucket_for(len(full))
+            others = [w for w in waiting if w is not r]
+            tel.emit("request_start", id=r.id, prompt_len=len(r.prompt),
+                     bucket=bucket, slot=slot_i)
+            log("admit", id=r.id, slot=slot_i, bucket=bucket,
+                tier=r.priority, resumed=len(prior),
+                waiting_min_tier=min(
+                    (w.priority for w in others), default=None),
+            )
+            vclock += model.prefill_ms(bucket)
+            tok0, ok, pf_s = self.engine.prefill(full, bucket, slot_i)
+            prefills += 1
+            tel.emit("prefill", id=r.id, bucket=bucket,
+                     wall_s=round(pf_s, 6))
+            sl = _SchedSlot(
+                request=r, pos=len(full), last_tok=tok0,
+                tokens=[] if not ok else [tok0], carried=list(prior),
+                admit_v=admit_v0, t_wall0=t_wall0, prefill_s=pf_s,
+                preempts=n_pre,
+            )
+            slots[slot_i] = sl
+            if not ok:
+                finish_slot(slot_i, "non-finite logits in prefill")
+                return
+            total_tokens += 1
+            if slot_done(sl):
+                finish_slot(slot_i)
+
+        while pending or waiting or any(sl is not None for sl in slots):
+            scan_arrivals()
+            if not waiting and not any(sl is not None for sl in slots):
+                # Idle gap: jump the virtual clock to the next arrival.
+                vclock = max(vclock, pending[0].arrival_ms)
+                log("advance")
+                continue
+
+            # -- admissions (vclock moves per prefill; re-scan) --
+            while waiting:
+                scan_arrivals()
+                waiting.sort(key=self._admit_key)
+                cand = waiting[0]
+                slot_i = next(
+                    (i for i, sl in enumerate(slots) if sl is None), None
+                )
+                if slot_i is None:
+                    slot_i = try_preempt(cand)
+                if slot_i is None:
+                    break
+                admit(cand, slot_i)
+
+            # -- shed the overload past the queue-depth bound --
+            if pol.shed_depth:
+                while len(waiting) > pol.shed_depth:
+                    victim = max(waiting, key=self._shed_key)
+                    waiting.remove(victim)
+                    sheds += 1
+                    tel.emit("request_shed", id=victim.id,
+                             tier=victim.priority,
+                             queue_depth=len(waiting) + 1,
+                             vclock_ms=round(vclock, 3))
+                    log("shed", id=victim.id, tier=victim.priority)
+                    finish_result(
+                        victim, [],
+                        f"shed: queue depth > {pol.shed_depth}",
+                        None, t_wall0,
+                    )
+
+            active = [i for i, sl in enumerate(slots) if sl is not None]
+            if not active:
+                continue
+
+            # -- one fused decode superstep over the whole batch --
+            k = self._choose_k(slots, len(waiting))
+            tel.emit("sched_decision", k=k, active=len(active),
+                     waiting=len(waiting), policy=pol.name,
+                     vclock_ms=round(vclock, 3))
+            log("decode", k=k, active=len(active), waiting=len(waiting))
+            pos_vec = np.array(
+                [sl.pos if sl else 0 for sl in slots], np.int32
+            )
+            tok_vec = np.array(
+                [sl.last_tok if sl else 0 for sl in slots], np.int32
+            )
+            vclock += model.decode_ms(k)
+            toks, oks, wall = self.engine.decode(pos_vec, tok_vec, k)
+            decode_s += wall
+            supersteps += 1
+            # Training-superstep accounting: one host program + one
+            # fence covered k decode steps (programs/step == 1/k).
+            tel.add_programs(1, steps=k)
+            tel.emit("decode_superstep", k=k, active=len(active),
+                     wall_s=round(wall, 6))
+            for j in range(k):
+                tel.record_step((supersteps - 1) * k + j,
+                                wall_s=wall / k)
+            for i in active:
+                sl = slots[i]
+                err = None
+                for j in range(k):
+                    if not bool(oks[j, i]):
+                        err = "non-finite logits in decode"
+                        break
+                    sl.tokens.append(int(toks[j, i]))
+                    sl.pos += 1
+                    total_tokens += 1
+                    if slot_done(sl):
+                        break
+                sl.last_tok = sl.tokens[-1] if sl.tokens else 0
+                if err is not None:
+                    finish_slot(i, err)
+                elif slot_done(sl):
+                    finish_slot(i)
+
+        elapsed = time.perf_counter() - t_wall0
+        # Per-request virtual-clock splits, exposed for the measure
+        # tool and tests (per-tier percentile analysis — the class the
+        # SLO policy protects is not visible in the global p99).
+        self.last_queue_waits = dict(qwaits)
+        self.last_e2es = dict(e2es)
+        self.last_slo_oks = dict(slo_oks)
+        stats = self._stats(results, qwaits, e2es, slo_oks, sheds,
+                            preempts, prefills, supersteps,
+                            total_tokens, decode_s, elapsed)
+        tel.note_summary(**{
+            kk: stats[kk] for kk in (
+                "queue_wait_ms_p50", "queue_wait_ms_p95",
+                "queue_wait_ms_p99", "request_sheds",
+                "request_preempts",
+            ) if kk in stats
+        }, **({"slo_attainment": stats["slo_attainment"]}
+              if "slo_attainment" in stats else {}))
+        return results, tel.fold_stats(stats)
+
+    # -- stats --------------------------------------------------------------
+
+    def _stats(self, results, qwaits, e2es, slo_oks, sheds, preempts,
+               prefills, supersteps, total_tokens, decode_s, elapsed):
+        lats = sorted(
+            r.latency_s for r in results.values() if r.error is None
+        )
+
+        def pct(vals: List[float], p: float) -> float:
+            if not vals:
+                return 0.0
+            return vals[min(len(vals) - 1,
+                            int(round(p * (len(vals) - 1))))]
+
+        qs = sorted(qwaits.values())
+        es = sorted(e2es.values())
+        stats: Dict[str, Any] = {
+            "requests": len(results),
+            "completed": sum(
+                1 for r in results.values() if r.error is None),
+            "failed": sum(1 for r in results.values() if r.error),
+            "tokens": total_tokens,
+            "elapsed_s": elapsed,
+            "tokens_per_s": total_tokens / max(elapsed, 1e-9),
+            "decode_supersteps": supersteps,
+            "decode_steps_per_call": self.decode_steps,
+            "decode_s": decode_s,
+            "prefills": prefills,
+            "policy": self.policy.name,
+            "request_latency_ms_p50": round(pct(lats, 0.50) * 1e3, 3),
+            "request_latency_ms_p95": round(pct(lats, 0.95) * 1e3, 3),
+            "request_latency_ms_p99": round(pct(lats, 0.99) * 1e3, 3),
+            # Virtual-clock latency split (deterministic, SERVING.md):
+            # the same rounded per-request values the request_end
+            # events carry, so obs reconstruction is bit-identical.
+            "queue_wait_ms_p50": round(pct(qs, 0.50), 3),
+            "queue_wait_ms_p95": round(pct(qs, 0.95), 3),
+            "queue_wait_ms_p99": round(pct(qs, 0.99), 3),
+            "e2e_ms_p50": round(pct(es, 0.50), 3),
+            "e2e_ms_p99": round(pct(es, 0.99), 3),
+            "request_sheds": sheds,
+            "request_preempts": preempts,
+            "programs_per_decode_superstep": 1,
+        }
+        if slo_oks:
+            stats["slo_attainment"] = round(
+                sum(slo_oks.values()) / len(slo_oks), 4
+            )
+        return stats

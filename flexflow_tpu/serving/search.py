@@ -1,0 +1,226 @@
+"""`--serve-auto`: the serving-config search (SEARCH.md mold).
+
+Searches (bucket boundaries x decode K x max_batch x scheduler policy
+knobs) against the calibrated serving latency model, pricing every
+candidate by SIMULATING the real scheduler loop over the real workload
+(``ScheduledServer.simulated`` — the same decision code that will run
+the winner, so predicted dispatch counts are the executed dispatch
+counts, not a parallel formula that can drift).
+
+Legality is enforced at candidate-construction time through
+:class:`~flexflow_tpu.serving.scheduler.SlotShape`, which mirrors
+``ServingExecutor``'s own validation — the search can only emit
+configs the executor accepts (PR 6's every-emitted-candidate-is-
+runnable discipline, pinned in tests/test_serving_sched.py).
+
+The app-default config COMPETES as a candidate (the execution search's
+baseline rule): the winner's predicted p99 is printed against it and
+the run's measured p99 lands in the predicted-vs-measured epilogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.runtime.serving import Request
+from flexflow_tpu.serving.latency_model import ServingLatencyModel
+from flexflow_tpu.serving.scheduler import (
+    ADAPTIVE_K_CANDIDATES,
+    ScheduledServer,
+    SchedulerPolicy,
+    SlotShape,
+)
+
+#: Decode-slot widths the search may propose (unioned with the app
+#: default, capped by ``max_batch_cap`` — the HBM budget stand-in).
+BATCH_CANDIDATES = (2, 4, 8)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """One executor-legal serving configuration.  Construction IS the
+    legality check: :class:`SlotShape` re-runs the executor's bucket
+    validation, and the k/batch bounds mirror ``ServingExecutor`` +
+    the relay clamp."""
+
+    buckets: Tuple[int, ...]
+    decode_steps: int
+    max_batch: int
+    max_seq: int
+    policy: SchedulerPolicy
+
+    def __post_init__(self):
+        from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
+
+        # Validates buckets against max_seq exactly as the executor
+        # does (raises ValueError on an illegal set).
+        shape = self.shape()
+        object.__setattr__(self, "buckets", shape.buckets)
+        if not (1 <= self.decode_steps <= MAX_DECODE_STEPS_PER_CALL):
+            raise ValueError(
+                f"decode_steps must be in [1, "
+                f"{MAX_DECODE_STEPS_PER_CALL}]: {self.decode_steps}"
+            )
+
+    def shape(self) -> SlotShape:
+        return SlotShape(max_batch=self.max_batch, max_seq=self.max_seq,
+                         buckets=self.buckets)
+
+    def describe(self) -> str:
+        return (f"buckets={list(self.buckets)} k={self.decode_steps} "
+                f"max_batch={self.max_batch} "
+                f"policy={self.policy.describe()}")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "buckets": list(self.buckets),
+            "decode_steps": self.decode_steps,
+            "max_batch": self.max_batch,
+            "max_seq": self.max_seq,
+            "policy": self.policy.name,
+            "adaptive_k": self.policy.adaptive_k,
+            "preempt": self.policy.preempt,
+            "shed_depth": self.policy.shed_depth,
+        }
+
+
+@dataclasses.dataclass
+class ScoredConfig:
+    config: ServingConfig
+    #: Simulated run stats over the workload (virtual-clock ms).
+    predicted_p99_ms: float
+    predicted_queue_wait_p99_ms: float
+    predicted_attainment: Optional[float]
+    predicted_dispatches: int
+
+
+@dataclasses.dataclass
+class ServingSearchResult:
+    chosen: ScoredConfig
+    baseline: ScoredConfig
+    candidates: List[ScoredConfig]
+    model: ServingLatencyModel
+    wall_s: float
+
+    @property
+    def speedup(self) -> float:
+        return self.baseline.predicted_p99_ms / max(
+            self.chosen.predicted_p99_ms, 1e-9
+        )
+
+    def describe(self) -> str:
+        c = self.chosen
+        return (f"serve-auto: chose {c.config.describe()} — predicted "
+                f"e2e p99 {c.predicted_p99_ms:.3f} ms vs baseline "
+                f"{self.baseline.predicted_p99_ms:.3f} ms "
+                f"({self.speedup:.2f}x) over {len(self.candidates)} "
+                f"candidates in {self.wall_s:.2f}s")
+
+
+def candidate_bucket_sets(
+    requests: Sequence[Request],
+    max_seq: int,
+    baseline: Tuple[int, ...],
+) -> List[Tuple[int, ...]]:
+    """A small family of bucket boundaries derived from the workload's
+    own prompt-length distribution — every set ends at ``max_seq`` so
+    coverage never shrinks below the app default's."""
+    plens = sorted(len(r.prompt) for r in requests)
+    out = {tuple(baseline), (max_seq,)}
+    if plens:
+        pmax = min(plens[-1], max_seq)
+        p50 = min(plens[len(plens) // 2], max_seq)
+        out.add(tuple(sorted({pmax, max_seq})))
+        out.add(tuple(sorted({p50, pmax, max_seq})))
+    return sorted(out)
+
+
+def _score(config: ServingConfig, requests: Sequence[Request],
+           model: ServingLatencyModel) -> ScoredConfig:
+    srv = ScheduledServer.simulated(
+        config.shape(), decode_steps=config.decode_steps,
+        policy=config.policy, latency_model=model,
+    )
+    _results, stats = srv.run(list(requests))
+    return ScoredConfig(
+        config=config,
+        predicted_p99_ms=stats["e2e_ms_p99"],
+        predicted_queue_wait_p99_ms=stats["queue_wait_ms_p99"],
+        predicted_attainment=stats.get("slo_attainment"),
+        predicted_dispatches=stats["prefills"] + stats["decode_supersteps"],
+    )
+
+
+def search_serving_config(
+    requests: Sequence[Request],
+    baseline: ServingConfig,
+    model: Optional[ServingLatencyModel] = None,
+    max_batch_cap: Optional[int] = None,
+) -> ServingSearchResult:
+    """Exhaustive search over the bounded candidate space (a few
+    dozen compute-free simulations), deterministic tie-break.  The
+    baseline ALWAYS competes; the winner is returned even when it IS
+    the baseline (the honest no-change outcome)."""
+    from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
+
+    t0 = time.time()
+    model = model or ServingLatencyModel.from_calibration()
+    cap = max_batch_cap or max(baseline.max_batch, max(BATCH_CANDIDATES))
+    ks = sorted(
+        k for k in set(ADAPTIVE_K_CANDIDATES) | {baseline.decode_steps}
+        if 1 <= k <= MAX_DECODE_STEPS_PER_CALL
+    )
+    batches = sorted(
+        b for b in set(BATCH_CANDIDATES) | {baseline.max_batch}
+        if 1 <= b <= cap
+    )
+    bucket_sets = candidate_bucket_sets(
+        requests, baseline.max_seq, baseline.buckets
+    )
+    base_pol = baseline.policy
+    configs: List[ServingConfig] = []
+    seen = set()
+    for bks in bucket_sets:
+        for k in ks:
+            for b in batches:
+                for adaptive in (
+                    (True, False) if base_pol.name == "slo" else (False,)
+                ):
+                    pol = dataclasses.replace(base_pol,
+                                              adaptive_k=adaptive)
+                    key = (bks, k, b, adaptive)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    configs.append(ServingConfig(
+                        buckets=bks, decode_steps=k, max_batch=b,
+                        max_seq=baseline.max_seq, policy=pol,
+                    ))
+    if not any(c.to_json() == baseline.to_json() for c in configs):
+        configs.append(baseline)
+
+    scored = [_score(c, requests, model) for c in configs]
+    baseline_scored = next(
+        s for s in scored if s.config.to_json() == baseline.to_json()
+    )
+
+    def order(s: ScoredConfig):
+        # Best predicted e2e p99; ties broken toward fewer dispatches,
+        # then the smaller/simpler config — fully deterministic.
+        return (
+            round(s.predicted_p99_ms, 6),
+            s.predicted_dispatches,
+            s.config.decode_steps,
+            s.config.max_batch,
+            len(s.config.buckets),
+            s.config.buckets,
+            not s.config.policy.adaptive_k,
+        )
+
+    chosen = min(scored, key=order)
+    return ServingSearchResult(
+        chosen=chosen, baseline=baseline_scored, candidates=scored,
+        model=model, wall_s=time.time() - t0,
+    )

@@ -1,0 +1,142 @@
+"""Open-loop serving workload generator (SERVING.md "Scheduler").
+
+The arrival process `data/trace.py` promised the serving stack: zipf-
+skewed prompt and output lengths, bursty inter-arrival gaps, and a
+per-request priority tier with an SLO deadline — everything the
+SLO-aware scheduler (``serving/scheduler.py``) admits against.
+
+Determinism contract (the one ``ProductionTraceSource`` set): every
+request draws from its OWN ``np.random.default_rng([seed, i])`` block,
+so a workload is a pure function of ``(spec, seed)`` — a scheduler
+decision trace over it replays bit-identically, which is what makes
+the chaos shed scenario and the measure-tool A/B exact.
+
+Arrivals are timestamped in **virtual milliseconds** (``arrival_ms``
+on :class:`~flexflow_tpu.runtime.serving.Request`): the scheduler's
+clock advances by modeled program costs (``serving/latency_model.py``),
+never by wall time, so queue-wait/SLO accounting is deterministic on
+any box.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+from flexflow_tpu.runtime.serving import Request
+
+
+def _bounded_zipf(rng: np.random.Generator, alpha: float, lo: int,
+                  hi: int) -> int:
+    """One zipf draw folded into [lo, hi] — the bounded-tail idiom
+    from ``data/trace.py`` (`np.minimum` clamp, 1-based shifted to the
+    range floor)."""
+    if alpha <= 1.0:
+        raise ValueError(f"zipf alpha must be > 1.0, got {alpha}")
+    if hi <= lo:
+        return lo
+    draw = int(np.minimum(rng.zipf(alpha), hi - lo + 1))
+    return lo + draw - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """Everything that shapes an open-loop request trace.  Frozen so a
+    spec can key caches and ride in telemetry meta verbatim."""
+
+    n_requests: int = 16
+    vocab: int = 256
+    #: Prompt lengths: zipf(alpha) folded into [lo, hi] — most prompts
+    #: short, a heavy tail near hi (the production shape).
+    prompt_len: Tuple[int, int] = (4, 12)
+    prompt_alpha: float = 1.5
+    #: Generation budgets: zipf-folded into [lo, hi] likewise.
+    max_new: Tuple[int, int] = (1, 16)
+    output_alpha: float = 1.5
+    #: Mean inter-arrival gap (virtual ms) between BURSTS; requests
+    #: inside a burst arrive back-to-back (gap 0).
+    mean_gap_ms: float = 8.0
+    #: Burst width: every ``burst`` consecutive requests share one
+    #: arrival instant (1 = no bursts, smooth exponential arrivals).
+    burst: int = 1
+    #: Priority tiers (0 = highest).  Tier is drawn uniformly; tier t
+    #: gets deadline ``slo_ms * (t + 1)`` — tighter SLOs on higher
+    #: tiers, the shape the EDF ordering exploits.
+    priorities: int = 1
+    #: Base SLO deadline (virtual ms) for tier 0; inf = best-effort.
+    slo_ms: float = float("inf")
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.n_requests < 1:
+            raise ValueError("workload needs at least one request")
+        if self.burst < 1:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+        if self.priorities < 1:
+            raise ValueError(
+                f"priorities must be >= 1, got {self.priorities}"
+            )
+        if self.mean_gap_ms < 0:
+            raise ValueError("mean_gap_ms must be >= 0")
+        for name in ("prompt_len", "max_new"):
+            lo, hi = getattr(self, name)
+            if lo < 1 or hi < lo:
+                raise ValueError(
+                    f"{name} must be 1 <= lo <= hi, got ({lo}, {hi})"
+                )
+
+
+def make_workload(spec: WorkloadSpec) -> List[Request]:
+    """The deterministic open-loop trace: requests id-ordered BY
+    arrival time (ties by draw order), every field a pure function of
+    ``(spec, seed)``."""
+    out: List[Request] = []
+    t_ms = 0.0
+    for i in range(spec.n_requests):
+        rng = np.random.default_rng([spec.seed, i])
+        plen = _bounded_zipf(rng, spec.prompt_alpha, *spec.prompt_len)
+        prompt = rng.integers(0, spec.vocab, size=plen).astype(np.int32)
+        max_new = _bounded_zipf(rng, spec.output_alpha, *spec.max_new)
+        tier = int(rng.integers(0, spec.priorities))
+        # Burst pacing: the first request of each burst group draws an
+        # exponential gap (scaled by the group width so the OFFERED
+        # load is burst-invariant); the rest arrive with it.
+        if i % spec.burst == 0 and i > 0:
+            t_ms += float(rng.exponential(spec.mean_gap_ms * spec.burst))
+        slo = spec.slo_ms * (tier + 1)
+        out.append(Request(
+            id=i, prompt=prompt, max_new_tokens=max_new,
+            arrival_ms=round(t_ms, 3), priority=tier, slo_ms=slo,
+        ))
+    return out
+
+
+def uniform_workload(
+    n: int,
+    vocab: int,
+    prompt_len: Tuple[int, int] = (4, 12),
+    max_new_tokens: int = 16,
+    every_ms: float = 0.0,
+    seed: int = 0,
+    slo_ms: float = float("inf"),
+) -> List[Request]:
+    """The ``--arrival-every`` migration target: the exact prompt
+    stream ``synthetic_requests`` draws (same rng, same shapes — a
+    closed-loop test migrates without changing its token content),
+    with ``arrival_ms = i * every_ms`` on the virtual clock instead of
+    the deprecated superstep-index knob."""
+    rng = np.random.default_rng(seed)
+    lo, hi = prompt_len
+    out = []
+    for i in range(n):
+        plen = int(rng.integers(lo, hi + 1))
+        out.append(Request(
+            id=i,
+            prompt=rng.integers(0, vocab, size=plen).astype(np.int32),
+            max_new_tokens=max_new_tokens,
+            arrival_ms=round(i * every_ms, 3),
+            slo_ms=slo_ms,
+        ))
+    return out

@@ -88,6 +88,13 @@ def stubbed_bench(monkeypatch):
             "request_latency_ms_p50": 50.0,
             "request_latency_ms_p95": 80.0,
             "programs_per_decode_superstep": 1,
+            "queue_wait_ms_p50": 5.0, "queue_wait_ms_p95": 20.0,
+            "queue_wait_ms_p99": 30.0, "e2e_ms_p99": 55.0,
+            "slo_attainment": 0.95, "request_sheds": 0,
+            "request_preempts": 1,
+            "fifo_queue_wait_ms_p99": 45.0,
+            "fifo_slo_attainment": 0.8,
+            "fifo_vs_slo_queue_wait_p99": 1.5,
         }),
     )
     monkeypatch.setattr(
@@ -164,6 +171,18 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert serving["request_latency_ms_p50"] == 50.0
     assert serving["request_latency_ms_p95"] == 80.0
     assert serving["programs_per_decode_superstep"] == 1
+    # The scheduler A/B columns (SERVING.md "Scheduler policy"):
+    # virtual-clock queue-wait percentiles + SLO attainment under the
+    # slo policy, and the FIFO baseline's p99 for the headline ratio.
+    assert serving["queue_wait_ms_p50"] == 5.0
+    assert serving["queue_wait_ms_p95"] == 20.0
+    assert serving["queue_wait_ms_p99"] == 30.0
+    assert serving["e2e_ms_p99"] == 55.0
+    assert serving["slo_attainment"] == 0.95
+    assert serving["request_sheds"] == 0
+    assert serving["request_preempts"] == 1
+    assert serving["fifo_queue_wait_ms_p99"] == 45.0
+    assert serving["fifo_vs_slo_queue_wait_p99"] == 1.5
     # The execution-autotuner leg (ISSUE 6): auto-chosen config with
     # its predicted-vs-measured ms/step + the search wall time.
     search = record["extra"]["search"]

@@ -37,7 +37,6 @@ from flexflow_tpu.runtime.serving import (
     Server,
     ServingExecutor,
     ServingFaultInjector,
-    synthetic_requests,
 )
 
 V, D, H, L, S = 64, 32, 2, 2, 16
@@ -198,6 +197,9 @@ def test_eviction_admission_invariants(sex, weights):
         _req(1, [4, 5], max_new=9),
         _req(2, [6, 7, 8, 9], max_new=2),
         _req(3, [10] * 6, max_new=30),      # context-limited
+        # The one retained coverage of the DEPRECATED closed-loop
+        # ``Request.arrival`` alias (superstep-index gating; new code
+        # uses serving.workload's virtual-clock ``arrival_ms``).
         _req(4, [11, 12], max_new=3, arrival=2),
     ]
     results, stats = _serve(sex, weights, reqs, decode_steps=4)
@@ -292,8 +294,8 @@ def test_serve_cli_train_handoff_e2e(tmp_path, capsys):
     assert "tokens/s" in out and "request latency p50" in out
 
 
-@pytest.mark.slow  # closed-loop scale case (~30s): staggered arrivals,
-# telemetry event stream reconstructable
+@pytest.mark.slow  # closed-loop scale case (~30s): telemetry event
+# stream reconstructable
 def test_serve_telemetry_stream(lm, weights, tmp_path):
     """--telemetry for serving: request_start/prefill/decode_superstep/
     request_end events land in the JSONL with the programs/step
@@ -302,10 +304,15 @@ def test_serve_telemetry_stream(lm, weights, tmp_path):
 
     from flexflow_tpu.runtime.telemetry import Telemetry
 
+    from flexflow_tpu.serving import uniform_workload
+
     sex2 = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8,),
                            decode_kernel=False)
-    reqs = synthetic_requests(4, V, prompt_len=(3, 6), max_new_tokens=6,
-                              arrival_every=1, seed=5)
+    # Workload-trace arrivals (the closed-loop arrival_every knob is
+    # deprecated); the legacy Server serves them all-at-start, which
+    # still exercises eviction/admission at 4 requests over 2 slots.
+    reqs = uniform_workload(4, V, prompt_len=(3, 6), max_new_tokens=6,
+                            seed=5)
     with Telemetry(str(tmp_path)) as tel:
         _, stats = _serve(sex2, weights, reqs, decode_steps=4)
         path = tel.path

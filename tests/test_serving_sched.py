@@ -1,0 +1,513 @@
+"""SLO-aware serving scheduler (SERVING.md "Scheduler policy").
+
+Pinned invariants:
+
+- **Workload determinism**: ``make_workload`` / ``uniform_workload``
+  are pure functions of their spec (per-request seeded rngs) — the
+  bit-identical-replay precondition; ``uniform_workload`` draws the
+  SAME token content as the deprecated ``synthetic_requests`` path.
+- **Replay determinism**: two runs of the same workload produce the
+  same decision log, virtual-clock stats and tokens (the chaos
+  ``serving_overload_shed`` scenario's foundation).
+- **Priority-inversion freedom**: under the slo policy no request is
+  admitted while a STRICTLY higher tier waits.
+- **Preemption is loss-free**: an evicted request resumes via
+  re-prefill over (prompt ‖ carried tokens) and its final sequence is
+  byte-identical to an unpreempted run; scheduling policy never
+  changes WHAT a request generates, only WHEN (cross-policy parity).
+- **Sim == real**: simulate mode (the serve-auto cost oracle) matches
+  the real engine decision for decision and dispatch for dispatch.
+- **serve-auto legality**: every searched config is executor-legal —
+  ``ServingConfig`` validation mirrors ``ServingExecutor``'s, and the
+  chosen config constructs a real executor (the runnable pattern).
+
+Fast cases run the compute-free simulate mode; the real-engine cases
+share one module-scoped tiny LM.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.transformer import build_transformer_lm
+from flexflow_tpu.runtime.serving import (
+    Request,
+    ServingExecutor,
+    synthetic_requests,
+)
+from flexflow_tpu.serving import (
+    ScheduledServer,
+    SchedulerPolicy,
+    ServingConfig,
+    ServingLatencyModel,
+    SlotShape,
+    WorkloadSpec,
+    make_workload,
+    search_serving_config,
+    uniform_workload,
+)
+
+V, D, H, L, S = 64, 32, 2, 2, 64
+
+SHAPE = SlotShape(max_batch=2, max_seq=32, buckets=(8, 32))
+
+BURSTY = WorkloadSpec(n_requests=16, vocab=V, prompt_len=(3, 6),
+                      max_new=(2, 10), mean_gap_ms=1.0, burst=8,
+                      priorities=3, slo_ms=60.0, seed=5)
+
+#: Virtual-clock / accounting stats — everything except wall time.
+VIRT = ("requests", "completed", "failed", "tokens", "decode_supersteps",
+        "prefills", "request_sheds", "request_preempts",
+        "queue_wait_ms_p50", "queue_wait_ms_p95", "queue_wait_ms_p99",
+        "e2e_ms_p50", "e2e_ms_p99", "slo_attainment")
+
+
+def _virt(stats):
+    return {k: stats[k] for k in VIRT if k in stats}
+
+
+def _sim(policy=None, shape=SHAPE, decode_steps=8):
+    return ScheduledServer.simulated(
+        shape, decode_steps=decode_steps,
+        policy=policy or SchedulerPolicy(name="slo"),
+    )
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return build_transformer_lm(
+        batch_size=2, seq_len=S, vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, config=FFConfig(batch_size=2),
+    )
+
+
+@pytest.fixture(scope="module")
+def sex(lm):
+    return ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                           decode_kernel=False)
+
+
+@pytest.fixture(scope="module")
+def weights(sex):
+    return sex.init(seed=0)
+
+
+def _req(rid, plen, max_new, arrival_ms=0.0, priority=0,
+         slo_ms=float("inf")):
+    return Request(id=rid,
+                   prompt=(np.arange(1, plen + 1, dtype=np.int32)
+                           * 3 % V),
+                   max_new_tokens=max_new, arrival_ms=arrival_ms,
+                   priority=priority, slo_ms=slo_ms)
+
+
+# -- workload -----------------------------------------------------------------
+
+
+def test_workload_deterministic():
+    a, b = make_workload(BURSTY), make_workload(BURSTY)
+    assert [r.arrival_ms for r in a] == [r.arrival_ms for r in b]
+    assert [r.priority for r in a] == [r.priority for r in b]
+    assert [r.slo_ms for r in a] == [r.slo_ms for r in b]
+    assert all((x.prompt == y.prompt).all() for x, y in zip(a, b))
+    assert [r.max_new_tokens for r in a] == [r.max_new_tokens for r in b]
+
+
+def test_workload_shape():
+    reqs = make_workload(BURSTY)
+    assert len(reqs) == BURSTY.n_requests
+    lo, hi = BURSTY.prompt_len
+    assert all(lo <= len(r.prompt) <= hi for r in reqs)
+    assert all(1 <= r.max_new_tokens <= BURSTY.max_new[1] for r in reqs)
+    assert all(0 <= r.priority < BURSTY.priorities for r in reqs)
+    # Tiered deadlines: tier t gets slo_ms * (t + 1).
+    assert all(r.slo_ms == BURSTY.slo_ms * (r.priority + 1)
+               for r in reqs)
+    arrivals = [r.arrival_ms for r in reqs]
+    assert arrivals == sorted(arrivals)
+    # Bursts arrive back to back: within each burst group, one gap.
+    assert arrivals[0] == arrivals[BURSTY.burst - 1]
+    assert arrivals[BURSTY.burst] > arrivals[BURSTY.burst - 1]
+
+
+def test_workload_validation():
+    with pytest.raises(ValueError):
+        make_workload(WorkloadSpec(prompt_alpha=1.0))
+    with pytest.raises(ValueError):
+        make_workload(WorkloadSpec(prompt_len=(6, 3)))
+    with pytest.raises(ValueError):
+        make_workload(WorkloadSpec(priorities=0))
+
+
+def test_uniform_workload_matches_deprecated_synthetic():
+    """The migration contract: uniform_workload draws the SAME token
+    content as synthetic_requests (which now warns on arrival_every),
+    with arrivals moved onto the virtual clock."""
+    with pytest.warns(DeprecationWarning, match="arrival_every"):
+        legacy = synthetic_requests(4, V, prompt_len=(3, 6),
+                                    max_new_tokens=6, arrival_every=2,
+                                    seed=5)
+    new = uniform_workload(4, V, prompt_len=(3, 6), max_new_tokens=6,
+                           every_ms=7.5, seed=5)
+    assert all((a.prompt == b.prompt).all() for a, b in zip(legacy, new))
+    assert [r.max_new_tokens for r in legacy] == \
+        [r.max_new_tokens for r in new]
+    assert [r.arrival_ms for r in new] == [0.0, 7.5, 15.0, 22.5]
+
+
+# -- replay determinism (sim) -------------------------------------------------
+
+
+def test_replay_determinism_sim():
+    s1, s2 = _sim(), _sim()
+    _, st1 = s1.run(make_workload(BURSTY))
+    _, st2 = s2.run(make_workload(BURSTY))
+    assert s1.decisions == s2.decisions
+    assert _virt(st1) == _virt(st2)
+
+
+def test_shed_determinism_sim():
+    pol = SchedulerPolicy(name="slo", shed_depth=4)
+    outs = []
+    for _ in range(2):
+        srv = _sim(pol)
+        res, st = srv.run(make_workload(BURSTY))
+        outs.append((sorted(r for r in res if res[r].error
+                            and res[r].error.startswith("shed")),
+                     st["request_sheds"], srv.decisions))
+    assert outs[0] == outs[1]
+    assert outs[0][1] > 0, "burst never tripped shed_depth"
+    assert len(outs[0][0]) == outs[0][1]
+
+
+def test_priority_inversion_freedom_sim():
+    """slo-policy admission order: the admit log never records a
+    strictly higher-priority (lower tier number) request left waiting
+    at the moment a lower-priority one was admitted."""
+    srv = _sim()
+    srv.run(make_workload(BURSTY))
+    admits = [d for d in srv.decisions if d["d"] == "admit"]
+    assert admits
+    for a in admits:
+        if a["waiting_min_tier"] is not None:
+            assert a["tier"] <= a["waiting_min_tier"], (
+                f"priority inversion: admitted tier {a['tier']} while "
+                f"tier {a['waiting_min_tier']} waited: {a}"
+            )
+
+
+def test_fifo_admits_in_arrival_order_sim():
+    srv = _sim(SchedulerPolicy.fifo())
+    reqs = make_workload(BURSTY)
+    srv.run(reqs)
+    admits = [d["id"] for d in srv.decisions if d["d"] == "admit"]
+    arrival = {r.id: (r.arrival_ms, r.id) for r in reqs}
+    assert admits == sorted(admits, key=lambda i: arrival[i])
+
+
+def test_adaptive_k_bounds_sim():
+    """Chosen k never exceeds decode_steps and the decode accounting
+    matches: supersteps equals the number of decode decisions."""
+    srv = _sim(decode_steps=8)
+    _, st = srv.run(make_workload(BURSTY))
+    ks = [d["k"] for d in srv.decisions if d["d"] == "decode"]
+    assert ks and all(1 <= k <= 8 for k in ks)
+    assert len(ks) == st["decode_supersteps"]
+    # Deep queue pushes k down at least once under bursty overload.
+    assert min(ks) < 8
+
+
+# -- preemption (real engine) -------------------------------------------------
+
+
+def _preempt_pair():
+    """A tier-1 hog admitted first + a tight-deadline tier-0 arrival
+    that is infeasible by waiting — the eviction trigger."""
+    return [_req(0, 4, 40, 0.0, priority=1),
+            _req(1, 4, 4, 5.0, priority=0, slo_ms=20.0)]
+
+
+def test_preempt_byte_parity(lm, weights):
+    """Loss-free preemption: the evicted request's final sequence is
+    byte-identical to an unpreempted solo run (re-prefill over
+    prompt ‖ carried tokens resumes exactly)."""
+    params, state = weights
+    sex1 = ServingExecutor(lm, max_batch=1, max_seq=S, buckets=(8, S),
+                           decode_kernel=False)
+    pol = SchedulerPolicy(name="slo")
+    srv = ScheduledServer(sex1, params, state, decode_steps=8,
+                          policy=pol)
+    res, st = srv.run(_preempt_pair())
+    assert st["request_preempts"] == 1
+    assert res[0].error is None and res[1].error is None
+    solo, _ = ScheduledServer(sex1, params, state, decode_steps=8,
+                              policy=pol).run([_req(0, 4, 40, 0.0,
+                                                    priority=1)])
+    assert res[0].tokens == solo[0].tokens
+    # The preempt telemetry/log trail exists and names the evictor.
+    evicts = [d for d in srv.decisions if d["d"] == "evict"]
+    assert len(evicts) == 1 and evicts[0]["id"] == 0
+    assert evicts[0]["by"] == 1
+
+
+def test_preempt_infeasible_deadline_not_honored(lm, weights):
+    """An already-lost deadline never evicts (the slack < need gate):
+    same pair but an SLO the candidate cannot meet even on a free
+    slot."""
+    params, state = weights
+    sex1 = ServingExecutor(lm, max_batch=1, max_seq=S, buckets=(8, S),
+                           decode_kernel=False)
+    srv = ScheduledServer(sex1, params, state, decode_steps=8,
+                          policy=SchedulerPolicy(name="slo"))
+    reqs = [_req(0, 4, 40, 0.0, priority=1),
+            _req(1, 4, 4, 5.0, priority=0, slo_ms=10.0)]
+    _, st = srv.run(reqs)
+    assert st["request_preempts"] == 0
+
+
+def test_cross_policy_output_parity(sex, weights):
+    """Scheduling policy changes WHEN, never WHAT: per-request token
+    sequences are identical under fifo and slo over the same
+    workload."""
+    params, state = weights
+    reqs = list(make_workload(WorkloadSpec(
+        n_requests=6, vocab=V, prompt_len=(3, 6), max_new=(2, 8),
+        mean_gap_ms=1.0, burst=3, priorities=2, slo_ms=60.0, seed=9,
+    )))
+    out = {}
+    for pol in (SchedulerPolicy.fifo(), SchedulerPolicy(name="slo")):
+        res, _ = ScheduledServer(sex, params, state, decode_steps=4,
+                                 policy=pol).run(reqs)
+        assert all(r.error is None for r in res.values())
+        out[pol.name] = {i: res[i].tokens for i in res}
+    assert out["fifo"] == out["slo"]
+
+
+# -- sim == real --------------------------------------------------------------
+
+
+def test_sim_matches_real_dispatch_exactly(sex, weights):
+    """Simulate mode (the serve-auto pricing oracle) runs the EXACT
+    decision code: decision log, prefill count and superstep count all
+    equal the real engine's, and the telemetry program counters agree
+    with the superstep count."""
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    params, state = weights
+    spec = WorkloadSpec(n_requests=8, vocab=V, prompt_len=(3, 6),
+                        max_new=(2, 8), mean_gap_ms=1.0, burst=4,
+                        priorities=2, slo_ms=60.0, seed=7)
+    pol = SchedulerPolicy(name="slo")
+    real = ScheduledServer(sex, params, state, decode_steps=8,
+                           policy=pol)
+    tel = Telemetry(None)
+    with tel:
+        _, real_st = real.run(make_workload(spec))
+    sim = _sim(pol, SlotShape(max_batch=2, max_seq=S, buckets=(8, S)))
+    _, sim_st = sim.run(make_workload(spec))
+    assert sim.decisions == real.decisions
+    assert sim_st["prefills"] == real_st["prefills"]
+    assert sim_st["decode_supersteps"] == real_st["decode_supersteps"]
+    assert _virt(sim_st) == _virt(real_st)
+    # One host program per superstep in the training-style counters.
+    assert tel.counts["host_programs"] == real_st["decode_supersteps"]
+    assert tel.counts["program_steps"] == sum(
+        d["k"] for d in real.decisions if d["d"] == "decode")
+
+
+# -- serve-auto ---------------------------------------------------------------
+
+
+def test_serving_config_legality():
+    pol = SchedulerPolicy(name="slo")
+    with pytest.raises(ValueError):
+        ServingConfig(buckets=(8, 64), decode_steps=8, max_batch=2,
+                      max_seq=32, policy=pol)  # bucket > max_seq
+    with pytest.raises(ValueError):
+        ServingConfig(buckets=(8, 32), decode_steps=0, max_batch=2,
+                      max_seq=32, policy=pol)
+    with pytest.raises(ValueError):
+        ServingConfig(buckets=(8, 32), decode_steps=99, max_batch=2,
+                      max_seq=32, policy=pol)  # relay clamp
+
+
+def test_serve_auto_emits_only_legal_configs_and_chosen_runs(lm, weights):
+    """Every candidate the search scored is executor-legal (the
+    ServingConfig gate) and the chosen one actually constructs a real
+    ServingExecutor — the runnable pattern."""
+    from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
+
+    params, state = weights
+    reqs = make_workload(WorkloadSpec(
+        n_requests=8, vocab=V, prompt_len=(3, 6), max_new=(2, 8),
+        mean_gap_ms=1.0, burst=4, priorities=2, slo_ms=60.0, seed=7,
+    ))
+    base = ServingConfig(buckets=(8, S), decode_steps=8, max_batch=2,
+                         max_seq=S, policy=SchedulerPolicy(name="slo"))
+    res = search_serving_config(reqs, base, max_batch_cap=4)
+    assert len(res.candidates) > 1
+    for c in res.candidates:
+        cfg = c.config
+        assert cfg.buckets[-1] <= cfg.max_seq
+        assert 1 <= cfg.decode_steps <= MAX_DECODE_STEPS_PER_CALL
+        assert cfg.max_batch <= 4
+        assert c.predicted_dispatches > 0
+    assert res.chosen.predicted_p99_ms <= res.baseline.predicted_p99_ms
+    # The runnable pattern: the winner builds a real executor + runs.
+    win = res.chosen.config
+    sexw = ServingExecutor(lm, max_batch=win.max_batch,
+                           max_seq=win.max_seq, buckets=win.buckets,
+                           decode_kernel=False)
+    pw, sw = sexw.init(seed=0)
+    out, stats = ScheduledServer(
+        sexw, pw, sw, decode_steps=win.decode_steps, policy=win.policy,
+    ).run(reqs)
+    assert stats["completed"] + stats["failed"] == len(reqs)
+    # Predicted dispatches are EXACT for the chosen config.
+    assert (stats["prefills"] + stats["decode_supersteps"]
+            == res.chosen.predicted_dispatches)
+
+
+def test_search_deterministic():
+    reqs = make_workload(BURSTY)
+    base = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                         max_seq=32, policy=SchedulerPolicy(name="slo"))
+    a = search_serving_config(reqs, base)
+    b = search_serving_config(reqs, base)
+    assert a.chosen.config.to_json() == b.chosen.config.to_json()
+    assert [c.config.to_json() for c in a.candidates] == \
+        [c.config.to_json() for c in b.candidates]
+
+
+# -- latency model ------------------------------------------------------------
+
+
+def test_latency_model_defaults_and_fit():
+    m = ServingLatencyModel.from_calibration()
+    assert not m.calibrated
+    assert m.prefill_ms(8) == pytest.approx(3.0 + 8 * 0.05)
+    assert m.decode_ms(8) == pytest.approx(3.0 + 8 * 0.2)
+    fitted = m.fit_events([
+        {"ev": "prefill", "bucket": 8, "wall_s": 0.0038},
+        {"ev": "prefill", "bucket": 8, "wall_s": 0.0042},
+        {"ev": "prefill", "bucket": 8, "wall_s": 0.0046},
+        {"ev": "decode_superstep", "k": 8, "wall_s": 0.0110},
+    ], source="test")
+    assert fitted.prefill_token_ms == pytest.approx(
+        ((0.0042 * 1e3) - 3.0) / 8)
+    assert fitted.decode_token_ms == pytest.approx((11.0 - 3.0) / 8)
+    assert fitted.source == "test"
+    # Sub-constant walls floor at 0, never negative.
+    floored = m.fit_events(
+        [{"ev": "decode_superstep", "k": 8, "wall_s": 0.0001}],
+        source="t")
+    assert floored.decode_token_ms == 0.0
+
+
+# -- telemetry / obs round trip ----------------------------------------------
+
+
+def test_scheduler_events_reconstruct(tmp_path, sex, weights):
+    """request_shed/request_preempt/sched_decision land in the JSONL;
+    the obs reader's reconstruction reproduces the folded summary's
+    scheduler rows bit-identically."""
+    from flexflow_tpu.obs.reader import RunLog
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    params, state = weights
+    pol = SchedulerPolicy(name="slo", shed_depth=3)
+    tel = Telemetry(str(tmp_path))
+    path = tel.path
+    with tel:
+        _, stats = ScheduledServer(
+            sex, params, state, decode_steps=8, policy=pol,
+        ).run(make_workload(BURSTY))
+    run = RunLog.load(path)
+    assert not run.unknown_events
+    assert len(run.select("sched_decision")) == stats["decode_supersteps"]
+    assert len(run.select("request_shed")) == stats["request_sheds"] > 0
+    rec = run.reconstruct_summary()
+    summ = run.summary()
+    for k in ("queue_wait_ms_p50", "queue_wait_ms_p95",
+              "queue_wait_ms_p99", "request_sheds", "request_preempts",
+              "slo_attainment"):
+        assert rec.get(k) == summ.get(k) == stats[k], k
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+@pytest.mark.slow  # end-to-end CLI cases (~40s): full app wiring
+def test_serve_cli_scheduled(capsys):
+    from flexflow_tpu.apps import serve
+
+    rc = serve.main([
+        "--max-seq", "32", "--max-batch", "2", "--decode-steps", "4",
+        "--requests", "6", "--max-new", "6", "--vocab", "64",
+        "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--prompt-len", "3:6", "--workload-trace", "--slo-ms", "50",
+        "--priorities", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "policy = slo" in out
+    assert "queue wait p50" in out and "(virtual)" in out
+    assert "SLO attainment" in out
+
+
+@pytest.mark.slow  # end-to-end CLI: search-then-run + exact epilogue
+def test_serve_cli_serve_auto(capsys):
+    from flexflow_tpu.apps import serve
+
+    rc = serve.main([
+        "--max-seq", "32", "--max-batch", "2", "--decode-steps", "4",
+        "--requests", "6", "--max-new", "6", "--vocab", "64",
+        "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--prompt-len", "3:6", "--serve-auto", "--slo-ms", "50",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serve-auto: chose" in out
+    assert "predicted e2e p99" in out
+    # The predicted-vs-measured epilogue: dispatch counts are EXACT.
+    epi = [l for l in out.splitlines()
+           if l.startswith("serve-auto: predicted e2e")]
+    assert len(epi) == 1
+    pred = int(epi[0].split("predicted dispatches ")[1].split(",")[0])
+    execd = int(epi[0].split("executed ")[1])
+    assert pred == execd
+
+
+@pytest.mark.slow  # end-to-end CLI: deprecated alias still serves
+def test_serve_cli_arrival_every_deprecated(capsys):
+    from flexflow_tpu.apps import serve
+
+    rc = serve.main([
+        "--max-seq", "32", "--max-batch", "2", "--decode-steps", "4",
+        "--requests", "4", "--max-new", "6", "--vocab", "64",
+        "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--prompt-len", "3:6", "--arrival-every", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "--arrival-every is deprecated" in out
+    assert "policy = slo" in out
+
+
+@pytest.mark.slow  # end-to-end CLI: scheduler dry run audits all ks
+def test_serve_cli_sched_dry_run(capsys):
+    from flexflow_tpu.apps import serve
+
+    rc = serve.main([
+        "--max-seq", "32", "--max-batch", "2", "--decode-steps", "8",
+        "--requests", "4", "--vocab", "64", "--d-model", "16",
+        "--heads", "2", "--layers", "1", "--prompt-len", "3:6",
+        "--sched", "slo", "--dry-run",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DRY RUN OK" in out
+    assert "audit: clean" in out
+    # Every adaptive-k candidate width is shape-checked + audited.
+    for k in (1, 2, 4, 8):
+        assert f"decode k={k}" in out

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Serving-scheduler A/B: the SERVING.md "Scheduler policy" acceptance
+run on the 8-dev virtual CPU mesh.
+
+Three measurements, each against its acceptance bar:
+
+- ``slo_vs_fifo p99``: queue-wait p99 of the SLO-CARRYING class (tier
+  0 — the class the policy exists to protect; the global p99 is
+  work-conservation-invariant and hides the win) under the slo policy
+  (tier+EDF admission, adaptive K, preemption) vs FIFO, same bursty
+  overload workload, REAL engine.  Bar: >= 1.3x.
+- ``slo attainment``: fraction of finite-SLO requests finishing inside
+  their deadline must be STRICTLY higher under the slo policy.
+- ``dispatch exactness``: the simulate-mode run (the serve-auto cost
+  oracle) must predict the real run's dispatch counts EXACTLY — same
+  decision log, same prefill count, same decode-superstep count, and
+  the telemetry program counter must equal prefills + supersteps.
+
+All compared metrics are VIRTUAL-clock values (the latency model's
+deterministic ms), so the paired protocol's A/A control reads exactly
+1.000x — reps vary the workload seed, not the box; the bar measures
+the policy, never wall noise.
+
+Usage: env PYTHONPATH=/root/repo python tools/measure_serving.py
+       [--reps N]
+(re-execs in a clean JAX_PLATFORMS=cpu subprocess with the axon
+sitecustomize dropped, per CLAUDE.md.)
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parent(argv):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return subprocess.call(
+        [sys.executable, os.path.abspath(__file__), "--child"] + argv,
+        env=env,
+    )
+
+
+def _arg(argv, flag, default):
+    if flag in argv:
+        return int(argv[argv.index(flag) + 1])
+    return default
+
+
+def child(argv):
+    os.environ.pop("FF_TELEMETRY_DIR", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    from flexflow_tpu.obs.compare import paired_measure
+    from flexflow_tpu.runtime.serving import ServingExecutor
+    from flexflow_tpu.runtime.telemetry import Telemetry
+    from flexflow_tpu.serving import (
+        ScheduledServer,
+        SchedulerPolicy,
+        SlotShape,
+        WorkloadSpec,
+        make_workload,
+    )
+
+    reps = _arg(argv, "--reps", 5)
+    max_batch, max_seq, buckets = 2, 32, (8,)
+
+    ff = build_transformer_lm(
+        batch_size=max_batch, seq_len=max_seq, vocab_size=32,
+        d_model=16, num_heads=2, num_layers=1,
+        config=FFConfig(batch_size=max_batch),
+    )
+    sex = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                          buckets=buckets)
+    params, state = sex.init(seed=0)
+    slo_pol = SchedulerPolicy(name="slo")
+    fifo_pol = SchedulerPolicy.fifo()
+
+    def workload(seed):
+        # Bursty overload: 24 requests against 2 slots, 12 per burst,
+        # 3 priority tiers, tier-0 SLO 60 virtual ms.
+        return make_workload(WorkloadSpec(
+            n_requests=24, vocab=32, prompt_len=(3, 6), max_new=(2, 12),
+            mean_gap_ms=1.0, burst=12, priorities=3, slo_ms=60.0,
+            seed=5 + seed,
+        ))
+
+    def pct(vals, p):
+        vals = sorted(vals)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(round(p * (len(vals) - 1))))]
+
+    def run_real(policy, seed, tel=None):
+        srv = ScheduledServer(sex, params, state, decode_steps=8,
+                              policy=policy)
+        reqs = workload(seed)
+        tier0 = {r.id for r in reqs if r.priority == 0}
+        if tel is not None:
+            with tel:
+                _, stats = srv.run(reqs)
+        else:
+            _, stats = srv.run(reqs)
+        t0_p99 = pct([srv.last_queue_waits[i] for i in tier0
+                      if i in srv.last_queue_waits], 0.99)
+        return srv, stats, t0_p99
+
+    print(f"serving scheduler A/B: median of {reps} paired ratios "
+          f"(virtual clock, seed varies per rep), 24 reqs / "
+          f"{max_batch} slots / burst 12 / 3 tiers / SLO 60 ms")
+    failures = 0
+
+    # -- slo_vs_fifo tier-0 queue-wait p99 (bar >= 1.3x) ----------------------
+    res = paired_measure(
+        make_a=lambda r: run_real(fifo_pol, r)[2],
+        make_b=lambda r: run_real(slo_pol, r)[2],
+        reps=reps,
+        control=lambda r: run_real(fifo_pol, r)[2],
+    )
+    med, ctl = res.median_ratio, res.median_aa_ratio
+    ok = med >= 1.3
+    print(f"{'slo_vs_fifo p99':<22} {med:>7.3f}x  (bar >= 1.3x, a_a "
+          f"{ctl:.3f}x) {'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
+
+    # -- SLO attainment strictly higher ---------------------------------------
+    worst_gap, atts = None, []
+    for r in range(reps):
+        _, s_slo, _ = run_real(slo_pol, r)
+        _, s_fifo, _ = run_real(fifo_pol, r)
+        gap = s_slo["slo_attainment"] - s_fifo["slo_attainment"]
+        atts.append((s_fifo["slo_attainment"], s_slo["slo_attainment"]))
+        worst_gap = gap if worst_gap is None else min(worst_gap, gap)
+    ok = worst_gap is not None and worst_gap > 0
+    print(f"{'slo attainment':<22} fifo->slo {atts[0][0]:.3f}->"
+          f"{atts[0][1]:.3f} (worst gap {worst_gap:+.3f}, bar > 0) "
+          f"{'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
+
+    # -- sim-vs-real dispatch exactness ---------------------------------------
+    from flexflow_tpu.obs.reader import RunLog
+
+    with tempfile.TemporaryDirectory(prefix="serving_ab_") as d:
+        tel = Telemetry(os.path.join(d, "audit"))
+        path = tel.path
+        real, real_stats, _ = run_real(slo_pol, 0, tel=tel)
+        sim = ScheduledServer.simulated(
+            SlotShape(max_batch=max_batch, max_seq=max_seq,
+                      buckets=buckets),
+            decode_steps=8, policy=slo_pol,
+        )
+        _, sim_stats = sim.run(workload(0))
+        dispatches = real_stats["prefills"] + real_stats["decode_supersteps"]
+        run_log = RunLog.load(path)
+        ev_dispatches = (len(run_log.select("prefill"))
+                         + len(run_log.select("decode_superstep")))
+        checks = [
+            ("decision log", sim.decisions == real.decisions),
+            ("prefills", sim_stats["prefills"] == real_stats["prefills"]),
+            ("supersteps", sim_stats["decode_supersteps"]
+             == real_stats["decode_supersteps"]),
+            ("telemetry events", ev_dispatches == dispatches),
+        ]
+        bad = [n for n, c in checks if not c]
+        ok = not bad
+        print(f"{'dispatch exactness':<22} sim == real: "
+              f"{dispatches} dispatches "
+              f"({real_stats['prefills']} prefills + "
+              f"{real_stats['decode_supersteps']} supersteps)"
+              + (f"; MISMATCH {bad}" if bad else "")
+              + f" {'PASS' if ok else 'FAIL'}")
+        if not ok:
+            failures += 1
+
+    return 1 if failures else 0
+
+
+def main():
+    argv = sys.argv[1:]
+    if "--child" in argv:
+        argv.remove("--child")
+        return child(argv)
+    return parent(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,5 +32,6 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_obs.py \
     tests/test_data_stream.py \
     tests/test_serving.py \
+    tests/test_serving_sched.py \
     tests/test_search.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"
