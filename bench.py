@@ -822,6 +822,47 @@ def bench_serving(n_chips: int, on_tpu: bool):
         fifo["queue_wait_ms_p99"] / max(slo["queue_wait_ms_p99"], 1e-9),
         3,
     )
+
+    # Capacity columns (SERVING.md "Cache layout"): per-slot HBM under
+    # both layouts at the leg's typical short prompt, the max batch a
+    # fixed cache budget admits (the paged-vs-padded capacity win), and
+    # paged / sharded tokens/s against the single-mesh padded run.
+    kv_block = 16 if on_tpu else 8
+    sexp = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                           buckets=(max_seq // 2, max_seq),
+                           kv_block=kv_block)
+    plen = 4
+    out["hbm_per_slot_bytes"] = sex.hbm_per_slot_bytes()
+    out["paged_hbm_per_slot_bytes"] = sexp.hbm_per_slot_bytes(plen, max_new)
+    budget = sex.cache_total_bytes()
+    out["padded_max_admitted_batch"] = sex.max_admissible_batch(
+        budget, plen, max_new)
+    out["paged_max_admitted_batch"] = sexp.max_admissible_batch(
+        budget, plen, max_new)
+
+    def throughput(engine):
+        reqs = lambda: synthetic_requests(
+            n_req, vocab, prompt_len=(4, max_seq // 4),
+            max_new_tokens=max_new, seed=13,
+        )
+        # Per-engine init: same seed = identical weights, placed for
+        # the engine's own mesh (sharded caches reject single-device
+        # params at dispatch).
+        p, s = engine.init(0)
+        srv = Server(engine, p, s, decode_steps=8)
+        srv.run(reqs())  # warm: compiles outside the measured run
+        _, stats = srv.run(reqs())
+        return stats
+
+    pstats = throughput(sexp)
+    out["paged_tokens_per_s"] = round(pstats["tokens_per_s"], 1)
+    sexs = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                           buckets=(max_seq // 2, max_seq), shard=(2, 1))
+    sstats = throughput(sexs)
+    out["sharded_mesh"] = sstats["shard"]  # None = single-mesh fallback
+    out["sharded_tokens_per_s"] = round(sstats["tokens_per_s"], 1)
+    out["sharded_vs_single_mesh_tokens_per_s"] = round(
+        sstats["tokens_per_s"] / max(out["k8_tokens_per_s"], 1e-9), 3)
     return out
 
 

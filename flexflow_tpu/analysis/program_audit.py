@@ -640,12 +640,53 @@ def _exec_config_programs_per_step(stages, microbatches, chunk,
     ).programs_per_step()
 
 
-def audit_serving(sex, decode_steps: int = 8,
-                  prefix: str = "serving") -> List[ProgramViolation]:
+def _serving_cache_avals(sex):
+    """Cache avals in the executor's OWN layout: padded per-slot rows
+    or the paged block pool (SERVING.md "Cache layout")."""
+    import jax
+
+    B, S = sex.max_batch, sex.max_seq
+
+    def aval(h, hd, dt):
+        if sex.paged:
+            return jax.ShapeDtypeStruct(
+                (sex.kv_blocks, sex.kv_block, h, hd), dt)
+        return jax.ShapeDtypeStruct((B, S, h, hd), dt)
+
+    return {
+        name: {"k": aval(h, hd, dt), "v": aval(h, hd, dt)}
+        for name, (h, hd, dt) in sex._cache_specs.items()
+    }
+
+
+def _serving_decode_args(sex, params, op_state, caches):
+    """The decode-superstep argument avals for the executor's layout:
+    the paged variant carries the per-slot block table between caches
+    and positions."""
+    import jax
+    import jax.numpy as jnp
+
+    B = sex.max_batch
+    pos = jax.ShapeDtypeStruct((B,), jnp.int32)
+    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    args = (params, op_state, caches)
+    if sex.paged:
+        args += (jax.ShapeDtypeStruct((B, sex.blocks_per_slot),
+                                      jnp.int32),)
+    return args + (pos, tok)
+
+
+def audit_serving(sex, decode_steps: int = 8, prefix: str = "serving",
+                  sample=None) -> List[ProgramViolation]:
     """Trace-only audit of a built ``ServingExecutor``: purity of
     every prefill bucket and the fused decode superstep (FFP001 is
     exempt — forward-only programs may reach AD-rule-less kernels),
-    plus the K-tokens-per-dispatch shape of the decode accounting."""
+    plus the K-tokens-per-dispatch shape of the decode accounting.
+    Covers whichever cache layout / mesh shard / sampling mode the
+    executor was built with — the paged variant traces with the block
+    table, the sharded one through its shard_map-wrapped kernels, and
+    ``sample=(temperature, top_k, seed)`` audits the in-program
+    sampling head."""
     import jax
     import jax.numpy as jnp
 
@@ -657,7 +698,7 @@ def audit_serving(sex, decode_steps: int = 8,
     params, _opt, op_state = Executor(
         sex.model, config=sex.config
     )._abstract_init()
-    B, S = sex.max_batch, sex.max_seq
+    B = sex.max_batch
     for bucket in sex.buckets:
         toks = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
         ln = jax.ShapeDtypeStruct((), jnp.int32)
@@ -672,31 +713,23 @@ def audit_serving(sex, decode_steps: int = 8,
                 f"prefill failed to trace: {type(e).__name__}: {e}"))
             continue
         out += purity_violations(jaxpr, name)
-    caches = {
-        name: {
-            "k": jax.ShapeDtypeStruct((B, S, h, hd), dt),
-            "v": jax.ShapeDtypeStruct((B, S, h, hd), dt),
-        }
-        for name, (h, hd, dt) in sex._cache_specs.items()
-    }
-    pos = jax.ShapeDtypeStruct((B,), jnp.int32)
-    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    caches = _serving_cache_avals(sex)
     k = decode_steps
     name = f"{prefix}/decode_k{k}"
-    decode = sex.build_decode_superstep(k)
+    decode = sex.build_decode_superstep(k, sample=sample)
+    args = _serving_decode_args(sex, params, op_state, caches)
+    if sample is not None:
+        args += (jax.ShapeDtypeStruct((B,), jnp.int32),)
     try:
-        jaxpr = jax.make_jaxpr(decode)(
-            params, op_state, caches, pos, tok
-        )
+        jaxpr = jax.make_jaxpr(decode)(*args)
     except Exception as e:
         return out + [ProgramViolation(
             "FFP002", name,
             f"decode superstep failed to trace: {type(e).__name__}: {e}")]
     out += purity_violations(jaxpr, name)
     # FFP004: K tokens per dispatch across the whole slot batch.
-    _, _, _, (toks_out, okf) = jax.eval_shape(
-        decode, params, op_state, caches, pos, tok
-    )
+    shapes = jax.eval_shape(decode, *args)
+    toks_out = shapes[3][0]
     if tuple(toks_out.shape) != (k, B):
         out.append(ProgramViolation(
             "FFP004", name,
@@ -717,20 +750,14 @@ def _donation_serving(sex, decode_steps: int = 8) -> List[ProgramViolation]:
     params, _opt, op_state = Executor(
         sex.model, config=sex.config
     )._abstract_init()
-    B, S = sex.max_batch, sex.max_seq
-    caches = {
-        name: {
-            "k": jax.ShapeDtypeStruct((B, S, h, hd), dt),
-            "v": jax.ShapeDtypeStruct((B, S, h, hd), dt),
-        }
-        for name, (h, hd, dt) in sex._cache_specs.items()
-    }
-    pos = jax.ShapeDtypeStruct((B,), jnp.int32)
-    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    caches = _serving_cache_avals(sex)
+    args = _serving_decode_args(sex, params, op_state, caches)
+    # Donated decode state = caches + pos + tok; the block table (the
+    # paged variant's extra arg) is host-owned and NOT donated.
+    donated = (caches, args[-2], args[-1])
     return donation_violations(
         sex.build_decode_superstep(decode_steps),
-        f"serving/decode_k{decode_steps}", (caches, pos, tok),
-        params, op_state, caches, pos, tok,
+        f"serving/decode_k{decode_steps}", donated, *args,
     )
 
 
@@ -804,13 +831,23 @@ def audit_repo(fast: bool = True) -> List[ProgramViolation]:
     pipec = PipelineExecutor(ffc, storec, microbatches=4, compiled=True)
     out += _audit_pipeline(pipec, prefix="pipeline_compiled", fast=fast)
 
-    # Serving family.
+    # Serving families: padded baseline, in-program sampling head,
+    # paged KV pool, and the sharded (n x c) decode mesh.
     sex = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
                           buckets=(8, 16))
     out += audit_serving(sex, decode_steps=4)
+    out += audit_serving(sex, decode_steps=4, prefix="serving_sampled",
+                         sample=(0.8, 8, 0))
+    sex_paged = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
+                                buckets=(8, 16), kv_block=4)
+    out += audit_serving(sex_paged, decode_steps=4, prefix="serving_paged")
+    sex_shard = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
+                                buckets=(8, 16), shard=(2, 2))
+    out += audit_serving(sex_shard, decode_steps=4, prefix="serving_sharded")
 
     if not fast:
         out += _donation_serving(sex, decode_steps=4)
+        out += _donation_serving(sex_paged, decode_steps=4)
         out += _accounting_live_violations()
     return out
 

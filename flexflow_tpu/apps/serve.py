@@ -27,11 +27,32 @@ Flags beyond the common set:
   --no-decode-kernel force the pure-jnp decode oracle (A/B, tests)
   --vocab --d-model --heads --layers   model shape (transformer app)
 
+Capacity flags (SERVING.md "Cache layout"):
+  --kv-block N       paged KV caches: N-token blocks + per-slot block
+                     tables instead of pad-to-max_seq rows (0 = padded;
+                     N must divide max_seq)
+  --kv-blocks N      paged pool size incl. the scratch block (default:
+                     worst case, max_batch * max_seq/kv_block + 1 —
+                     shrink it to serve under an HBM budget)
+  --shard N,C        shard the decode batch over mesh axis n and the
+                     KV heads over c (build_mesh_plan over N*C
+                     devices); falls back loudly below N*C devices
+
+Sampling flags (greedy stays the default and the parity oracle):
+  --temperature T    in-program temperature sampling (0 = greedy)
+  --top-k N          restrict sampling to the N best logits (0 = all)
+  --sample-seed S    base sampling seed; draws are keyed by
+                     (S, request id, position) — replayable across
+                     batch compositions and superstep boundaries
+
 Scheduler flags (each enables the scheduled path):
   --sched POLICY     fifo | slo (default slo when another scheduler
                      flag is present)
-  --workload-trace   zipf/bursty open-loop workload (data/trace.py
-                     shape) instead of the uniform stream
+  --workload-trace [SRC]  open-loop workload instead of the uniform
+                     stream: bare = zipf/bursty lengths (data/trace.py
+                     shape); ``prod[:alpha=A]`` = prompt tokens read
+                     LIVE from data/trace.py ProductionTraceSource
+                     (the shared power-law id source)
   --trace-alpha A    zipf skew for prompt/output lengths (1.5)
   --mean-gap-ms X    mean inter-arrival gap, virtual ms (8.0)
   --burst N          requests arriving back-to-back per burst (4)
@@ -40,12 +61,14 @@ Scheduler flags (each enables the scheduled path):
   --priorities N     priority tiers, 0 = highest (1)
   --shed-depth N     shed waiting requests past this queue depth (0 =
                      off)
-  --serve-auto       search (buckets x K x max_batch x policy knobs)
-                     against the calibrated serving latency model and
-                     run the winner (--calibration feeds constants)
-  --arrival-every N  DEPRECATED superstep-index arrival knob: now an
-                     alias for a uniform workload trace with one
-                     arrival per modeled superstep interval
+  --serve-auto       search (buckets x K x max_batch x kv layout x
+                     policy knobs) against the calibrated serving
+                     latency model and run the winner (--calibration
+                     feeds constants)
+
+``--arrival-every`` is RETIRED (PR 12's one-release deprecation grace
+is up): the run refuses it loudly — use ``--workload-trace`` or
+``serving.workload.uniform_workload(every_ms=...)``.
 
 Example::
 
@@ -81,6 +104,21 @@ def _pop_flag(argv, flag):
         argv.remove(flag)
         return True
     return False
+
+
+def _pop_opt_str(argv, flag):
+    """A flag with an OPTIONAL value: absent -> None, bare -> "",
+    ``--flag val`` -> "val" (a following ``-...`` token is not
+    consumed)."""
+    if flag not in argv:
+        return None
+    i = argv.index(flag)
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        val = argv[i + 1]
+        del argv[i:i + 2]
+        return val
+    del argv[i]
+    return ""
 
 
 def _dry_run(sex, decode_ks) -> int:
@@ -149,7 +187,13 @@ def main(argv=None) -> int:
     decode_steps = pop_int(argv, "--decode-steps", 8)
     n_requests = pop_int(argv, "--requests", 8)
     max_new = pop_int(argv, "--max-new", 16)
-    arrival_every = pop_int(argv, "--arrival-every", 0)
+    if "--arrival-every" in argv:
+        raise SystemExit(
+            "--arrival-every is retired (its PR 12 deprecation grace "
+            "is up): pass an open-loop workload instead — "
+            "--workload-trace on this CLI, or "
+            "serving.workload.uniform_workload(every_ms=...) in code."
+        )
     eos = pop_int(argv, "--eos", -1)
     vocab = pop_int(argv, "--vocab", 32 * 1024)
     d_model = pop_int(argv, "--d-model", 512)
@@ -158,10 +202,16 @@ def main(argv=None) -> int:
     plen_s = _pop_str(argv, "--prompt-len", "4:12")
     buckets_s = _pop_str(argv, "--buckets", "")
     no_kernel = _pop_flag(argv, "--no-decode-kernel")
+    kv_block = pop_int(argv, "--kv-block", 0)
+    kv_blocks = pop_int(argv, "--kv-blocks", 0)
+    shard_s = _pop_str(argv, "--shard", "")
+    temperature = pop_float(argv, "--temperature", 0.0)
+    top_k = pop_int(argv, "--top-k", 0)
+    sample_seed = pop_int(argv, "--sample-seed", 0)
     # Scheduler flags (SERVING.md "Scheduler policy"): any of them
     # routes through the SLO-aware scheduled path.
     sched_s = _pop_str(argv, "--sched", "")
-    workload_trace = _pop_flag(argv, "--workload-trace")
+    workload_trace = _pop_opt_str(argv, "--workload-trace")
     trace_alpha = pop_float(argv, "--trace-alpha", 1.5)
     mean_gap_ms = pop_float(argv, "--mean-gap-ms", 8.0)
     burst = pop_int(argv, "--burst", 4)
@@ -176,6 +226,19 @@ def main(argv=None) -> int:
         raise SystemExit("--prompt-len expects LO:HI")
     if sched_s and sched_s not in ("fifo", "slo"):
         raise SystemExit(f"--sched expects fifo|slo, got {sched_s!r}")
+    if workload_trace not in (None, "", "zipf") \
+            and not workload_trace.startswith("prod"):
+        raise SystemExit(
+            f"--workload-trace expects nothing, 'zipf' or "
+            f"'prod[:alpha=A]', got {workload_trace!r}"
+        )
+    shard = None
+    if shard_s:
+        try:
+            sn, sc = (int(v) for v in shard_s.split(","))
+        except ValueError:
+            raise SystemExit("--shard expects N,C (e.g. --shard 2,2)")
+        shard = (sn, sc)
     if buckets_s:
         buckets = tuple(int(b) for b in buckets_s.split(","))
     else:
@@ -184,8 +247,8 @@ def main(argv=None) -> int:
     buckets = tuple(b for b in buckets if b <= max_seq)
 
     use_sched = bool(
-        sched_s or workload_trace or slo_ms > 0 or priorities > 0
-        or shed_depth > 0 or serve_auto or arrival_every > 0
+        sched_s or workload_trace is not None or slo_ms > 0
+        or priorities > 0 or shed_depth > 0 or serve_auto
     )
     if not use_sched:
         return _run_legacy(
@@ -193,26 +256,31 @@ def main(argv=None) -> int:
             decode_steps=decode_steps, n_requests=n_requests,
             max_new=max_new, eos=eos, vocab=vocab, d_model=d_model,
             heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
-            no_kernel=no_kernel,
+            no_kernel=no_kernel, kv_block=kv_block, kv_blocks=kv_blocks,
+            shard=shard, temperature=temperature, top_k=top_k,
+            sample_seed=sample_seed,
         )
     return _run_scheduled(
         cfg, max_seq=max_seq, max_batch=max_batch,
         decode_steps=decode_steps, n_requests=n_requests,
         max_new=max_new, eos=eos, vocab=vocab, d_model=d_model,
         heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
-        no_kernel=no_kernel, policy_name=sched_s or "slo",
+        no_kernel=no_kernel, kv_block=kv_block, kv_blocks=kv_blocks,
+        shard=shard, temperature=temperature, top_k=top_k,
+        sample_seed=sample_seed, policy_name=sched_s or "slo",
         workload_trace=workload_trace, trace_alpha=trace_alpha,
         mean_gap_ms=mean_gap_ms, burst=burst, slo_ms=slo_ms,
         priorities=max(priorities, 1), shed_depth=shed_depth,
-        serve_auto=serve_auto, arrival_every=arrival_every,
+        serve_auto=serve_auto,
     )
 
 
 def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 max_new, eos, vocab, d_model, heads, layers, lo, hi,
-                buckets, no_kernel) -> int:
-    """The closed-loop FIFO path, unchanged — still the chaos decode-
-    fault harness and the scheduler's numerics oracle."""
+                buckets, no_kernel, kv_block, kv_blocks, shard,
+                temperature, top_k, sample_seed) -> int:
+    """The closed-loop FIFO path — still the chaos decode-fault
+    harness and the scheduler's numerics oracle."""
     from flexflow_tpu.runtime import telemetry as _telemetry
     from flexflow_tpu.runtime.serving import (
         Server,
@@ -227,6 +295,7 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
     sex = ServingExecutor(
         ff, cfg, max_batch=max_batch, max_seq=max_seq, buckets=buckets,
         decode_kernel=False if no_kernel else None,
+        kv_block=kv_block, kv_blocks=kv_blocks or None, shard=shard,
     )
     if cfg.dry_run:
         # Inside maybe_run so the dry run's `analysis` audit event
@@ -246,12 +315,15 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             max_new_tokens=max_new, seed=cfg.seed,
         )
         srv = Server(sex, params, state, decode_steps=decode_steps,
-                     eos_id=None if eos < 0 else eos)
+                     eos_id=None if eos < 0 else eos,
+                     temperature=temperature, top_k=top_k,
+                     sample_seed=sample_seed)
         t0 = time.perf_counter()
         results, stats = srv.run(requests)
         elapsed = time.perf_counter() - t0
     print(f"requests = {stats['requests']} "
           f"completed = {stats['completed']} failed = {stats['failed']}")
+    _print_layout(stats)
     print(f"time = {elapsed:.4f}s")
     print(f"tokens/s = {stats['tokens_per_s']:.1f}")
     print(f"request latency p50 = {stats['request_latency_ms_p50']:.1f} ms "
@@ -264,9 +336,10 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
 
 def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                    max_new, eos, vocab, d_model, heads, layers, lo, hi,
-                   buckets, no_kernel, policy_name, workload_trace,
-                   trace_alpha, mean_gap_ms, burst, slo_ms, priorities,
-                   shed_depth, serve_auto, arrival_every) -> int:
+                   buckets, no_kernel, kv_block, kv_blocks, shard,
+                   temperature, top_k, sample_seed, policy_name,
+                   workload_trace, trace_alpha, mean_gap_ms, burst,
+                   slo_ms, priorities, shed_depth, serve_auto) -> int:
     from flexflow_tpu.runtime import telemetry as _telemetry
     from flexflow_tpu.runtime.serving import ServingExecutor
     from flexflow_tpu.runtime.trainer import relay_safe_steps
@@ -277,6 +350,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         SlotShape,
         WorkloadSpec,
         make_workload,
+        production_workload,
         search_serving_config,
         uniform_workload,
     )
@@ -290,28 +364,33 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
 
     with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
         model = _latency_model(cfg)
-        if workload_trace:
-            requests = make_workload(WorkloadSpec(
+        if workload_trace is not None:
+            spec = WorkloadSpec(
                 n_requests=n_requests, vocab=vocab,
                 prompt_len=(lo, hi), prompt_alpha=trace_alpha,
                 max_new=(1, max_new), output_alpha=trace_alpha,
                 mean_gap_ms=mean_gap_ms, burst=burst,
                 priorities=priorities, slo_ms=base_slo, seed=cfg.seed,
-            ))
+            )
+            if workload_trace.startswith("prod"):
+                # LIVE data-plane trace: prompt tokens read from
+                # data/trace.py ProductionTraceSource (shared source).
+                args = workload_trace[5:] \
+                    if workload_trace.startswith("prod:") else ""
+                kv = dict(p.split("=", 1) for p in args.split(",") if p)
+                id_alpha = float(kv.pop("alpha", 1.2))
+                if kv:
+                    raise SystemExit(
+                        f"--workload-trace prod: unknown args "
+                        f"{sorted(kv)} (supported: alpha=A)"
+                    )
+                requests = production_workload(spec, id_alpha=id_alpha)
+            else:
+                requests = make_workload(spec)
         else:
-            every_ms = 0.0
-            if arrival_every > 0:
-                # The deprecated superstep-index knob, mapped onto the
-                # virtual clock: one arrival per N modeled supersteps.
-                every_ms = arrival_every * model.decode_ms(decode_steps)
-                print("WARNING: --arrival-every is deprecated; it now "
-                      "aliases a uniform workload trace (one arrival "
-                      f"per {every_ms:.2f} virtual ms). Use "
-                      "--workload-trace / serving.workload instead.")
             requests = uniform_workload(
                 n_requests, vocab, prompt_len=(lo, hi),
-                max_new_tokens=max_new, every_ms=every_ms,
-                seed=cfg.seed, slo_ms=base_slo,
+                max_new_tokens=max_new, seed=cfg.seed, slo_ms=base_slo,
             )
 
         choice = None
@@ -319,6 +398,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             baseline = ServingConfig(
                 buckets=buckets, decode_steps=decode_steps,
                 max_batch=max_batch, max_seq=max_seq, policy=policy,
+                kv_block=kv_block, kv_blocks=kv_blocks or None,
+                shard=shard,
             )
             res = search_serving_config(requests, baseline, model)
             choice = res.chosen
@@ -331,6 +412,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             decode_steps = choice.config.decode_steps
             max_batch = choice.config.max_batch
             policy = choice.config.policy
+            kv_block = choice.config.kv_block
+            kv_blocks = choice.config.kv_blocks or 0
             _telemetry.current().emit(
                 "search", kind="serving",
                 chosen=choice.config.to_json(),
@@ -353,10 +436,12 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             ff, cfg, max_batch=max_batch, max_seq=max_seq,
             buckets=buckets,
             decode_kernel=False if no_kernel else None,
+            kv_block=kv_block, kv_blocks=kv_blocks or None, shard=shard,
         )
         srv_proto = ScheduledServer.simulated(
             SlotShape(max_batch=max_batch, max_seq=max_seq,
-                      buckets=buckets),
+                      buckets=buckets, kv_block=kv_block,
+                      kv_blocks=kv_blocks or None),
             decode_steps=decode_steps, policy=policy,
             latency_model=model,
         )
@@ -372,7 +457,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         srv = ScheduledServer(
             sex, params, state, decode_steps=decode_steps,
             eos_id=None if eos < 0 else eos, policy=policy,
-            latency_model=model,
+            latency_model=model, temperature=temperature, top_k=top_k,
+            sample_seed=sample_seed,
         )
         t0 = time.perf_counter()
         results, stats = srv.run(requests)
@@ -383,6 +469,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
           f"completed = {stats['completed']} failed = {stats['failed']} "
           f"shed = {stats['request_sheds']} "
           f"preempted = {stats['request_preempts']}")
+    _print_layout(stats)
     print(f"time = {elapsed:.4f}s")
     print(f"tokens/s = {stats['tokens_per_s']:.1f}")
     print(f"queue wait p50 = {stats['queue_wait_ms_p50']:.1f} ms "
@@ -403,6 +490,17 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
               f"executed "
               f"{stats['prefills'] + stats['decode_supersteps']}")
     return _report_failures(results, stats)
+
+
+def _print_layout(stats) -> None:
+    if stats.get("kv_layout") == "paged":
+        print(f"kv layout = paged ({stats['kv_blocks']} x "
+              f"{stats['kv_block']}-token blocks incl. scratch)")
+    if stats.get("shard"):
+        n, c = stats["shard"]
+        print(f"mesh shard = batch n={n} x heads c={c}")
+    if stats.get("sampled"):
+        print("sampling = seeded temperature/top-k (replayable)")
 
 
 def _report_failures(results, stats) -> int:

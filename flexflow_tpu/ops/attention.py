@@ -275,8 +275,12 @@ class MultiHeadAttention(Op):
     # - **decode** (t == 1): the token at position ``pos`` writes its
     #   K/V at ``cache[b, pos[b]]`` and attends key positions
     #   ``<= pos`` via the Pallas ``flash_decode`` kernel (q_len=1
-    #   streaming softmax over cache blocks) or the pure-jnp
-    #   ``_einsum_decode`` oracle.
+    #   streaming softmax over cache blocks; shard_map-wrapped when a
+    #   multi-device serving plan is bound) or the pure-jnp
+    #   ``_einsum_decode`` oracle.  When ``state`` additionally
+    #   carries ``block_table``, the caches are PAGED global block
+    #   pools and decode scatters/gathers through the table
+    #   (runtime/serving.py KVBlockLedger).
     #
     # Training never sets cache keys, so the differentiable pure-jnp
     # contract on the training path is untouched (the decode kernel
@@ -294,22 +298,35 @@ class MultiHeadAttention(Op):
         q, k, v = self._project(params, x)
         qh, kh, vh = map(self._split_heads, (q, k, v))   # (B, h, t, hd)
         b, h, t, hd = qh.shape
-        if t == 1:
+        if t == 1 and "block_table" in state:
+            # Paged decode (SERVING.md "Cache layout"): ck/cv are the
+            # GLOBAL block pools (kv_blocks, kv_block, h, hd); the
+            # per-slot block table (B, nblk) int32 maps each slot's
+            # logical kv_block-sized chunks onto pool blocks.  The
+            # token at ``pos`` scatters into its slot's owning block
+            # at (pos // bs, pos % bs); attention then gathers the
+            # slot's blocks into a transient padded (B, nblk*bs, ...)
+            # view and runs the einsum oracle — persistent HBM is the
+            # pool alone, which is what the capacity win measures.
+            # Positions past a slot's reservation map to scratch
+            # block 0, whose garbage the <= pos mask excludes.
+            pos = state["pos"]
+            bt = state["block_table"]
+            bs = ck.shape[1]
+            rows = jnp.arange(b)
+            dest = bt[rows, pos // bs]
+            ck = ck.at[dest, pos % bs].set(kh[:, :, 0].astype(ck.dtype))
+            cv = cv.at[dest, pos % bs].set(vh[:, :, 0].astype(cv.dtype))
+            view_k = ck[bt].reshape(b, -1, h, hd)
+            view_v = cv[bt].reshape(b, -1, h, hd)
+            out = _einsum_decode(qh[:, :, 0], view_k, view_v, pos)
+            y = self._merge_heads(out[:, :, None], x.dtype)
+        elif t == 1:
             pos = state["pos"]
             rows = jnp.arange(b)
             ck = ck.at[rows, pos].set(kh[:, :, 0].astype(ck.dtype))
             cv = cv.at[rows, pos].set(vh[:, :, 0].astype(cv.dtype))
-            use_kernel = self.decode_kernel
-            if use_kernel is None:
-                use_kernel = pallas_kernels.flash_decode_supported(
-                    ck.shape, qh.dtype
-                )
-            if use_kernel:
-                out = pallas_kernels.flash_decode(
-                    qh[:, :, 0], ck, cv, pos + 1
-                )
-            else:
-                out = _einsum_decode(qh[:, :, 0], ck, cv, pos)
+            out = self._decode_attend(qh[:, :, 0], ck, cv, pos)
             y = self._merge_heads(out[:, :, None], x.dtype)
         else:
             ck = ck.at[:, :t].set(kh.transpose(0, 2, 1, 3).astype(ck.dtype))
@@ -322,6 +339,61 @@ class MultiHeadAttention(Op):
         new_state["cache_k"] = ck
         new_state["cache_v"] = cv
         return [out_y], new_state
+
+    def _decode_attend(self, q1, ck, cv, pos):
+        """Padded-layout decode attention dispatch: the Pallas
+        ``flash_decode`` kernel — shard_map-wrapped per local shard
+        when a multi-device plan is bound (batch on 'n', heads on 'c',
+        the ``_flash_dense`` discipline: a pallas_call has no GSPMD
+        partitioning rule) — or the pure-jnp ``_einsum_decode``
+        oracle, which under a mesh partitions via plain GSPMD (decode
+        softmax is local per (batch, head): zero collectives either
+        way).  ``q1``: (B, h, hd)."""
+        plan = getattr(self, "_plan", None)
+        if plan is None or plan.num_devices == 1:
+            use = self.decode_kernel
+            if use is None:
+                use = pallas_kernels.flash_decode_supported(
+                    ck.shape, q1.dtype
+                )
+            if use:
+                return pallas_kernels.flash_decode(q1, ck, cv, pos + 1)
+            return _einsum_decode(q1, ck, cv, pos)
+        (n_entry, n_deg), (c_entry, c_deg) = plan.local_degrees(
+            self._pc, "n", "c"
+        )
+        b, s, h, hd = ck.shape
+        local = (b // max(n_deg, 1), s, h // max(c_deg, 1), hd)
+        supported = (
+            b % max(n_deg, 1) == 0 and h % max(c_deg, 1) == 0
+            and pallas_kernels.flash_decode_supported(local, q1.dtype)
+        )
+        use = self.decode_kernel
+        if use is None:
+            use = supported
+        elif use and not supported:
+            import logging
+
+            logging.getLogger("ff.attention").warning(
+                "%s: sharded flash_decode unsupported for local cache "
+                "shape %s — falling back to the einsum decode oracle "
+                "(single-mesh numerics, GSPMD-partitioned)",
+                self.name, local,
+            )
+            use = False
+        if not use:
+            return _einsum_decode(q1, ck, cv, pos)
+        q_spec = PartitionSpec(n_entry, c_entry, None)
+        kv_spec = PartitionSpec(n_entry, None, c_entry, None)
+        return jax.shard_map(
+            lambda ql, kl, vl, pl: pallas_kernels.flash_decode(
+                ql, kl, vl, pl + 1
+            ),
+            mesh=plan.mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, PartitionSpec(n_entry)),
+            out_specs=q_spec,
+            check_vma=False,
+        )(q1, ck, cv, pos)
 
     def _attend_dense(self, q, k, v, dtype):
         q, k, v = map(self._split_heads, (q, k, v))

@@ -352,10 +352,13 @@ def scenario_loader_fault(root: str) -> Tuple[bool, str]:
                     trajectory(out["losses"], ITERS), out)
 
 
-def _serving_setup():
+def _serving_setup(kv_block: int = 0):
     """Tiny transformer LM serving stack shared by the baseline and
     faulted runs of the serving chaos scenario (one instance = shared
-    compiled programs; params deterministic from the seed)."""
+    compiled programs; params deterministic from the seed).
+    ``kv_block > 0`` builds the paged-KV variant of the same stack —
+    params are identical across layouts, so paged survivor sequences
+    must stay byte-identical to the padded baseline."""
     from flexflow_tpu.models.transformer import build_transformer_lm
     from flexflow_tpu.runtime.serving import ServingExecutor
 
@@ -363,7 +366,8 @@ def _serving_setup():
         batch_size=2, seq_len=32, vocab_size=32, d_model=16,
         num_heads=2, num_layers=1, config=FFConfig(batch_size=2),
     )
-    sex = ServingExecutor(ff, max_batch=2, max_seq=32, buckets=(8,))
+    sex = ServingExecutor(ff, max_batch=2, max_seq=32, buckets=(8,),
+                          kv_block=kv_block)
     params, state = sex.init(seed=0)
     return sex, params, state
 
@@ -412,9 +416,27 @@ def scenario_serving_decode_fault(root: str) -> Tuple[bool, str]:
             return False, (f"serving: request {rid}'s tokens DIVERGED "
                            f"from the unfaulted run (slot-neighbor "
                            f"isolation broken)")
+    # Paged sub-check: the same fault matrix against the paged-KV
+    # stack (the NaN lands in the slot's first pool block via the
+    # block table) — same failure set, and survivors byte-identical
+    # to the PADDED unfaulted baseline.
+    sexp, pparams, pstate = _serving_setup(kv_block=8)
+    pinj = ServingFaultInjector(nan_cache_at={1: 0}, raise_at={3: 0})
+    presults, pstats = Server(sexp, pparams, pstate, decode_steps=4,
+                              fault_injector=pinj).run(_serving_requests())
+    if pstats.get("kv_layout") != "paged":
+        return False, "serving: paged sub-check did not run paged"
+    pfailed = sorted(rid for rid, r in presults.items() if r.error)
+    if pfailed != [0, 2]:
+        return False, (f"serving[paged]: expected requests [0, 2] to "
+                       f"error out, got {pfailed}")
+    for rid in (1, 3):
+        if presults[rid].tokens != base_results[rid].tokens:
+            return False, (f"serving[paged]: request {rid}'s tokens "
+                           f"DIVERGED from the padded unfaulted run")
     return True, ("serving: faulted requests [0, 2] errored out; "
                   "surviving slots' sequences byte-identical to the "
-                  "unfaulted run")
+                  "unfaulted run (padded AND paged layouts)")
 
 
 def scenario_serving_overload_shed(root: str) -> Tuple[bool, str]:
@@ -478,10 +500,29 @@ def scenario_serving_overload_shed(root: str) -> Tuple[bool, str]:
         if res_a[rid].tokens != res_c[rid].tokens:
             return False, (f"overload_shed: survivor {rid}'s tokens "
                            f"DIVERGED from the no-shedding run")
+    # Paged sub-check: the identical overload on the paged-KV stack
+    # (pool sized at the worst case, so admission decisions match) —
+    # same shed set, same decision log, every result byte-identical
+    # to the padded run.
+    sexp, pparams, pstate = _serving_setup(kv_block=8)
+    srv_p = ScheduledServer(sexp, pparams, pstate, decode_steps=4,
+                            policy=policy)
+    res_p, stats_p = srv_p.run(overload())
+    if stats_p.get("kv_layout") != "paged":
+        return False, "overload_shed: paged sub-check did not run paged"
+    shed_p = sorted(rid for rid, r in res_p.items()
+                    if r.error and r.error.startswith("shed"))
+    if shed_p != shed_a or srv_p.decisions != dec_a:
+        return False, (f"overload_shed[paged]: decisions DIVERGED from "
+                       f"the padded run — shed {shed_p} vs {shed_a}")
+    for rid in res_a:
+        if res_p[rid].tokens != res_a[rid].tokens:
+            return False, (f"overload_shed[paged]: request {rid}'s "
+                           f"tokens DIVERGED from the padded run")
     return True, (f"overload_shed: requests {shed_a} shed "
                   f"deterministically across replays; all "
                   f"{len(res_c)} survivors byte-identical to the "
-                  f"no-shedding run")
+                  f"no-shedding run (padded AND paged layouts)")
 
 
 SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {

@@ -37,6 +37,26 @@ over cache blocks, per-slot length masking) and the pure-jnp
 training checkpoints via the strategy-portable ``CheckpointManager``
 restore — the train->serve handoff (SERVING.md).
 
+Two capacity regimes extend the PR-7 single-mesh pad-to-max_seq
+engine (SERVING.md "Cache layout"):
+
+- **Sharded decode** (``shard=(n, c)``): the slot batch shards over
+  mesh axis ``n`` and heads over ``c`` (the training strategy axes,
+  via ``build_mesh_plan`` + ``ParallelConfig``) so per-layer caches
+  are ``NamedSharding``-placed and the fused decode superstep runs as
+  one sharded whole-graph program; ``flash_decode`` is shard_map-
+  wrapped per local shard (the ``_flash_dense`` discipline), the
+  einsum oracle stays the single-mesh fallback.
+- **Paged KV caches** (``kv_block > 0``): per-layer caches become a
+  global pool of fixed-size KV blocks ``(kv_blocks, kv_block, h, hd)``
+  plus a per-slot block table, so HBM per slot scales with the
+  request's ACTUAL reserved length (``KVBlockLedger.blocks_for``) —
+  not worst-case ``max_seq`` — and admission is gated by the
+  host-side :class:`KVBlockLedger` free list.  Block 0 is a reserved
+  scratch block: inactive slots and bounded-speculation overflow
+  writes land there and are never read by an active slot's masked
+  attention, keeping survivors byte-identical under chaos.
+
 Fault isolation (chaos matrix: ``runtime/chaos.py`` serving scenario):
 slots are independent in the batch dimension, per-slot logits carry an
 in-program finiteness flag read at the superstep fence, and a faulted
@@ -103,8 +123,14 @@ class ServingFaultInjector:
         #: Log of ("nan_cache"|"raise", superstep, slot) fired.
         self.fired: List[Tuple[str, int, int]] = []
 
-    def before_superstep(self, idx: int, caches):
-        """Returns possibly-corrupted caches; may raise ServingFault."""
+    def before_superstep(self, idx: int, caches, block_table=None):
+        """Returns possibly-corrupted caches; may raise ServingFault.
+
+        ``block_table`` (host (B, nblk) int32) switches the NaN
+        injection to the paged layout: the target slot's FIRST owned
+        pool block goes NaN — the paged analogue of NaNing the slot's
+        padded cache row (never the shared scratch block 0, which
+        would leak the fault across slots)."""
         if idx in self.raise_at:
             slot = self.raise_at.pop(idx)
             self.fired.append(("raise", idx, slot))
@@ -118,11 +144,15 @@ class ServingFaultInjector:
                                       superstep=idx, slot=slot)
             name = next(iter(caches))
             k = caches[name]["k"]
+            if block_table is not None:
+                dest = int(block_table[slot][0])
+                if dest == 0:  # slot owns no blocks: nothing to corrupt
+                    return caches
+                k = k.at[dest].set(jnp.nan)
+            else:
+                k = k.at[slot].set(jnp.nan)
             caches = dict(caches)
-            caches[name] = {
-                "k": k.at[slot].set(jnp.nan),
-                "v": caches[name]["v"],
-            }
+            caches[name] = {"k": k, "v": caches[name]["v"]}
         return caches
 
 
@@ -135,16 +165,14 @@ class Request:
     on the scheduler's virtual clock, priority tier (0 = highest), and
     the end-to-end deadline in virtual ms (inf = best-effort).
 
-    ``arrival`` — the decode-superstep index at which the request
-    becomes eligible in the legacy closed-loop :class:`Server` —
-    is DEPRECATED in favor of workload-driven ``arrival_ms``
-    (``serving/workload.py``); it is kept as an alias for one release
-    so existing closed-loop call sites keep working."""
+    The PR-7 closed-loop ``arrival`` superstep-index field is GONE
+    (its one-release deprecation grace is up): constructing a Request
+    with ``arrival=`` raises ``TypeError``.  Arrivals are workload-
+    driven ``arrival_ms`` (``serving/workload.py``) everywhere."""
 
     id: int
     prompt: np.ndarray  # 1-D int32 token ids
     max_new_tokens: int = 16
-    arrival: int = 0    # deprecated: superstep-index eligibility knob
     arrival_ms: float = 0.0
     priority: int = 0
     slo_ms: float = float("inf")
@@ -152,6 +180,86 @@ class Request:
     @property
     def deadline_ms(self) -> float:
         return self.arrival_ms + self.slo_ms
+
+
+class KVBlockLedger:
+    """Host-side free-list accounting for the paged KV pool.
+
+    PURE integer arithmetic, deliberately device-free: the SAME ledger
+    gates admission in the real :class:`Server` / ``_RealEngine`` loop
+    and in the scheduler's compute-free ``simulated`` mode, so the
+    simulation stays dispatch-for-dispatch exact on the paged path by
+    construction.
+
+    Block 0 is the SCRATCH block — never allocated.  Inactive slots'
+    table rows point at it, and decode writes past a slot's
+    reservation (the bounded-speculation tail of a fused K-step
+    superstep) land there; no active slot's masked attention ever
+    reads its own reserved region from it.  Freed blocks return to
+    the free list and are reused LOWEST-FIRST (the list stays
+    sorted), so allocation is deterministic across replays.
+    """
+
+    def __init__(self, num_blocks: int, block: int, max_seq: int):
+        if block < 1 or max_seq % block:
+            raise ValueError(
+                f"kv_block must divide max_seq: block={block}, "
+                f"max_seq={max_seq}"
+            )
+        if num_blocks < 2:
+            raise ValueError(
+                f"paged pool needs >= 2 blocks (scratch + 1), got "
+                f"{num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        self.block = int(block)
+        self.max_seq = int(max_seq)
+        #: Table-row width: worst-case blocks a slot could reference.
+        self.blocks_per_slot = self.max_seq // self.block
+        self._free: List[int] = list(range(1, self.num_blocks))
+        self._held: Dict[int, List[int]] = {}
+
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Blocks to RESERVE at admission: every position the request
+        can legitimately write (prompt + generated + the first-token
+        feedback row), capped at the context limit.  Reserving up
+        front means a slot can never exhaust the pool mid-decode."""
+        toks = min(int(prompt_len) + int(max_new_tokens) + 1, self.max_seq)
+        return -(-toks // self.block)
+
+    def can_admit(self, n_blocks: int) -> bool:
+        return n_blocks <= len(self._free)
+
+    def alloc(self, slot: int, n_blocks: int) -> np.ndarray:
+        """Reserve ``n_blocks`` for ``slot``; returns the slot's full
+        ``(blocks_per_slot,)`` int32 table row (unreserved entries
+        point at scratch block 0)."""
+        if slot in self._held:
+            raise RuntimeError(f"slot {slot} already holds KV blocks")
+        if n_blocks > len(self._free):
+            raise RuntimeError(
+                f"paged KV pool exhausted: need {n_blocks} blocks, "
+                f"{len(self._free)} free of {self.capacity_blocks}"
+            )
+        got, self._free = self._free[:n_blocks], self._free[n_blocks:]
+        self._held[slot] = got
+        row = np.zeros((self.blocks_per_slot,), np.int32)
+        row[: len(got)] = got
+        return row
+
+    def free(self, slot: int) -> None:
+        got = self._held.pop(slot, None)
+        if got:
+            self._free = sorted(self._free + got)
 
 
 @dataclasses.dataclass
@@ -192,9 +300,25 @@ class ServingExecutor:
       read back in ONE fence.
 
     Params restore from training checkpoints through the existing
-    strategy-portable ``CheckpointManager`` (:meth:`restore`); serving
-    runs on a single device (``device``, default the first visible) —
-    multi-chip serving sharding is future work (SERVING.md).
+    strategy-portable ``CheckpointManager`` (:meth:`restore`).
+
+    Capacity knobs (SERVING.md "Cache layout"):
+
+    - ``shard=(n, c)``: multi-chip decode — slot batch over mesh axis
+      ``n``, heads over ``c`` (``build_mesh_plan(n*c)`` +
+      ``ParallelConfig(n=n, c=c)``, the training strategy machinery);
+      a hybrid-trained checkpoint restores and serves sharded with no
+      conversion.  Falls back LOUDLY to single-mesh when the box has
+      too few devices.
+    - ``kv_block`` / ``kv_blocks``: paged KV caches — per-layer pools
+      of ``kv_blocks`` fixed-size blocks of ``kv_block`` token
+      positions, per-slot block tables, admission gated by
+      :class:`KVBlockLedger`.  ``kv_block=0`` (default) keeps the
+      padded ``(max_batch, max_seq, ...)`` layout; ``kv_blocks=None``
+      defaults to the worst case (every slot at ``max_seq``) + the
+      scratch block — the capacity win comes from setting it lower
+      under an HBM budget.  Paged and sharded do not compose yet:
+      paged wins, sharding is dropped with a loud warning.
     """
 
     def __init__(
@@ -206,6 +330,9 @@ class ServingExecutor:
         buckets: Optional[Sequence[int]] = None,
         decode_kernel: Optional[bool] = None,
         device: Optional[jax.Device] = None,
+        kv_block: int = 0,
+        kv_blocks: Optional[int] = None,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         self.model = model
         self.config = config or model.config
@@ -248,8 +375,73 @@ class ServingExecutor:
             d = op.inputs[0].shape[-1]
             h = op.attrs["num_heads"]
             self._cache_specs[op.name] = (h, d // h, op.outputs[0].dtype)
+        # -- paged KV layout --
+        self.kv_block = int(kv_block or 0)
+        self.paged = self.kv_block > 0
+        if self.paged:
+            if self.max_seq % self.kv_block:
+                raise ValueError(
+                    f"kv_block must divide max_seq: kv_block="
+                    f"{self.kv_block}, max_seq={self.max_seq}"
+                )
+            self.blocks_per_slot = self.max_seq // self.kv_block
+            worst = self.max_batch * self.blocks_per_slot + 1
+            self.kv_blocks = int(kv_blocks) if kv_blocks else worst
+            if self.kv_blocks < 2:
+                raise ValueError(
+                    f"kv_blocks must be >= 2 (scratch + 1), got "
+                    f"{self.kv_blocks}"
+                )
+        else:
+            if kv_blocks:
+                raise ValueError("kv_blocks needs kv_block > 0 (paged mode)")
+            self.blocks_per_slot = 0
+            self.kv_blocks = 0
+        # -- sharded decode (batch on 'n', heads on 'c') --
+        self._plan = None
+        self._pc = None
+        if shard is not None and self.paged:
+            _log.warning(
+                "paged KV caches and sharded decode do not compose yet: "
+                "dropping shard=%s, serving paged on the single mesh",
+                tuple(shard),
+            )
+            shard = None
+        if shard is not None:
+            n, c = int(shard[0]), int(shard[1])
+            if n < 1 or c < 1 or n * c < 2:
+                raise ValueError(f"shard=(n, c) needs n*c >= 2, got {shard}")
+            ndev = len(jax.devices())
+            if ndev < n * c:
+                _log.warning(
+                    "sharded decode needs %d devices, have %d: falling "
+                    "back to the single-mesh engine", n * c, ndev,
+                )
+            else:
+                if self.max_batch % n:
+                    raise ValueError(
+                        f"shard batch degree n={n} must divide "
+                        f"max_batch={self.max_batch}"
+                    )
+                bad = [
+                    name for name, (h, _hd, _dt) in self._cache_specs.items()
+                    if h % c
+                ]
+                if bad:
+                    raise ValueError(
+                        f"shard head degree c={c} must divide num_heads "
+                        f"of every attention op; offenders: {bad}"
+                    )
+                from flexflow_tpu.parallel.mesh import build_mesh_plan
+                from flexflow_tpu.parallel.strategy import ParallelConfig
+
+                self._plan = build_mesh_plan(num_devices=n * c)
+                self._pc = ParallelConfig(n=n, c=c)
+        self.shard = (
+            (self._pc.n, self._pc.c) if self._pc is not None else None
+        )
         self._prefill_fns: Dict[int, Any] = {}
-        self._decode_fns: Dict[Tuple[int, bool], Any] = {}
+        self._decode_fns: Dict[Tuple, Any] = {}
 
     # -- params / checkpoint handoff ---------------------------------------
 
@@ -263,6 +455,11 @@ class ServingExecutor:
         return Executor(self.model, config=self.config).init()
 
     def _place(self, tree):
+        if self._plan is not None:
+            # Sharded mode: params/op_state replicate over the decode
+            # mesh (mixing mesh-sharded caches with a single committed
+            # device would reject at dispatch).
+            return jax.device_put(tree, self._plan.replicated())
         return jax.device_put(tree, self.device)
 
     def init(self, seed: Optional[int] = None):
@@ -291,10 +488,140 @@ class ServingExecutor:
 
     # -- caches -------------------------------------------------------------
 
+    @property
+    def _bytes_per_token(self) -> int:
+        """Bytes one cached token position costs across ALL layers
+        (K and V)."""
+        return sum(
+            2 * h * hd * jnp.dtype(dt).itemsize
+            for (h, hd, dt) in self._cache_specs.values()
+        )
+
+    def cache_total_bytes(self) -> int:
+        """Per-device bytes :meth:`init_cache` will allocate (the
+        ``DeviceMemoryError`` budget estimate)."""
+        if self.paged:
+            total = self.kv_blocks * self.kv_block * self._bytes_per_token
+        else:
+            total = self.max_batch * self.max_seq * self._bytes_per_token
+        if self._plan is not None:
+            total //= self._plan.num_devices
+        return total
+
+    def hbm_per_slot_bytes(
+        self, prompt_len: Optional[int] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> int:
+        """KV-cache HBM one decode slot costs.  Padded: the full
+        worst-case ``max_seq`` row, regardless of request length.
+        Paged: the blocks :class:`KVBlockLedger` would reserve for a
+        ``(prompt_len, max_new_tokens)`` request (defaults: the
+        worst case, where the two layouts coincide up to rounding)."""
+        if not self.paged:
+            return self.max_seq * self._bytes_per_token
+        if prompt_len is None:
+            blocks = self.blocks_per_slot
+        else:
+            led = KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq)
+            blocks = led.blocks_for(
+                prompt_len,
+                self.max_seq if max_new_tokens is None else max_new_tokens,
+            )
+        return blocks * self.kv_block * self._bytes_per_token
+
+    def max_admissible_batch(
+        self, budget_bytes: int, prompt_len: int, max_new_tokens: int
+    ) -> int:
+        """How many CONCURRENT decode slots a cache-HBM budget admits
+        for uniform ``(prompt_len, max_new_tokens)`` requests — the
+        paged-vs-padded capacity comparison, compute-free.  Padded is
+        bounded by worst-case ``max_seq`` rows; paged by the block
+        pool the budget can hold."""
+        if not self.paged:
+            return budget_bytes // (self.max_seq * self._bytes_per_token)
+        block_bytes = self.kv_block * self._bytes_per_token
+        pool_blocks = budget_bytes // block_bytes - 1  # scratch
+        led = KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq)
+        need = led.blocks_for(prompt_len, max_new_tokens)
+        return max(pool_blocks, 0) // need
+
+    def make_ledger(self) -> KVBlockLedger:
+        """The paged pool's host-side accounting (raises unless
+        paged) — one per serving loop; real and simulated loops build
+        identical ledgers, which is what keeps simulate admission
+        exact."""
+        if not self.paged:
+            raise ValueError("make_ledger() needs kv_block > 0 (paged mode)")
+        return KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq)
+
+    def _budget_check(self):
+        """Refuse BEFORE the first ``device_put`` when the KV cache
+        cannot fit the per-device budget — the ``DeviceMemoryError``
+        estimate machinery (``data/loader.py``), reused so serving
+        capacity is measurable under ``FF_DEVICE_MEM_BYTES``."""
+        from flexflow_tpu.data.loader import (
+            DeviceMemoryError, _device_bytes_limit,
+        )
+
+        limit = _device_bytes_limit()
+        if limit is None:
+            return
+        total = self.cache_total_bytes()
+        if total > limit:
+            layout = (
+                f"paged pool ({self.kv_blocks} x {self.kv_block}-token "
+                f"blocks)" if self.paged else
+                f"padded ({self.max_batch} slots x {self.max_seq} rows)"
+            )
+            hint = (
+                "shrink kv_blocks or kv_block" if self.paged else
+                "switch to the paged layout (kv_block > 0, SERVING.md "
+                "'Cache layout') so HBM scales with actual generated "
+                "length instead of worst-case max_seq"
+            )
+            raise DeviceMemoryError(
+                f"KV cache needs {total} bytes/device ({layout}) but the "
+                f"device budget is {limit} bytes "
+                f"(FF_DEVICE_MEM_BYTES / memory_stats): {hint}"
+            )
+
     def init_cache(self):
-        """Preallocated per-layer KV caches: ``{op: {"k"/"v":
-        (max_batch, max_seq, heads, d_head)}}`` on the serving device."""
+        """Preallocated per-layer KV caches on the serving device(s).
+
+        Padded: ``{op: {"k"/"v": (max_batch, max_seq, heads,
+        d_head)}}`` (``NamedSharding``-placed batch-on-'n'/
+        heads-on-'c' when sharded).  Paged: ``{op: {"k"/"v":
+        (kv_blocks, kv_block, heads, d_head)}}`` — the global block
+        pool; slot structure lives in the block table."""
+        self._budget_check()
+        if self.paged:
+            NB, bs = self.kv_blocks, self.kv_block
+            return {
+                name: {
+                    "k": self._place(jnp.zeros((NB, bs, h, hd), dt)),
+                    "v": self._place(jnp.zeros((NB, bs, h, hd), dt)),
+                }
+                for name, (h, hd, dt) in self._cache_specs.items()
+            }
         B, S = self.max_batch, self.max_seq
+        if self._plan is not None:
+            return {
+                name: {
+                    "k": jax.device_put(
+                        jnp.zeros((B, S, h, hd), dt),
+                        self._plan.sharding(
+                            self._pc, ("n", None, "c", None), (B, S, h, hd)
+                        ),
+                    ),
+                    "v": jax.device_put(
+                        jnp.zeros((B, S, h, hd), dt),
+                        self._plan.sharding(
+                            self._pc, ("n", None, "c", None), (B, S, h, hd)
+                        ),
+                    ),
+                }
+                for name, (h, hd, dt) in self._cache_specs.items()
+            }
         return {
             name: {
                 "k": self._place(jnp.zeros((B, S, h, hd), dt)),
@@ -314,21 +641,29 @@ class ServingExecutor:
 
     # -- the forward walk ---------------------------------------------------
 
-    def _forward(self, params, op_state, tokens, caches, pos):
+    def _forward(self, params, op_state, tokens, caches, pos,
+                 block_table=None):
         """Forward-only walk over the non-loss op graph in inference
         mode: attention ops get their caches + the per-slot position
         vector through the existing ``state`` mechanism
         (``ops/attention.py`` KV-cache protocol), position embeddings
         get ``pos``; everything else runs its plain eval forward.
+        ``block_table`` (paged layout) rides the same state channel.
         Returns ``(logits, new_caches)``."""
         env: Dict[str, Any] = {self._tokens_name: tokens}
         new_caches: Dict[str, Any] = {}
         for op in self._layers:
-            # Serving runs unsharded on one device: bind a mesh-less
-            # placement so strategy-bound paths (ring attention, TP
-            # linear pinning) stay off regardless of what a training
-            # executor last bound on these shared op objects.
-            op.bind_mesh(None, None)
+            # Single-mesh serving binds a mesh-less placement so
+            # strategy-bound paths (ring attention, TP linear pinning)
+            # stay off regardless of what a training executor last
+            # bound on these shared op objects.  Sharded decode binds
+            # the serving plan to the ATTENTION ops only: they own the
+            # shard_map'd flash_decode and the c-split projections;
+            # every other op partitions via plain GSPMD.
+            if self._plan is not None and isinstance(op, MultiHeadAttention):
+                op.bind_mesh(self._plan, self._pc)
+            else:
+                op.bind_mesh(None, None)
             if isinstance(op, MultiHeadAttention):
                 op.decode_kernel = self.decode_kernel
             xs = [env[t.name] for t in op.inputs]
@@ -337,6 +672,8 @@ class ServingExecutor:
                 s["cache_k"] = caches[op.name]["k"]
                 s["cache_v"] = caches[op.name]["v"]
                 s["pos"] = pos
+                if block_table is not None:
+                    s["block_table"] = block_table
             elif isinstance(op, PositionEmbedding):
                 s["pos"] = pos
             ys, s_new = op.forward(params.get(op.name, {}), xs, s,
@@ -404,30 +741,100 @@ class ServingExecutor:
 
         return jax.jit(install, donate_argnums=(0,))
 
-    def build_decode_superstep(self, k: int, return_logits: bool = False):
+    @functools.cached_property
+    def install_paged(self):
+        """Paged analogue of :meth:`install`: the prefilled
+        ``(max_seq, h, hd)`` rows reshape into ``kv_block``-sized
+        chunks and scatter into the slot's table row of pool blocks
+        (unreserved entries write their all-pad chunks into scratch
+        block 0 — harmless by the scratch contract, and the write
+        fully re-initializes reused blocks after an eviction)."""
+
+        def install(caches, rows, table_row):
+            def put(c, r):
+                chunks = r.astype(c.dtype).reshape((-1,) + c.shape[1:])
+                return c.at[table_row].set(chunks)
+
+            return jax.tree.map(put, caches, rows)
+
+        return jax.jit(install, donate_argnums=(0,))
+
+    def build_decode_superstep(
+        self,
+        k: int,
+        return_logits: bool = False,
+        sample: Optional[Tuple[float, int, int]] = None,
+    ):
         """K fused single-token decode steps as ONE jitted dispatch:
         ``(params, op_state, caches, pos (B,), tok (B,)) -> (caches,
-        pos, tok, (tokens (K, B), finite (K, B)))`` — greedy argmax
+        pos, tok, (tokens (K, B), finite (K, B)))`` — token selection
         INSIDE the scan, so the host sees one program and one fence
         per K tokens across the whole slot batch.  ``return_logits``
         additionally stacks the (K, B, V) logits (test/oracle use
-        only — production keeps the readback K x B ints)."""
+        only — production keeps the readback K x B ints).
+
+        Paged layout: the program takes the per-slot block table
+        after the caches — ``(params, op_state, caches, block_table
+        (B, nblk), pos, tok)`` — and passes it through unchanged.
+
+        ``sample=(temperature, top_k, seed)`` replaces the greedy
+        argmax with in-program temperature/top-k sampling (top_k=0 =
+        full softmax); the program then takes a trailing ``req_ids
+        (B,)`` argument and every draw keys off
+        ``fold_in(fold_in(key(seed), req_id), pos)`` — a pure
+        function of (seed, request, position), so sampled outputs
+        replay bit-identically across superstep boundaries, batch
+        composition, eviction and re-admission (the
+        ``default_rng([seed, req_id])`` idiom, in-program).  Greedy
+        (``sample=None``) stays the default and the parity oracle."""
         if k < 1:
             raise ValueError(f"decode steps per call must be >= 1, got {k}")
-        key = (k, return_logits)
+        if sample is not None:
+            temperature, top_k, sample_seed = sample
+            temperature = float(temperature)
+            top_k = int(top_k)
+            if temperature <= 0.0:
+                raise ValueError(
+                    f"sampling needs temperature > 0, got {temperature} "
+                    f"(greedy is sample=None)"
+                )
+            sample = (temperature, top_k, int(sample_seed))
+        key = (k, return_logits, self.paged, sample)
         fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
         S = self.max_seq
+        base_key = (
+            jax.random.key(sample[2]) if sample is not None else None
+        )
 
-        def superstep(params, op_state, caches, pos, tok):
+        def pick_token(logits, req_ids, pos):
+            if sample is None:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            temperature, top_k, _seed = sample
+
+            def draw(lg, rid, p):
+                kkey = jax.random.fold_in(
+                    jax.random.fold_in(base_key, rid), p
+                )
+                lg = lg.astype(jnp.float32) / temperature
+                if 0 < top_k < lg.shape[-1]:
+                    kth = jax.lax.top_k(lg, top_k)[0][-1]
+                    lg = jnp.where(lg >= kth, lg, -jnp.inf)
+                return jax.random.categorical(kkey, lg).astype(jnp.int32)
+
+            return jax.vmap(draw)(logits, req_ids, pos)
+
+        def run_scan(params, op_state, caches, pos, tok, block_table,
+                     req_ids):
             def body(carry, _):
                 caches, pos, tok = carry
                 logits, caches = self._forward(
-                    params, op_state, tok[:, None], caches, pos
+                    params, op_state, tok[:, None], caches, pos,
+                    block_table=block_table,
                 )
                 logits = logits[:, 0]                      # (B, V)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = pick_token(logits, req_ids, pos)
                 ok = jnp.all(
                     jnp.isfinite(logits.astype(jnp.float32)), axis=-1
                 )
@@ -440,10 +847,37 @@ class ServingExecutor:
             )
             return caches, pos, tok, outs
 
+        if self.paged and sample is not None:
+            def superstep(params, op_state, caches, block_table, pos, tok,
+                          req_ids):
+                return run_scan(params, op_state, caches, pos, tok,
+                                block_table, req_ids)
+            donate = (2, 4, 5)
+        elif self.paged:
+            def superstep(params, op_state, caches, block_table, pos, tok):
+                return run_scan(params, op_state, caches, pos, tok,
+                                block_table, None)
+            donate = (2, 4, 5)
+        elif sample is not None:
+            def superstep(params, op_state, caches, pos, tok, req_ids):
+                return run_scan(params, op_state, caches, pos, tok,
+                                None, req_ids)
+            donate = (2, 3, 4)
+        else:
+            def superstep(params, op_state, caches, pos, tok):
+                return run_scan(params, op_state, caches, pos, tok,
+                                None, None)
+            donate = (2, 3, 4)
+
         fn = self._decode_fns[key] = jax.jit(
-            superstep, donate_argnums=(2, 3, 4)
+            superstep, donate_argnums=donate
         )
-        _telemetry.current().emit("serving_program", kind="decode", k=int(k))
+        _telemetry.current().emit(
+            "serving_program", kind="decode", k=int(k),
+            layout="paged" if self.paged else "padded",
+            sharded=self.shard is not None,
+            sampled=sample is not None,
+        )
         return fn
 
     # -- compute-free mode ---------------------------------------------------
@@ -460,9 +894,17 @@ class ServingExecutor:
             self.model, config=self.config
         )._abstract_init()
         B, S = self.max_batch, self.max_seq
+
+        def cache_aval(h, hd, dt):
+            if self.paged:
+                return jax.ShapeDtypeStruct(
+                    (self.kv_blocks, self.kv_block, h, hd), dt
+                )
+            return jax.ShapeDtypeStruct((B, S, h, hd), dt)
+
         out: Dict[str, Any] = {"prefill": {}, "cache": {}}
         for name, (h, hd, dt) in self._cache_specs.items():
-            out["cache"][name] = jax.ShapeDtypeStruct((B, S, h, hd), dt)
+            out["cache"][name] = cache_aval(h, hd, dt)
         for bucket in self.buckets:
             toks = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
             ln = jax.ShapeDtypeStruct((), jnp.int32)
@@ -472,17 +914,24 @@ class ServingExecutor:
             out["prefill"][bucket] = tok
         caches = {
             name: {
-                "k": jax.ShapeDtypeStruct((B, S, h, hd), dt),
-                "v": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+                "k": cache_aval(h, hd, dt),
+                "v": cache_aval(h, hd, dt),
             }
             for name, (h, hd, dt) in self._cache_specs.items()
         }
         pos = jax.ShapeDtypeStruct((B,), jnp.int32)
         tok = jax.ShapeDtypeStruct((B,), jnp.int32)
-        _, _, _, (toks, okf) = jax.eval_shape(
-            self.build_decode_superstep(decode_steps),
-            params, op_state, caches, pos, tok,
-        )
+        if self.paged:
+            bt = jax.ShapeDtypeStruct((B, self.blocks_per_slot), jnp.int32)
+            _, _, _, (toks, okf) = jax.eval_shape(
+                self.build_decode_superstep(decode_steps),
+                params, op_state, caches, bt, pos, tok,
+            )
+        else:
+            _, _, _, (toks, okf) = jax.eval_shape(
+                self.build_decode_superstep(decode_steps),
+                params, op_state, caches, pos, tok,
+            )
         out["decode"] = toks
         return out
 
@@ -508,6 +957,9 @@ class Server:
         decode_steps: int = 8,
         eos_id: Optional[int] = None,
         fault_injector: Optional[ServingFaultInjector] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        sample_seed: int = 0,
     ):
         self.ex = executor
         self.params = params
@@ -517,6 +969,12 @@ class Server:
         )
         self.eos_id = eos_id
         self.injector = fault_injector
+        #: In-program sampling (temperature <= 0 = greedy, the default
+        #: and the parity oracle; see build_decode_superstep).
+        self.sample: Optional[Tuple[float, int, int]] = (
+            (float(temperature), int(top_k), int(sample_seed))
+            if temperature > 0.0 else None
+        )
 
     # -- loop ----------------------------------------------------------------
 
@@ -524,14 +982,19 @@ class Server:
         tel = _telemetry.current()
         ex = self.ex
         B, k = ex.max_batch, self.decode_steps
-        decode_fn = ex.build_decode_superstep(k)
+        decode_fn = ex.build_decode_superstep(k, sample=self.sample)
         caches = ex.init_cache()
-        slots: List[Optional[_Slot]] = [None] * B
-        queue = collections.deque(
-            sorted(requests, key=lambda r: (r.arrival,))
+        ledger = ex.make_ledger() if ex.paged else None
+        block_table = (
+            np.zeros((B, ledger.blocks_per_slot), np.int32)
+            if ledger is not None else None
         )
+        slots: List[Optional[_Slot]] = [None] * B
+        # Closed-loop runs have no arrival clock (the deprecated
+        # superstep-index ``Request.arrival`` is retired): every
+        # request is eligible at run start, in the given order.
+        queue = collections.deque(requests)
         results: Dict[int, RequestResult] = {}
-        eligible_at: Dict[int, float] = {}
         superstep_idx = 0
         total_tokens = 0
         supersteps = 0
@@ -553,6 +1016,9 @@ class Server:
             tel.emit("request_end", id=sl.request.id,
                      tokens=len(sl.tokens), error=error,
                      latency_s=round(lat, 6))
+            if ledger is not None:
+                ledger.free(slot_i)
+                block_table[slot_i] = 0
             slots[slot_i] = None
 
         def slot_done(sl: _Slot) -> bool:
@@ -562,36 +1028,49 @@ class Server:
             if len(sl.tokens) >= sl.request.max_new_tokens:
                 return True
             return sl.pos >= ex.max_seq  # context limit
+        def reject(r: Request, err: str):
+            # Rejected requests still leave a complete start/end pair
+            # in the log (the reconstructable-from-JSONL contract)
+            # and an honest latency.
+            plen = len(r.prompt)
+            tel.emit("request_start", id=r.id, prompt_len=plen,
+                     bucket=None, slot=None)
+            lat = time.perf_counter() - t_run0
+            results[r.id] = RequestResult(
+                id=r.id, prompt_len=plen, tokens=[],
+                error=err, latency_s=lat,
+            )
+            tel.emit("request_end", id=r.id, tokens=0,
+                     error=err, latency_s=round(lat, 6))
+
         while queue or any(slots):
             # -- admissions (between decode supersteps) --
-            now = time.perf_counter()
-            # Eligibility is when the arrival clock passes, NOT when a
-            # slot frees up — queue wait under full slots is real
-            # request latency.
-            for r in queue:
-                if r.arrival <= superstep_idx and r.id not in eligible_at:
-                    eligible_at[r.id] = now
-            while queue and queue[0].arrival <= superstep_idx and \
-                    None in slots:
-                r = queue.popleft()
-                slot_i = slots.index(None)
+            while queue and None in slots:
+                r = queue[0]
                 plen = len(r.prompt)
                 try:
                     bucket = ex.bucket_for(plen)
                 except ValueError as e:
-                    # Rejected requests still leave a complete
-                    # start/end pair in the log (the reconstructable-
-                    # from-JSONL contract) and an honest latency.
-                    tel.emit("request_start", id=r.id, prompt_len=plen,
-                             bucket=None, slot=None)
-                    lat = time.perf_counter() - eligible_at[r.id]
-                    results[r.id] = RequestResult(
-                        id=r.id, prompt_len=plen, tokens=[],
-                        error=str(e), latency_s=lat,
-                    )
-                    tel.emit("request_end", id=r.id, tokens=0,
-                             error=str(e), latency_s=round(lat, 6))
+                    queue.popleft()
+                    reject(r, str(e))
                     continue
+                if ledger is not None:
+                    need = ledger.blocks_for(plen, r.max_new_tokens)
+                    if need > ledger.capacity_blocks:
+                        queue.popleft()
+                        reject(r, (
+                            f"request needs {need} KV blocks but the "
+                            f"paged pool holds {ledger.capacity_blocks}"
+                        ))
+                        continue
+                    if not ledger.can_admit(need):
+                        # Head-of-line wait: blocks free up when an
+                        # active slot finishes (deterministic FIFO —
+                        # no reorder, no livelock: the whole pool
+                        # covers any single admissible request).
+                        break
+                queue.popleft()
+                slot_i = slots.index(None)
                 tel.emit("request_start", id=r.id, prompt_len=plen,
                          bucket=bucket, slot=slot_i)
                 padded = np.zeros((1, bucket), np.int32)
@@ -611,14 +1090,19 @@ class Server:
                 tel.emit("prefill", id=r.id, bucket=bucket,
                          wall_s=round(pf_s, 6))
                 if not bool(ok):
-                    sl = _Slot(r, plen, 0, [], eligible_at[r.id], pf_s)
+                    sl = _Slot(r, plen, 0, [], t_run0, pf_s)
                     slots[slot_i] = sl
                     finish(slot_i, error="non-finite logits in prefill")
                     continue
-                caches = ex.install(caches, rows, slot_i)
+                if ledger is not None:
+                    row = ledger.alloc(slot_i, need)
+                    block_table[slot_i] = row
+                    caches = ex.install_paged(caches, rows, row)
+                else:
+                    caches = ex.install(caches, rows, slot_i)
                 sl = _Slot(
                     request=r, pos=plen, last_tok=int(tok0),
-                    tokens=[int(tok0)], t_eligible=eligible_at[r.id],
+                    tokens=[int(tok0)], t_eligible=t_run0,
                     prefill_s=pf_s,
                 )
                 total_tokens += 1
@@ -628,18 +1112,13 @@ class Server:
 
             active = [i for i, sl in enumerate(slots) if sl is not None]
             if not active:
-                if queue:
-                    # Closed-loop idle tick: no active slot, but future
-                    # arrivals remain — advance the superstep clock.
-                    superstep_idx += 1
-                    continue
                 break
 
             # -- one fused decode superstep over the whole batch --
             if self.injector is not None:
                 try:
                     caches = self.injector.before_superstep(
-                        superstep_idx, caches
+                        superstep_idx, caches, block_table
                     )
                 except ServingFault as f:
                     superstep_idx += 1
@@ -652,14 +1131,17 @@ class Server:
             tok_vec = np.array(
                 [sl.last_tok if sl else 0 for sl in slots], np.int32
             )
+            args = (self.params, self.op_state, caches)
+            if block_table is not None:
+                args += (block_table.copy(),)
+            args += (pos_vec, tok_vec)
+            if self.sample is not None:
+                args += (np.array(
+                    [sl.request.id if sl else 0 for sl in slots], np.int32
+                ),)
             t_call = time.perf_counter()
-            tel.program_cost(
-                "decode_superstep", decode_fn,
-                (self.params, self.op_state, caches, pos_vec, tok_vec),
-                k=k)
-            caches, _pos, _tok, (toks, oks) = decode_fn(
-                self.params, self.op_state, caches, pos_vec, tok_vec
-            )
+            tel.program_cost("decode_superstep", decode_fn, args, k=k)
+            caches, _pos, _tok, (toks, oks) = decode_fn(*args)
             host_toks, host_oks = tel.fence((toks, oks), "decode_superstep")
             wall = time.perf_counter() - t_call
             decode_s += wall
@@ -716,7 +1198,13 @@ class Server:
             # One host program per decode superstep, by construction
             # (audited by the telemetry programs/step counter).
             "programs_per_decode_superstep": 1,
+            "kv_layout": "paged" if ex.paged else "padded",
+            "shard": list(ex.shard) if ex.shard is not None else None,
+            "sampled": self.sample is not None,
         }
+        if ex.paged:
+            stats["kv_block"] = ex.kv_block
+            stats["kv_blocks"] = ex.kv_blocks
         return results, tel.fold_stats(stats)
 
 
@@ -730,23 +1218,20 @@ def synthetic_requests(
 ) -> List[Request]:
     """Deterministic synthetic request stream for closed-loop
     benchmarking: prompt lengths uniform in ``prompt_len`` (inclusive),
-    ids uniform over the vocab, one request becoming eligible every
-    ``arrival_every`` decode supersteps (0 = all at start — the burst
-    pattern).
+    ids uniform over the vocab, all requests eligible at run start
+    (the burst pattern).
 
-    ``arrival_every > 0`` is DEPRECATED: the superstep-index arrival
-    knob is replaced by the open-loop workload generator
-    (``serving/workload.py``; ``uniform_workload`` is the direct
-    alias) — kept for one release."""
+    ``arrival_every`` is RETIRED (PR 12's one-release deprecation
+    grace is up): any non-zero value raises ``ValueError`` pointing at
+    the open-loop workload generator (``serving/workload.py``;
+    ``uniform_workload`` is the direct replacement)."""
     if arrival_every:
-        import warnings
-
-        warnings.warn(
-            "synthetic_requests(arrival_every=...) and Request.arrival "
-            "are deprecated: use flexflow_tpu.serving.workload "
-            "(uniform_workload / make_workload) arrival_ms-driven "
-            "arrivals instead",
-            DeprecationWarning, stacklevel=2,
+        raise ValueError(
+            "synthetic_requests(arrival_every=...) is retired (and "
+            "Request.arrival is gone): superstep-index arrivals were "
+            "replaced by arrival_ms-driven open-loop workloads — use "
+            "flexflow_tpu.serving.workload.uniform_workload / "
+            "make_workload instead"
         )
     rng = np.random.default_rng(seed)
     lo, hi = prompt_len
@@ -757,6 +1242,5 @@ def synthetic_requests(
             id=i,
             prompt=rng.integers(0, vocab, size=plen).astype(np.int32),
             max_new_tokens=max_new_tokens,
-            arrival=i * arrival_every,
         ))
     return out

@@ -22,6 +22,7 @@ from flexflow_tpu.serving.search import (
 from flexflow_tpu.serving.workload import (
     WorkloadSpec,
     make_workload,
+    production_workload,
     uniform_workload,
 )
 
@@ -35,5 +36,6 @@ __all__ = [
     "search_serving_config",
     "WorkloadSpec",
     "make_workload",
+    "production_workload",
     "uniform_workload",
 ]

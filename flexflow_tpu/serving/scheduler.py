@@ -109,11 +109,18 @@ class SchedulerPolicy:
 class SlotShape:
     """The executor surface the simulate mode needs — mirrors the
     real :class:`ServingExecutor` validation so a config that
-    simulates is a config the executor accepts."""
+    simulates is a config the executor accepts.  ``kv_block > 0``
+    switches the simulated capacity model to the paged KV pool
+    (SERVING.md "Cache layout"): admission is then gated by the same
+    :class:`~flexflow_tpu.runtime.serving.KVBlockLedger` arithmetic
+    the real engine runs, so a config that admits in simulation
+    admits for real."""
 
     max_batch: int
     max_seq: int
     buckets: Tuple[int, ...]
+    kv_block: int = 0
+    kv_blocks: Optional[int] = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -124,6 +131,37 @@ class SlotShape:
                 f"buckets must be in [1, max_seq]: {list(self.buckets)}"
             )
         object.__setattr__(self, "buckets", bks)
+        # Mirrors ServingExecutor's paged validation exactly.
+        if self.kv_blocks is not None and self.kv_block <= 0:
+            raise ValueError("kv_blocks requires kv_block > 0")
+        if self.kv_block > 0:
+            if self.max_seq % self.kv_block != 0:
+                raise ValueError(
+                    f"kv_block {self.kv_block} must divide "
+                    f"max_seq {self.max_seq}"
+                )
+            bps = self.max_seq // self.kv_block
+            n_blocks = (self.kv_blocks if self.kv_blocks is not None
+                        else self.max_batch * bps + 1)
+            if n_blocks < 2:
+                raise ValueError(
+                    f"kv_blocks must be >= 2 (scratch + pool), "
+                    f"got {n_blocks}"
+                )
+            object.__setattr__(self, "kv_blocks", n_blocks)
+
+    @property
+    def paged(self) -> bool:
+        return self.kv_block > 0
+
+    def make_ledger(self):
+        """The block allocator for the simulated capacity model —
+        the SAME class the real engine gates admission with."""
+        from flexflow_tpu.runtime.serving import KVBlockLedger
+
+        if not self.paged:
+            raise ValueError("make_ledger() needs kv_block > 0")
+        return KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq)
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
@@ -142,15 +180,20 @@ class _RealEngine:
 
     simulated = False
 
-    def __init__(self, ex: ServingExecutor, params, op_state):
+    def __init__(self, ex: ServingExecutor, params, op_state,
+                 sample=None):
         self.ex = ex
         self.params = params
         self.op_state = op_state
+        self.sample = sample
         self.caches = ex.init_cache()
 
-    def prefill(self, prompt: np.ndarray, bucket: int, slot_i: int):
-        """Pad-to-bucket prefill + cache-row install into ``slot_i``:
-        returns ``(first_token, finite, wall_s)`` after one fence."""
+    def prefill(self, prompt: np.ndarray, bucket: int, slot_i: int,
+                row: Optional[np.ndarray] = None):
+        """Pad-to-bucket prefill + cache install into ``slot_i``
+        (padded rows, or the ledger-assigned block ``row`` on the
+        paged layout): ``(first_token, finite, wall_s)`` after one
+        fence."""
         tel = _telemetry.current()
         ex = self.ex
         plen = len(prompt)
@@ -167,22 +210,28 @@ class _RealEngine:
         tok0, ok = tel.fence((tok0, okf), "prefill")
         wall = time.perf_counter() - t0
         if bool(ok):
-            self.caches = ex.install(self.caches, rows, slot_i)
+            if row is not None:
+                self.caches = ex.install_paged(self.caches, rows, row)
+            else:
+                self.caches = ex.install(self.caches, rows, slot_i)
         return int(tok0), bool(ok), wall
 
-    def decode(self, pos_vec: np.ndarray, tok_vec: np.ndarray, k: int):
+    def decode(self, pos_vec: np.ndarray, tok_vec: np.ndarray, k: int,
+               block_table: Optional[np.ndarray] = None,
+               req_ids: Optional[np.ndarray] = None):
         """One fused k-token superstep over the whole slot batch:
         ``(tokens (k, B), finite (k, B), wall_s)`` after one fence."""
         tel = _telemetry.current()
-        fn = self.ex.build_decode_superstep(k)
+        fn = self.ex.build_decode_superstep(k, sample=self.sample)
+        args = (self.params, self.op_state, self.caches)
+        if block_table is not None:
+            args += (block_table,)
+        args += (pos_vec, tok_vec)
+        if self.sample is not None:
+            args += (np.asarray(req_ids, np.int32),)
         t0 = time.perf_counter()
-        tel.program_cost(
-            "decode_superstep", fn,
-            (self.params, self.op_state, self.caches, pos_vec, tok_vec),
-            k=k)
-        self.caches, _pos, _tok, (toks, oks) = fn(
-            self.params, self.op_state, self.caches, pos_vec, tok_vec
-        )
+        tel.program_cost("decode_superstep", fn, args, k=k)
+        self.caches, _pos, _tok, (toks, oks) = fn(*args)
         host_toks, host_oks = tel.fence((toks, oks), "decode_superstep")
         return host_toks, host_oks, time.perf_counter() - t0
 
@@ -190,17 +239,19 @@ class _RealEngine:
 class _SimEngine:
     """Compute-free engine: fabricated (finite) tokens, zero wall.
     Token values are synthetic; decision-relevant quantities (counts,
-    positions, budgets) are exact — see the module docstring."""
+    positions, budgets, KV-block reservations) are exact — see the
+    module docstring."""
 
     simulated = True
 
     def __init__(self, shape: SlotShape):
         self.shape = shape
 
-    def prefill(self, prompt, bucket, slot_i):
+    def prefill(self, prompt, bucket, slot_i, row=None):
         return 1, True, 0.0
 
-    def decode(self, pos_vec, tok_vec, k):
+    def decode(self, pos_vec, tok_vec, k, block_table=None,
+               req_ids=None):
         B = len(pos_vec)
         toks = np.ones((k, B), np.int32)
         oks = np.ones((k, B), bool)
@@ -243,6 +294,9 @@ class ScheduledServer:
         eos_id: Optional[int] = None,
         policy: Optional[SchedulerPolicy] = None,
         latency_model: Optional[ServingLatencyModel] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        sample_seed: int = 0,
         _engine=None,
     ):
         from flexflow_tpu.runtime.trainer import relay_safe_steps
@@ -254,7 +308,13 @@ class ScheduledServer:
             decode_steps, what="decode_steps", log=_log
         )
         self.eos_id = eos_id
-        self.engine = _engine or _RealEngine(executor, params, op_state)
+        # In-program sampling (replayable: draws are keyed by
+        # (seed, request id, position), so preemption/resume and any
+        # batch composition replay the same sequence).
+        self.sample = (temperature, top_k, sample_seed) \
+            if temperature > 0.0 else None
+        self.engine = _engine or _RealEngine(executor, params, op_state,
+                                             sample=self.sample)
         #: The replayable decision trace: one dict per admit / evict /
         #: shed / reject / decode / advance decision, vclock-stamped.
         self.decisions: List[Dict[str, Any]] = []
@@ -316,6 +376,15 @@ class ScheduledServer:
         tel = _telemetry.current()
         ex, pol, model = self.ex, self.policy, self.model
         B = ex.max_batch
+        # Paged KV capacity: admission is gated by the SAME ledger
+        # arithmetic on the real and the simulated engine (pure host
+        # integers), so simulated dispatch counts stay exact.
+        ledger = self.ex.make_ledger() \
+            if getattr(self.ex, "paged", False) else None
+        block_table = (
+            np.zeros((B, ledger.blocks_per_slot), np.int32)
+            if ledger is not None else None
+        )
         vclock = 0.0
         pending = sorted(requests, key=lambda r: (r.arrival_ms, r.id))
         waiting: List[Request] = []
@@ -364,6 +433,9 @@ class ScheduledServer:
             finish_result(sl.request, sl.all_tokens, err, sl.admit_v,
                           sl.t_wall0, sl.prefill_s)
             slots[slot_i] = None
+            if ledger is not None:
+                ledger.free(slot_i)
+                block_table[slot_i] = 0
 
         def slot_done(sl: _SchedSlot) -> bool:
             toks = sl.all_tokens
@@ -388,6 +460,19 @@ class ScheduledServer:
                     log("reject", id=r.id, reason="no_bucket")
                     finish_result(r, [], str(e), None, t_wall0)
                     continue
+                if ledger is not None:
+                    need = ledger.blocks_for(len(r.prompt),
+                                             r.max_new_tokens)
+                    if need > ledger.capacity_blocks:
+                        tel.emit("request_start", id=r.id,
+                                 prompt_len=len(r.prompt), bucket=None,
+                                 slot=None)
+                        log("reject", id=r.id, reason="kv_pool")
+                        finish_result(r, [], (
+                            f"request needs {need} KV blocks but the "
+                            f"paged pool holds {ledger.capacity_blocks}"
+                        ), None, t_wall0)
+                        continue
                 waiting.append(r)
 
         def projected_free_ms() -> float:
@@ -441,6 +526,9 @@ class ScheduledServer:
             # Re-queue at its original key; the freed slot admits cand.
             waiting.append(sl.request)
             slots[slot_i] = None
+            if ledger is not None:
+                ledger.free(slot_i)
+                block_table[slot_i] = 0
             return slot_i
 
         def admit(r: Request, slot_i: int):
@@ -462,7 +550,13 @@ class ScheduledServer:
                     (w.priority for w in others), default=None),
             )
             vclock += model.prefill_ms(bucket)
-            tok0, ok, pf_s = self.engine.prefill(full, bucket, slot_i)
+            row = None
+            if ledger is not None:
+                row = ledger.alloc(slot_i, ledger.blocks_for(
+                    len(r.prompt), r.max_new_tokens))
+                block_table[slot_i] = row
+            tok0, ok, pf_s = self.engine.prefill(full, bucket, slot_i,
+                                                 row=row)
             prefills += 1
             tel.emit("prefill", id=r.id, bucket=bucket,
                      wall_s=round(pf_s, 6))
@@ -500,6 +594,17 @@ class ScheduledServer:
                     slot_i = try_preempt(cand)
                 if slot_i is None:
                     break
+                if ledger is not None and not ledger.can_admit(
+                        ledger.blocks_for(len(cand.prompt),
+                                          cand.max_new_tokens)):
+                    # Free slot but not enough free KV blocks:
+                    # head-of-line wait for block turnover (an active
+                    # slot finishing frees its reservation; the pool
+                    # covers any single admissible request, so no
+                    # livelock).
+                    log("kv_wait", id=cand.id,
+                        free_blocks=ledger.free_blocks)
+                    break
                 admit(cand, slot_i)
 
             # -- shed the overload past the queue-depth bound --
@@ -535,8 +640,16 @@ class ScheduledServer:
             tok_vec = np.array(
                 [sl.last_tok if sl else 0 for sl in slots], np.int32
             )
+            req_vec = np.array(
+                [sl.request.id if sl else 0 for sl in slots], np.int32
+            )
             vclock += model.decode_ms(k)
-            toks, oks, wall = self.engine.decode(pos_vec, tok_vec, k)
+            toks, oks, wall = self.engine.decode(
+                pos_vec, tok_vec, k,
+                block_table=(block_table.copy()
+                             if ledger is not None else None),
+                req_ids=req_vec,
+            )
             decode_s += wall
             supersteps += 1
             # Training-superstep accounting: one host program + one
@@ -628,7 +741,18 @@ class ScheduledServer:
             "request_sheds": sheds,
             "request_preempts": preempts,
             "programs_per_decode_superstep": 1,
+            # Cache-layout columns (SERVING.md "Cache layout"): the
+            # executor OR the simulated SlotShape carries them, so
+            # predicted and measured stats line up column-for-column.
+            "kv_layout": ("paged" if getattr(self.ex, "paged", False)
+                          else "padded"),
+            "shard": (list(self.ex.shard)
+                      if getattr(self.ex, "shard", None) else None),
+            "sampled": self.sample is not None,
         }
+        if getattr(self.ex, "paged", False):
+            stats["kv_block"] = self.ex.kv_block
+            stats["kv_blocks"] = self.ex.kv_blocks
         if slo_oks:
             stats["slo_attainment"] = round(
                 sum(slo_oks.values()) / len(slo_oks), 4

@@ -50,14 +50,24 @@ class ServingConfig:
     max_batch: int
     max_seq: int
     policy: SchedulerPolicy
+    #: Cache layout (SERVING.md "Cache layout"): 0 = padded rows;
+    #: > 0 = paged KV pool with this block size.  The simulated
+    #: scheduler gates admission with the real ledger arithmetic, so
+    #: a paged candidate's queueing behavior is priced exactly.
+    kv_block: int = 0
+    kv_blocks: Optional[int] = None
+    #: Mesh shard (n, c) — carried through to the executor, not
+    #: searched (the device count is a deployment fact, not a knob).
+    shard: Optional[Tuple[int, int]] = None
 
     def __post_init__(self):
         from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
 
-        # Validates buckets against max_seq exactly as the executor
-        # does (raises ValueError on an illegal set).
+        # Validates buckets AND the paged-pool shape exactly as the
+        # executor does (raises ValueError on an illegal set).
         shape = self.shape()
         object.__setattr__(self, "buckets", shape.buckets)
+        object.__setattr__(self, "kv_blocks", shape.kv_blocks)
         if not (1 <= self.decode_steps <= MAX_DECODE_STEPS_PER_CALL):
             raise ValueError(
                 f"decode_steps must be in [1, "
@@ -66,12 +76,17 @@ class ServingConfig:
 
     def shape(self) -> SlotShape:
         return SlotShape(max_batch=self.max_batch, max_seq=self.max_seq,
-                         buckets=self.buckets)
+                         buckets=self.buckets, kv_block=self.kv_block,
+                         kv_blocks=self.kv_blocks)
 
     def describe(self) -> str:
-        return (f"buckets={list(self.buckets)} k={self.decode_steps} "
-                f"max_batch={self.max_batch} "
-                f"policy={self.policy.describe()}")
+        bits = (f"buckets={list(self.buckets)} k={self.decode_steps} "
+                f"max_batch={self.max_batch}")
+        if self.kv_block > 0:
+            bits += f" kv={self.kv_blocks}x{self.kv_block}"
+        if self.shard is not None:
+            bits += f" shard={self.shard[0]}x{self.shard[1]}"
+        return bits + f" policy={self.policy.describe()}"
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -83,6 +98,9 @@ class ServingConfig:
             "adaptive_k": self.policy.adaptive_k,
             "preempt": self.policy.preempt,
             "shed_depth": self.policy.shed_depth,
+            "kv_block": self.kv_block,
+            "kv_blocks": self.kv_blocks,
+            "shard": list(self.shard) if self.shard else None,
         }
 
 
@@ -137,6 +155,24 @@ def candidate_bucket_sets(
     return sorted(out)
 
 
+def candidate_kv_layouts(
+    baseline: "ServingConfig",
+) -> List[Tuple[int, Optional[int]]]:
+    """Paged block-size variants at the baseline's pool-TOKEN capacity
+    (halved/doubled block, pool re-sized so HBM stays fixed) — the
+    block-granularity vs fragmentation trade the ledger gating prices.
+    A padded baseline stays padded: the layout switch is an HBM-budget
+    decision the operator makes, not a latency one the search may."""
+    if baseline.kv_block <= 0:
+        return [(0, None)]
+    pool_tokens = (baseline.kv_blocks - 1) * baseline.kv_block
+    out = {(baseline.kv_block, baseline.kv_blocks)}
+    for blk in (baseline.kv_block // 2, baseline.kv_block * 2):
+        if blk >= 1 and baseline.max_seq % blk == 0:
+            out.add((blk, max(pool_tokens // blk, 1) + 1))
+    return sorted(out)
+
+
 def _score(config: ServingConfig, requests: Sequence[Request],
            model: ServingLatencyModel) -> ScoredConfig:
     srv = ScheduledServer.simulated(
@@ -180,24 +216,29 @@ def search_serving_config(
         requests, baseline.max_seq, baseline.buckets
     )
     base_pol = baseline.policy
+    kv_layouts = candidate_kv_layouts(baseline)
     configs: List[ServingConfig] = []
     seen = set()
     for bks in bucket_sets:
         for k in ks:
             for b in batches:
-                for adaptive in (
-                    (True, False) if base_pol.name == "slo" else (False,)
-                ):
-                    pol = dataclasses.replace(base_pol,
-                                              adaptive_k=adaptive)
-                    key = (bks, k, b, adaptive)
-                    if key in seen:
-                        continue
-                    seen.add(key)
-                    configs.append(ServingConfig(
-                        buckets=bks, decode_steps=k, max_batch=b,
-                        max_seq=baseline.max_seq, policy=pol,
-                    ))
+                for kvb, kvn in kv_layouts:
+                    for adaptive in (
+                        (True, False) if base_pol.name == "slo"
+                        else (False,)
+                    ):
+                        pol = dataclasses.replace(base_pol,
+                                                  adaptive_k=adaptive)
+                        key = (bks, k, b, kvb, kvn, adaptive)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        configs.append(ServingConfig(
+                            buckets=bks, decode_steps=k, max_batch=b,
+                            max_seq=baseline.max_seq, policy=pol,
+                            kv_block=kvb, kv_blocks=kvn,
+                            shard=baseline.shard,
+                        ))
     if not any(c.to_json() == baseline.to_json() for c in configs):
         configs.append(baseline)
 
@@ -216,6 +257,7 @@ def search_serving_config(
             s.config.max_batch,
             len(s.config.buckets),
             s.config.buckets,
+            s.config.kv_block,
             not s.config.policy.adaptive_k,
         )
 

@@ -113,6 +113,52 @@ def make_workload(spec: WorkloadSpec) -> List[Request]:
     return out
 
 
+def production_workload(spec: WorkloadSpec,
+                        id_alpha: float = 1.2) -> List[Request]:
+    """The LIVE production-trace workload (``--workload-trace
+    prod:<args>``): prompt TOKEN CONTENT comes from real
+    ``data/trace.py`` :class:`ProductionTraceSource` reads — the
+    shared source, not a mirrored idiom — so serving sees the same
+    power-law token skew the data plane stresses (a few hot ids
+    dominate every prompt).  Lengths, budgets, tiers and burst-paced
+    arrivals keep the :func:`make_workload` draws (same per-request
+    rng block), so the two generators differ ONLY in token content
+    and a trace replays bit-identically.
+
+    ``id_alpha`` is the trace source's embedding-id zipf skew
+    (``ProductionTraceSource(alpha=...)``), distinct from the
+    length-shaping ``spec.prompt_alpha``.
+    """
+    from flexflow_tpu.data.trace import ProductionTraceSource
+
+    hi = spec.prompt_len[1]
+    src = ProductionTraceSource(
+        num_samples=spec.n_requests * hi, dense_dim=1,
+        vocab_sizes=[spec.vocab], alpha=id_alpha, seed=spec.seed,
+        block=max(hi, 64),
+    )
+    out: List[Request] = []
+    t_ms = 0.0
+    for i in range(spec.n_requests):
+        rng = np.random.default_rng([spec.seed, i])
+        plen = _bounded_zipf(rng, spec.prompt_alpha, *spec.prompt_len)
+        # Request i owns trace rows [i*hi, i*hi + plen): one id column
+        # read through the source's own chunked reader.
+        prompt = src.read(i * hi, i * hi + plen)["sparse_input"][:, 0]
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        rng.integers(0, spec.vocab, size=plen)  # keep draw alignment
+        max_new = _bounded_zipf(rng, spec.output_alpha, *spec.max_new)
+        tier = int(rng.integers(0, spec.priorities))
+        if i % spec.burst == 0 and i > 0:
+            t_ms += float(rng.exponential(spec.mean_gap_ms * spec.burst))
+        out.append(Request(
+            id=i, prompt=prompt, max_new_tokens=max_new,
+            arrival_ms=round(t_ms, 3), priority=tier,
+            slo_ms=spec.slo_ms * (tier + 1),
+        ))
+    return out
+
+
 def uniform_workload(
     n: int,
     vocab: int,

@@ -95,6 +95,14 @@ def stubbed_bench(monkeypatch):
             "fifo_queue_wait_ms_p99": 45.0,
             "fifo_slo_attainment": 0.8,
             "fifo_vs_slo_queue_wait_p99": 1.5,
+            "hbm_per_slot_bytes": 32768,
+            "paged_hbm_per_slot_bytes": 8192,
+            "padded_max_admitted_batch": 4,
+            "paged_max_admitted_batch": 14,
+            "paged_tokens_per_s": 390.0,
+            "sharded_mesh": [2, 1],
+            "sharded_tokens_per_s": 600.0,
+            "sharded_vs_single_mesh_tokens_per_s": 1.5,
         }),
     )
     monkeypatch.setattr(
@@ -183,6 +191,18 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert serving["request_preempts"] == 1
     assert serving["fifo_queue_wait_ms_p99"] == 45.0
     assert serving["fifo_vs_slo_queue_wait_p99"] == 1.5
+    # The capacity columns (ISSUE 13, SERVING.md "Cache layout"):
+    # per-slot HBM under both layouts, the paged-vs-padded max batch a
+    # fixed cache budget admits, and paged / sharded tokens/s against
+    # the single-mesh padded run (sharded_mesh None = loud fallback).
+    assert serving["hbm_per_slot_bytes"] == 32768
+    assert serving["paged_hbm_per_slot_bytes"] == 8192
+    assert serving["padded_max_admitted_batch"] == 4
+    assert serving["paged_max_admitted_batch"] == 14
+    assert serving["paged_tokens_per_s"] == 390.0
+    assert serving["sharded_mesh"] == [2, 1]
+    assert serving["sharded_tokens_per_s"] == 600.0
+    assert serving["sharded_vs_single_mesh_tokens_per_s"] == 1.5
     # The execution-autotuner leg (ISSUE 6): auto-chosen config with
     # its predicted-vs-measured ms/step + the search wall time.
     search = record["extra"]["search"]

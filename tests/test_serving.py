@@ -13,7 +13,12 @@ Pins the subsystem's correctness contracts:
   chaos scenario also leans on).
 - **Eviction/admission slot invariants**: every queued request is
   served exactly once, generation lengths respect budget and context
-  limits, arrivals gate admission.
+  limits; on the paged layout, block-table reuse after eviction and
+  ledger-gated admission preserve all of the above.
+- **Paged / sharded parity matrix**: the paged block-pool layout and
+  the sharded multi-chip decode both reproduce the single-mesh padded
+  engine's logits (vs the full-seq forward oracle) and its greedy
+  sequences under any batch composition.
 - **Train->serve handoff**: params restored from a training checkpoint
   through the strategy-portable CheckpointManager drive serving.
 
@@ -152,9 +157,9 @@ def _serve(executor, weights, requests, **kw):
     return results, stats
 
 
-def _req(rid, prompt, max_new=5, arrival=0):
+def _req(rid, prompt, max_new=5):
     return Request(id=rid, prompt=np.asarray(prompt, np.int32),
-                   max_new_tokens=max_new, arrival=arrival)
+                   max_new_tokens=max_new)
 
 
 def test_prefill_bucket_invariance(sex, weights):
@@ -189,18 +194,15 @@ def test_slot_neighbor_independence(sex, weights):
 
 
 def test_eviction_admission_invariants(sex, weights):
-    """More requests than slots + staggered arrivals: every request is
-    served exactly once, budgets and the context limit are honored,
-    and one host program covers each K-token decode superstep."""
+    """More requests than slots: every request is served exactly
+    once, budgets and the context limit are honored, and one host
+    program covers each K-token decode superstep."""
     reqs = [
         _req(0, [1, 2, 3], max_new=4),
         _req(1, [4, 5], max_new=9),
         _req(2, [6, 7, 8, 9], max_new=2),
         _req(3, [10] * 6, max_new=30),      # context-limited
-        # The one retained coverage of the DEPRECATED closed-loop
-        # ``Request.arrival`` alias (superstep-index gating; new code
-        # uses serving.workload's virtual-clock ``arrival_ms``).
-        _req(4, [11, 12], max_new=3, arrival=2),
+        _req(4, [11, 12], max_new=3),
     ]
     results, stats = _serve(sex, weights, reqs, decode_steps=4)
     assert sorted(results) == [0, 1, 2, 3, 4]
@@ -329,3 +331,279 @@ def test_serve_telemetry_stream(lm, weights, tmp_path):
     assert tele["programs_per_step"] == pytest.approx(0.25)
     assert stats["request_latency_ms_p95"] >= stats[
         "request_latency_ms_p50"]
+
+
+# -- retired closed-loop arrival knob (loud-error contract) --------------
+
+
+def test_closed_loop_arrival_retired():
+    """PR 12's one-release grace is up: ``Request.arrival`` is gone
+    (TypeError) and ``synthetic_requests(arrival_every=...)`` raises
+    with the workload-generator migration pointer."""
+    from flexflow_tpu.runtime.serving import synthetic_requests
+
+    with pytest.raises(TypeError):
+        Request(id=0, prompt=np.array([1], np.int32), arrival=2)
+    with pytest.raises(ValueError, match="retired"):
+        synthetic_requests(3, 16, arrival_every=2)
+
+
+# -- paged KV caches (SERVING.md "Cache layout") -------------------------
+
+
+@pytest.fixture(scope="module")
+def paged_sex(lm):
+    """Paged-layout oracle executor: 4-token KV blocks, worst-case
+    pool (parity config — the capacity win needs a budget)."""
+    return ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                           decode_kernel=False, kv_block=4)
+
+
+def test_kv_block_ledger_reuse_lowest_first():
+    """Ledger unit contract: block 0 reserved as scratch, reservation
+    arithmetic caps at max_seq, freed blocks are reused lowest-first
+    (deterministic across replays)."""
+    from flexflow_tpu.runtime.serving import KVBlockLedger
+
+    led = KVBlockLedger(9, 4, S)
+    assert led.capacity_blocks == 8 and led.blocks_per_slot == 4
+    assert led.blocks_for(3, 6) == 3          # 3+6+1 tokens -> 3 blocks
+    assert led.blocks_for(10, 100) == 4       # capped at max_seq
+    r0, r1 = led.alloc(0, 3), led.alloc(1, 3)
+    assert list(r0) == [1, 2, 3, 0] and list(r1) == [4, 5, 6, 0]
+    assert led.free_blocks == 2 and not led.can_admit(3)
+    led.free(0)
+    assert list(led.alloc(0, 2)) == [1, 2, 0, 0]  # lowest-first reuse
+    with pytest.raises(RuntimeError, match="already holds"):
+        led.alloc(0, 1)
+
+
+def test_paged_decode_matches_full_forward(paged_sex, weights,
+                                           full_forward):
+    """The paged acceptance bar: block-pool decode logits match the
+    full-sequence forward oracle at every decoded position."""
+    params, state = weights
+    toks, full_logits = full_forward
+    prefix = 6
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :prefix] = toks[0, :prefix]
+    rows, tok0, ok = paged_sex.build_prefill(8)(
+        params, state, padded, np.int32(prefix)
+    )
+    assert bool(ok)
+    assert int(tok0) == int(np.argmax(full_logits[0, prefix - 1]))
+    led = paged_sex.make_ledger()
+    row = led.alloc(0, led.blocks_for(prefix, S))
+    bt = np.zeros((2, led.blocks_per_slot), np.int32)
+    bt[0] = row
+    caches = paged_sex.install_paged(paged_sex.init_cache(), rows, row)
+    dec = paged_sex.build_decode_superstep(1, return_logits=True)
+    pos = np.array([prefix, 0], np.int32)
+    errs = []
+    for t in range(prefix, S):
+        tokv = np.array([toks[0, t], 0], np.int32)
+        caches, pos_d, _t, (_nxt, okf, logits) = dec(
+            params, state, caches, bt, pos, tokv
+        )
+        assert bool(np.asarray(okf)[0, 0])
+        errs.append(float(np.max(np.abs(
+            np.asarray(logits)[0, 0] - full_logits[0, t]
+        ))))
+        pos = np.asarray(pos_d)
+    assert max(errs) <= DECODE_TOL, f"paged decode drift {max(errs)}"
+
+
+def test_paged_vs_padded_greedy_parity(sex, paged_sex, weights):
+    """Greedy sequences are identical between the padded and the
+    paged engine, under any batch composition."""
+    def reqs():
+        return [
+            _req(0, [5, 9, 2], max_new=6),
+            _req(1, [3, 1, 4, 1, 5], max_new=4),
+            _req(2, [31, 3, 3, 7], max_new=7),
+        ]
+
+    base, _ = _serve(sex, weights, reqs(), decode_steps=4)
+    pg, pstats = _serve(paged_sex, weights, reqs(), decode_steps=4)
+    assert pstats["kv_layout"] == "paged"
+    assert pstats["kv_block"] == 4
+    for rid in (0, 1, 2):
+        assert pg[rid].error is None
+        assert pg[rid].tokens == base[rid].tokens
+    alone, _ = _serve(paged_sex, weights,
+                      [_req(1, [3, 1, 4, 1, 5], max_new=4)],
+                      decode_steps=4)
+    assert alone[1].tokens == pg[1].tokens
+
+
+def test_paged_eviction_block_table_reuse(lm, paged_sex, weights):
+    """A pool too small for two concurrent requests forces ledger-
+    gated admission: the waiter admits only after an eviction frees
+    blocks, REUSES them (lowest-first), and still generates exactly
+    the unconstrained paged engine's tokens."""
+    tight_ex = ServingExecutor(lm, max_batch=2, max_seq=S,
+                               buckets=(8, S), decode_kernel=False,
+                               kv_block=4, kv_blocks=5)
+    def reqs():
+        return [
+            _req(0, [1, 2, 3], max_new=6),
+            _req(1, [4, 5, 6], max_new=6),
+            _req(2, [7, 8, 9], max_new=6),
+        ]
+
+    tight, tstats = _serve(tight_ex, weights, reqs(), decode_steps=4)
+    roomy, _ = _serve(paged_sex, weights, reqs(), decode_steps=4)
+    assert sorted(tight) == [0, 1, 2]
+    assert tstats["completed"] == 3 and tstats["failed"] == 0
+    for rid in (0, 1, 2):
+        assert tight[rid].error is None
+        assert tight[rid].tokens == roomy[rid].tokens
+    # A request whose reservation exceeds the WHOLE pool is rejected
+    # loudly, not deadlocked (needs 4 blocks, pool holds 3).
+    tiny_ex = ServingExecutor(lm, max_batch=2, max_seq=S,
+                              buckets=(8, S), decode_kernel=False,
+                              kv_block=4, kv_blocks=4)
+    big, _ = _serve(tiny_ex, weights,
+                    [_req(9, [1, 2, 3, 4, 5, 6, 7], max_new=30)],
+                    decode_steps=4)
+    assert "KV blocks" in big[9].error
+
+
+def test_paged_fault_isolation(paged_sex, weights):
+    """The chaos NaN injection on the paged layout (pool block of the
+    target slot, never scratch) fails exactly its own request; the
+    neighbor's tokens are byte-identical to the clean run."""
+    def reqs():
+        return [_req(0, [1, 2, 3], max_new=8),
+                _req(1, [4, 5, 6], max_new=8)]
+
+    clean, _ = _serve(paged_sex, weights, reqs(), decode_steps=4)
+    inj = ServingFaultInjector(nan_cache_at={1: 0})
+    faulted, stats = _serve(paged_sex, weights, reqs(), decode_steps=4,
+                            fault_injector=inj)
+    assert faulted[0].error is not None
+    assert faulted[1].error is None
+    assert faulted[1].tokens == clean[1].tokens
+    assert stats["failed"] == 1 and stats["completed"] == 1
+
+
+def test_paged_capacity_under_budget(lm, monkeypatch):
+    """The DeviceMemoryError budget machinery: under a budget that
+    REFUSES the padded engine, a budget-sized paged pool serves the
+    same slots, and the compute-free capacity estimate admits >= 2x
+    the padded batch at prompt_len << max_seq."""
+    from flexflow_tpu.data.loader import DeviceMemoryError
+
+    padded = ServingExecutor(lm, max_batch=4, max_seq=S, buckets=(8,),
+                             decode_kernel=False)
+    budget = padded.cache_total_bytes() // 2
+    monkeypatch.setenv("FF_DEVICE_MEM_BYTES", str(budget))
+    with pytest.raises(DeviceMemoryError, match="paged"):
+        padded.init_cache()
+    blocks = budget // (4 * padded._bytes_per_token)
+    paged = ServingExecutor(lm, max_batch=4, max_seq=S, buckets=(8,),
+                            decode_kernel=False, kv_block=4,
+                            kv_blocks=blocks)
+    paged.init_cache()  # fits the same budget
+    assert paged.max_admissible_batch(budget, 2, 1) >= \
+        2 * padded.max_admissible_batch(budget, 2, 1)
+
+
+# -- sharded multi-chip decode -------------------------------------------
+
+
+def test_sharded_decode_matches_full_forward(lm, weights, full_forward):
+    """Sharded (batch-on-n) decode logits match the full-seq forward
+    oracle — the single-mesh tolerance discipline."""
+    shx = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                          decode_kernel=False, shard=(2, 1))
+    assert shx.shard == (2, 1)
+    w2 = (shx._place(weights[0]), shx._place(weights[1]))
+    err = _decode_logits_vs_full(shx, w2, full_forward, prefix=6)
+    assert err <= DECODE_TOL, f"sharded decode drift {err}"
+
+
+@pytest.mark.parametrize("shard", [(2, 1), (2, 2)])
+def test_sharded_vs_single_mesh_greedy(lm, sex, weights, shard):
+    """Greedy sequences are identical between the sharded engine
+    (batch on 'n', heads on 'c') and the single-mesh engine, under
+    any batch composition."""
+    shx = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                          decode_kernel=False, shard=shard)
+    w2 = (shx._place(weights[0]), shx._place(weights[1]))
+
+    def reqs():
+        return [_req(0, [5, 9, 2], max_new=6),
+                _req(1, [3, 1, 4, 1, 5], max_new=5)]
+
+    base, _ = _serve(sex, weights, reqs(), decode_steps=4)
+    sh, sstats = _serve(shx, w2, reqs(), decode_steps=4)
+    assert sstats["shard"] == list(shard)
+    for rid in (0, 1):
+        assert sh[rid].error is None
+        assert sh[rid].tokens == base[rid].tokens
+    alone, _ = _serve(shx, w2, [_req(0, [5, 9, 2], max_new=6)],
+                      decode_steps=4)
+    assert alone[0].tokens == sh[0].tokens
+
+
+def test_sharded_falls_back_without_devices(lm, caplog):
+    """Asking for more shard devices than the box has falls back
+    LOUDLY to the single-mesh engine instead of crashing."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="ff.serving"):
+        shx = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8,),
+                              shard=(64, 2))
+    assert shx.shard is None
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_paged_wins_over_shard(lm, caplog):
+    """Paged + sharded do not compose yet: paged wins, loudly."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="ff.serving"):
+        shx = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8,),
+                              kv_block=4, shard=(2, 1))
+    assert shx.paged and shx.shard is None
+    assert any("do not compose" in r.message for r in caplog.records)
+
+
+# -- in-program sampling -------------------------------------------------
+
+
+def test_sampling_replayable(sex, weights):
+    """Temperature/top-k sampling is keyed by (seed, request, pos):
+    re-runs, different batch compositions, and different superstep
+    boundaries (decode_steps) all replay the exact token sequence."""
+    def reqs():
+        return [_req(0, [5, 9, 2], max_new=6),
+                _req(1, [3, 1, 4], max_new=6)]
+
+    kw = dict(temperature=0.8, top_k=8, sample_seed=3)
+    a, astats = _serve(sex, weights, reqs(), decode_steps=4, **kw)
+    b, _ = _serve(sex, weights, reqs(), decode_steps=4, **kw)
+    assert astats["sampled"] is True
+    assert a[0].tokens == b[0].tokens and a[1].tokens == b[1].tokens
+    alone, _ = _serve(sex, weights, [_req(1, [3, 1, 4], max_new=6)],
+                      decode_steps=4, **kw)
+    assert alone[1].tokens == a[1].tokens
+    k2, _ = _serve(sex, weights, reqs(), decode_steps=2, **kw)
+    assert k2[0].tokens == a[0].tokens and k2[1].tokens == a[1].tokens
+    other, _ = _serve(sex, weights, reqs(), decode_steps=4,
+                      temperature=0.8, top_k=8, sample_seed=4)
+    assert (other[0].tokens != a[0].tokens
+            or other[1].tokens != a[1].tokens)
+
+
+def test_sampling_greedy_default_is_oracle(sex, weights):
+    """temperature=0 (default) keeps the greedy path: byte-identical
+    across runs and identical to an explicit greedy server."""
+    def reqs():
+        return [_req(0, [5, 9, 2], max_new=6)]
+
+    g1, gstats = _serve(sex, weights, reqs(), decode_steps=4)
+    g2, _ = _serve(sex, weights, reqs(), decode_steps=4)
+    assert gstats["sampled"] is False
+    assert g1[0].tokens == g2[0].tokens

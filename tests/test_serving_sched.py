@@ -139,14 +139,16 @@ def test_workload_validation():
         make_workload(WorkloadSpec(priorities=0))
 
 
-def test_uniform_workload_matches_deprecated_synthetic():
-    """The migration contract: uniform_workload draws the SAME token
-    content as synthetic_requests (which now warns on arrival_every),
-    with arrivals moved onto the virtual clock."""
-    with pytest.warns(DeprecationWarning, match="arrival_every"):
-        legacy = synthetic_requests(4, V, prompt_len=(3, 6),
-                                    max_new_tokens=6, arrival_every=2,
-                                    seed=5)
+def test_uniform_workload_matches_retired_synthetic():
+    """The migration contract after PR 13's retirement:
+    ``synthetic_requests(arrival_every=...)`` now REFUSES (its
+    one-release deprecation grace is up), and uniform_workload draws
+    the SAME token content with arrivals on the virtual clock."""
+    with pytest.raises(ValueError, match="retired"):
+        synthetic_requests(4, V, prompt_len=(3, 6), max_new_tokens=6,
+                           arrival_every=2, seed=5)
+    legacy = synthetic_requests(4, V, prompt_len=(3, 6),
+                                max_new_tokens=6, seed=5)
     new = uniform_workload(4, V, prompt_len=(3, 6), max_new_tokens=6,
                            every_ms=7.5, seed=5)
     assert all((a.prompt == b.prompt).all() for a, b in zip(legacy, new))
@@ -478,20 +480,18 @@ def test_serve_cli_serve_auto(capsys):
     assert pred == execd
 
 
-@pytest.mark.slow  # end-to-end CLI: deprecated alias still serves
-def test_serve_cli_arrival_every_deprecated(capsys):
+def test_serve_cli_arrival_every_retired():
+    """The retired alias refuses LOUDLY (SystemExit with the
+    migration pointer), before any model or device work."""
     from flexflow_tpu.apps import serve
 
-    rc = serve.main([
-        "--max-seq", "32", "--max-batch", "2", "--decode-steps", "4",
-        "--requests", "4", "--max-new", "6", "--vocab", "64",
-        "--d-model", "16", "--heads", "2", "--layers", "1",
-        "--prompt-len", "3:6", "--arrival-every", "2",
-    ])
-    out = capsys.readouterr().out
-    assert rc == 0
-    assert "--arrival-every is deprecated" in out
-    assert "policy = slo" in out
+    with pytest.raises(SystemExit, match="retired"):
+        serve.main([
+            "--max-seq", "32", "--max-batch", "2", "--decode-steps",
+            "4", "--requests", "4", "--max-new", "6", "--vocab", "64",
+            "--d-model", "16", "--heads", "2", "--layers", "1",
+            "--prompt-len", "3:6", "--arrival-every", "2",
+        ])
 
 
 @pytest.mark.slow  # end-to-end CLI: scheduler dry run audits all ks
@@ -511,3 +511,135 @@ def test_serve_cli_sched_dry_run(capsys):
     # Every adaptive-k candidate width is shape-checked + audited.
     for k in (1, 2, 4, 8):
         assert f"decode k={k}" in out
+
+
+# -- paged capacity on the scheduled path (SERVING.md "Cache layout") ---------
+
+
+def test_slot_shape_paged_validation():
+    """SlotShape mirrors the executor's paged validation, so a config
+    that simulates is a config the executor accepts."""
+    with pytest.raises(ValueError, match="divide"):
+        SlotShape(max_batch=2, max_seq=32, buckets=(8, 32), kv_block=5)
+    with pytest.raises(ValueError, match="kv_block"):
+        SlotShape(max_batch=2, max_seq=32, buckets=(8, 32), kv_blocks=4)
+    shp = SlotShape(max_batch=2, max_seq=32, buckets=(8, 32), kv_block=8)
+    assert shp.paged and shp.kv_blocks == 2 * 4 + 1  # worst case
+    led = shp.make_ledger()
+    assert led.capacity_blocks == shp.kv_blocks - 1
+
+
+def test_sim_matches_real_dispatch_paged(lm, weights):
+    """The sim==real contract EXTENDS to the paged layout: ledger
+    gating is pure host arithmetic shared by both engines, so a
+    block-starved pool produces the same kv_wait decisions, prefill
+    count and superstep count in simulation as on the device."""
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    params, state = weights
+    # kv_block=16 over max_seq=64, pool of 4 allocatable blocks:
+    # two long requests (3 blocks each) cannot share the pool.
+    sex_paged = ServingExecutor(lm, max_batch=2, max_seq=S,
+                                buckets=(8, S), decode_kernel=False,
+                                kv_block=16, kv_blocks=5)
+    reqs = lambda: [_req(0, 4, 40, 0.0), _req(1, 5, 40, 0.0),
+                    _req(2, 3, 6, 1.0), _req(3, 6, 30, 2.0)]
+    pol = SchedulerPolicy(name="slo")
+    real = ScheduledServer(sex_paged, params, state, decode_steps=8,
+                           policy=pol)
+    with Telemetry(None):
+        _, real_st = real.run(reqs())
+    sim = _sim(pol, SlotShape(max_batch=2, max_seq=S, buckets=(8, S),
+                              kv_block=16, kv_blocks=5))
+    _, sim_st = sim.run(reqs())
+    assert sim.decisions == real.decisions
+    assert any(d["d"] == "kv_wait" for d in real.decisions)
+    assert sim_st["prefills"] == real_st["prefills"]
+    assert sim_st["decode_supersteps"] == real_st["decode_supersteps"]
+    assert real_st["kv_layout"] == "paged"
+    assert sim_st["kv_layout"] == "paged"
+    assert _virt(sim_st) == _virt(real_st)
+
+
+def test_sched_paged_output_parity(sex, weights):
+    """Cache layout changes CAPACITY, never content: per-request
+    greedy sequences on a block-starved paged scheduler equal the
+    padded scheduler's."""
+    params, state = weights
+    sex_paged = ServingExecutor(sex.model, max_batch=2, max_seq=S,
+                                buckets=(8, S), decode_kernel=False,
+                                kv_block=16, kv_blocks=5)
+    reqs = lambda: [_req(0, 4, 20, 0.0), _req(1, 5, 20, 0.0),
+                    _req(2, 3, 20, 1.0)]
+    pol = SchedulerPolicy(name="slo")
+    base, _ = ScheduledServer(sex, params, state, decode_steps=4,
+                              policy=pol).run(reqs())
+    paged, _ = ScheduledServer(sex_paged, params, state, decode_steps=4,
+                               policy=pol).run(reqs())
+    for rid in (0, 1, 2):
+        assert paged[rid].error is None
+        assert paged[rid].tokens == base[rid].tokens
+
+
+def test_serve_auto_kv_layout_candidates():
+    """A paged baseline searches block-size variants at fixed pool
+    HBM; every candidate is executor-legal; a padded baseline stays
+    padded."""
+    from flexflow_tpu.serving.search import candidate_kv_layouts
+
+    pol = SchedulerPolicy(name="slo")
+    padded = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                           max_seq=32, policy=pol)
+    assert candidate_kv_layouts(padded) == [(0, None)]
+    paged = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                          max_seq=32, policy=pol, kv_block=8,
+                          kv_blocks=9)
+    variants = candidate_kv_layouts(paged)
+    assert (8, 9) in variants and len(variants) >= 2
+    # Pool-token capacity is preserved across block-size variants.
+    for blk, n in variants:
+        assert (n - 1) * blk == 64
+    reqs = make_workload(WorkloadSpec(
+        n_requests=6, vocab=V, prompt_len=(3, 6), max_new=(2, 8),
+        mean_gap_ms=1.0, seed=3,
+    ))
+    res = search_serving_config(
+        reqs, paged, model=ServingLatencyModel.from_calibration())
+    assert any(s.config.kv_block not in (0, 8) for s in res.candidates)
+    assert res.chosen.config.kv_block > 0  # paged stays paged
+
+
+# -- production-trace workload (shared data-plane source) ---------------------
+
+
+def test_production_workload_live_source():
+    """The prod: workload reads prompt TOKENS from the LIVE
+    data/trace.py ProductionTraceSource (shared source), keeps
+    make_workload's length/budget/arrival draws, and is deterministic."""
+    from flexflow_tpu.data.trace import ProductionTraceSource
+    from flexflow_tpu.serving import production_workload
+
+    spec = WorkloadSpec(n_requests=8, vocab=V, prompt_len=(3, 8),
+                        max_new=(2, 8), mean_gap_ms=2.0, burst=2,
+                        priorities=2, slo_ms=50.0, seed=11)
+    a = production_workload(spec, id_alpha=1.3)
+    b = production_workload(spec, id_alpha=1.3)
+    zipfy = make_workload(spec)
+    assert all((x.prompt == y.prompt).all() for x, y in zip(a, b))
+    # Same non-content draws as the zipf generator...
+    assert [r.arrival_ms for r in a] == [r.arrival_ms for r in zipfy]
+    assert [len(r.prompt) for r in a] == [len(r.prompt) for r in zipfy]
+    assert [r.max_new_tokens for r in a] == \
+        [r.max_new_tokens for r in zipfy]
+    assert [r.priority for r in a] == [r.priority for r in zipfy]
+    # ...but token CONTENT comes from the trace source itself.
+    hi = spec.prompt_len[1]
+    src = ProductionTraceSource(num_samples=spec.n_requests * hi,
+                                dense_dim=1, vocab_sizes=[V],
+                                alpha=1.3, seed=spec.seed,
+                                block=max(hi, 64))
+    for r in a:
+        expect = src.read(r.id * hi,
+                          r.id * hi + len(r.prompt))["sparse_input"][:, 0]
+        assert (r.prompt == expect.astype(np.int32)).all()
+        assert r.prompt.max() < V

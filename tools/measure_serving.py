@@ -2,7 +2,7 @@
 """Serving-scheduler A/B: the SERVING.md "Scheduler policy" acceptance
 run on the 8-dev virtual CPU mesh.
 
-Three measurements, each against its acceptance bar:
+Four measurements, each against its acceptance bar:
 
 - ``slo_vs_fifo p99``: queue-wait p99 of the SLO-CARRYING class (tier
   0 — the class the policy exists to protect; the global p99 is
@@ -15,6 +15,11 @@ Three measurements, each against its acceptance bar:
   oracle) must predict the real run's dispatch counts EXACTLY — same
   decision log, same prefill count, same decode-superstep count, and
   the telemetry program counter must equal prefills + supersteps.
+- ``paged capacity``: under ``FF_DEVICE_MEM_BYTES`` = half the padded
+  cache budget, the padded executor must refuse with
+  ``DeviceMemoryError``, the budget-sized paged pool must serve
+  requests end-to-end, and at a short prompt it must admit >= 2x the
+  padded concurrent batch (SERVING.md "Cache layout").
 
 All compared metrics are VIRTUAL-clock values (the latency model's
 deterministic ms), so the paired protocol's A/A control reads exactly
@@ -186,6 +191,49 @@ def child(argv):
               + f" {'PASS' if ok else 'FAIL'}")
         if not ok:
             failures += 1
+
+    # -- paged capacity under a fixed HBM budget (bar >= 2x) ------------------
+    # SERVING.md "Cache layout": half the padded cache budget via
+    # FF_DEVICE_MEM_BYTES — the padded executor must REFUSE
+    # (DeviceMemoryError before any device_put), the paged pool sized
+    # to that budget must serve requests end-to-end, and at a short
+    # prompt (plen << max_seq) it must admit >= 2x the padded batch.
+    from flexflow_tpu.data.loader import DeviceMemoryError
+    from flexflow_tpu.runtime.serving import Server, synthetic_requests
+
+    budget = sex.cache_total_bytes() // 2
+    os.environ["FF_DEVICE_MEM_BYTES"] = str(budget)
+    try:
+        try:
+            sex.init_cache()
+            padded_refused = False
+        except DeviceMemoryError:
+            padded_refused = True
+        blk = 4
+        blocks = budget // (blk * sex._bytes_per_token)
+        paged = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                                buckets=buckets, kv_block=blk,
+                                kv_blocks=blocks)
+        results, _ = Server(paged, params, state, decode_steps=4).run(
+            synthetic_requests(3, 32, prompt_len=(2, 3),
+                               max_new_tokens=2, seed=1)
+        )
+        served = not any(r.error for r in results.values())
+        plen, mnew = 2, 1
+        cap_padded = sex.max_admissible_batch(budget, plen, mnew)
+        cap_paged = paged.max_admissible_batch(budget, plen, mnew)
+        ratio = cap_paged / max(cap_padded, 1)
+        ok = padded_refused and served and ratio >= 2.0
+        print(f"{'paged capacity':<22} budget {budget} B: padded "
+              f"{'refused' if padded_refused else 'FIT (?)'}; paged "
+              f"({blocks} x {blk}-token blocks) served "
+              f"{len(results)} reqs {'clean' if served else 'WITH ERRORS'}; "
+              f"admits {cap_paged} vs {cap_padded} slots @ plen {plen} "
+              f"({ratio:.1f}x, bar >= 2x) {'PASS' if ok else 'FAIL'}")
+        if not ok:
+            failures += 1
+    finally:
+        os.environ.pop("FF_DEVICE_MEM_BYTES", None)
 
     return 1 if failures else 0
 
