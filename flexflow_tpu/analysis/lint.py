@@ -366,6 +366,7 @@ FF008_EVENT_NAMES = frozenset({
     "request_start", "prefill", "decode_superstep", "request_end",
     "serving_program",
     "sched_decision", "request_preempt", "request_shed",
+    "distributed_init", "elastic_resize",
 })
 
 #: Receiver names that mark an ``.emit(...)`` call as a telemetry

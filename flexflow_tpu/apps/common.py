@@ -76,6 +76,12 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
            telemetry dir, else uncalibrated constants)
   --resilient (detection + checkpoint rollback + SIGTERM emergency save)
   --save-every N   --ckpt-dir PATH   --max-restarts N   --sync-ckpt
+  --elastic (multi-host elastic mode: world-failure gate + world
+             ledger + per-host batch shards; exits 76 on a torn world
+             for the external supervisor — RESILIENCE.md, requires
+             --resilient)
+  --coordinator HOST:PORT   --num-processes N   --process-id I
+             (jax.distributed bootstrap; JAX_* env fallback)
   --telemetry DIR (JSONL run telemetry + heartbeat + stall watchdog,
                    OBSERVABILITY.md)   --stall-deadline S (0 = no watchdog)
   --stall-notify-pid PID (stall escalation: SIGUSR1 to an external
@@ -374,6 +380,12 @@ def _run_resilient(
             "batch_fn; --zc-dataset (device-resident staging) is not "
             "wired into that path yet"
         )
+    if cfg.elastic and cfg.stream_dataset:
+        raise SystemExit(
+            "--elastic needs the world-invariant deterministic batch "
+            "schedule; --stream-dataset's checkpointed cursor is "
+            "host-local and does not survive an elastic resize"
+        )
     eval_arrays = None
     if cfg.eval_iters > 0 and arrays is not None:
         # The same true holdout as the non-resilient path: EVAL numbers
@@ -391,11 +403,63 @@ def _run_resilient(
         batch_fn = make_batch_fn(ff, cfg, arrays, int_high)
     iters = cfg.iterations * max(cfg.epochs, 1)
     ckdir = cfg.ckpt_dir or os.path.join(os.getcwd(), "ckpts")
-    with CheckpointManager(ckdir, async_save=cfg.async_checkpointing) as ck:
-        rt = ResilientTrainer(
-            executor_factory, ck,
-            policy=FailurePolicy(max_restarts=cfg.max_restarts),
+    if cfg.elastic:
+        # Multi-host elastic mode (RESILIENCE.md "Host loss & elastic
+        # resize"): world-failure gate + world ledger + per-host slice
+        # of the deterministic global batch schedule.  The generation
+        # comes from the external supervisor (tools/elastic_rig.py env
+        # protocol); a bare launch is generation 1.
+        from flexflow_tpu.parallel.distributed import world as _world
+        from flexflow_tpu.runtime.elastic import (
+            LedgeredCheckpointManager,
+            WorldLedger,
+            classify_world_failure,
+            worldify,
         )
+
+        host_id, num_hosts = _world()
+        generation = int(os.environ.get("FF_ELASTIC_GENERATION", "1"))
+        ledger = WorldLedger(ckdir)
+        ledger.claim(generation, num_hosts, primary=(host_id == 0))
+        inner_factory = executor_factory
+
+        def executor_factory():
+            return worldify(inner_factory())
+
+        if num_hosts > 1 and batch_fn is not None:
+            from flexflow_tpu.data.stream import shard_for_host
+
+            lo, hi = shard_for_host(cfg.batch_size, host_id, num_hosts)
+            global_fn, gb = batch_fn, cfg.batch_size
+
+            def batch_fn(step):
+                # Every host derives the same deterministic GLOBAL
+                # batch and serves its contiguous slice (process-major,
+                # matching the DCN-outer mesh's batch layout) — the
+                # schedule is world-invariant, so a resized world
+                # replays the identical global trajectory.
+                return {
+                    k: v[lo:hi]
+                    if getattr(v, "ndim", 0) and len(v) == gb else v
+                    for k, v in global_fn(step).items()
+                }
+
+        policy = FailurePolicy(max_restarts=cfg.max_restarts,
+                               fatal=classify_world_failure)
+        ck = LedgeredCheckpointManager(
+            ckdir, ledger, generation,
+            async_save=cfg.async_checkpointing,
+        )
+    else:
+        policy = FailurePolicy(max_restarts=cfg.max_restarts)
+        ck = CheckpointManager(ckdir, async_save=cfg.async_checkpointing)
+    # NOT a `with` block: in a multi-process world ``ck.close()`` is a
+    # COLLECTIVE (orbax barriers the world), so running it while
+    # unwinding a world failure would block forever against the dead
+    # peer.  Close explicitly on the healthy path; a classified world
+    # failure hard-exits with the supervisor contract's code instead.
+    try:
+        rt = ResilientTrainer(executor_factory, ck, policy=policy)
         start = time.perf_counter()
         try:
             out = rt.fit(
@@ -447,6 +511,33 @@ def _run_resilient(
                 Trainer(rt.executor), out["params"], out["state"], cfg,
                 eval_arrays,
             )
+    except BaseException as e:
+        if cfg.elastic:
+            import sys
+
+            from flexflow_tpu.runtime import telemetry as _telemetry
+            from flexflow_tpu.runtime.elastic import (
+                EXIT_WORLD_FAILURE,
+                classify_world_failure as _classify,
+            )
+
+            if _classify(e):
+                # The world died under us: record it (the log is the
+                # postmortem evidence), skip the collective close, and
+                # hand the resize decision to the external supervisor
+                # via the exit-code contract.
+                _telemetry.current().emit(
+                    "fault", kind="world_failure",
+                    error=f"{type(e).__name__}: {e}"[:500],
+                )
+                print(f"elastic: world failure ({type(e).__name__}); "
+                      f"exiting {EXIT_WORLD_FAILURE} for the supervisor",
+                      file=sys.stderr)
+                sys.stderr.flush()
+                os._exit(EXIT_WORLD_FAILURE)
+        ck.close()
+        raise
+    ck.close()
     return stats
 
 
@@ -474,6 +565,22 @@ def run_training(
     """
     from flexflow_tpu.runtime import telemetry as _telemetry
 
+    if (cfg.elastic or cfg.coordinator_address
+            or cfg.num_processes is not None
+            or cfg.process_id is not None):
+        # Bring the world up BEFORE telemetry opens (the run_start
+        # fingerprint records process_id/process_count) and before any
+        # backend touch fixes the device set.
+        from flexflow_tpu.parallel.distributed import initialize
+
+        initialize(cfg.coordinator_address, cfg.num_processes,
+                   cfg.process_id)
+    if cfg.elastic and not cfg.resilient:
+        raise SystemExit(
+            "--elastic is the multi-host arm of the resilient loop; "
+            "add --resilient (RESILIENCE.md 'Host loss & elastic "
+            "resize')"
+        )
     with _telemetry.maybe_run(cfg, meta={"app": label}):
         return _run_training(ff, cfg, strategy, int_high, label,
                              num_samples, arrays, stream_source)

@@ -202,6 +202,23 @@ class FFConfig:
     # --max-restarts N: crash-loop budget — consecutive recoveries
     # without durable progress before giving up (FailurePolicy).
     max_restarts: int = 3
+    # --elastic: multi-host elastic mode (RESILIENCE.md "Host loss &
+    # elastic resize").  Requires --resilient.  Arms the world-failure
+    # gate (a dead peer/coordinator re-raises IMMEDIATELY instead of
+    # burning in-process restarts), claims the checkpoint dir's world
+    # ledger (single-writer rule), shards the deterministic batch
+    # schedule per host, and exits with EXIT_WORLD_FAILURE (76) on a
+    # torn world so an EXTERNAL supervisor (tools/elastic_rig.py, or a
+    # real scheduler speaking the same env protocol) can relaunch the
+    # survivors at the resized world against the same --ckpt-dir.
+    elastic: bool = False
+    # --coordinator HOST:PORT / --num-processes N / --process-id I:
+    # explicit jax.distributed bootstrap (parallel/distributed.py
+    # initialize()); fall back to JAX_COORDINATOR_ADDRESS /
+    # JAX_NUM_PROCESSES / JAX_PROCESS_ID, then cluster auto-detection.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
     # --sync-ckpt: disable async checkpointing (saves then block the
     # train loop until durable; default is non-blocking background
     # writes with a flush fence at restore/exit).
@@ -380,6 +397,14 @@ class FFConfig:
                 cfg.ckpt_dir = _next()
             elif a == "--max-restarts":
                 cfg.max_restarts = int(_next())
+            elif a == "--elastic":
+                cfg.elastic = True
+            elif a == "--coordinator":
+                cfg.coordinator_address = _next()
+            elif a == "--num-processes":
+                cfg.num_processes = int(_next())
+            elif a == "--process-id":
+                cfg.process_id = int(_next())
             elif a == "--sync-ckpt":
                 cfg.async_checkpointing = False
             elif a == "--telemetry":

@@ -53,6 +53,9 @@ EVENT_CATALOG = frozenset({
     "sched_decision",
     "request_preempt",
     "request_shed",
+    # multi-host / elastic (RESILIENCE.md "Host loss & elastic resize")
+    "distributed_init",
+    "elastic_resize",
 })
 
 #: ``run_end.exit`` classifications (the reader adds ``truncated`` for

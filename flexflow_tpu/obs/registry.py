@@ -81,6 +81,12 @@ def box_fingerprint() -> Dict[str, Any]:
         # a first-touch of the relay the run itself would not do.
         fp["platform"] = jax.default_backend()
         fp["devices"] = jax.device_count()
+        # World identity: which process of how many (1/1 single-host).
+        # ``obs compare`` surfaces any delta via fingerprint_diff —
+        # a world-size change between runs IS a box-state change
+        # (elastic resize, RESILIENCE.md).
+        fp["process_id"] = jax.process_index()
+        fp["process_count"] = jax.process_count()
     except Exception as e:
         _log.warning("box_fingerprint: backend identity unavailable (%s)",
                      e)

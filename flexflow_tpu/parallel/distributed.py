@@ -84,12 +84,43 @@ def initialize(
                     "cluster auto-detection unavailable (%s); "
                     "running single-process", e,
                 )
+            else:
+                _emit_distributed_init(coordinator_address)
         return
+    if num_processes is not None and num_processes > 1:
+        # CPU worlds (the elastic rig, tests) need an actual cross-host
+        # collectives backend; gloo is the only one the CPU client
+        # ships.  Set before backend init — a no-op on TPU platforms.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jaxlib without the option
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    _emit_distributed_init(coordinator_address)
+
+
+def _emit_distributed_init(coordinator_address: Optional[str]) -> None:
+    """Record the world bring-up in the run telemetry (no-op stream
+    when telemetry is off — zero overhead on the common path)."""
+    from flexflow_tpu.runtime import telemetry as _telemetry
+
+    _telemetry.current().emit(
+        "distributed_init",
+        process_id=jax.process_index(),
+        process_count=jax.process_count(),
+        coordinator=coordinator_address,
+    )
+
+
+def world() -> tuple:
+    """``(process_id, num_processes)`` of the current runtime — the one
+    pair every per-host derivation (loader shards, batch schedule,
+    single-writer gating) keys off."""
+    return jax.process_index(), jax.process_count()
 
 
 def build_hybrid_mesh_plan(
@@ -113,9 +144,15 @@ def build_hybrid_mesh_plan(
     n = len(devices)
     if num_granules is None:
         num_granules = max(jax.process_count(), 1)
-    assert n % num_granules == 0, (
-        f"{n} devices do not divide into {num_granules} granules"
-    )
+    if num_granules < 1 or n % num_granules != 0:
+        # User-facing config validation (``--granules``): a bare assert
+        # vanishes under ``python -O`` and turns a typo into a wrong
+        # mesh shape downstream.
+        raise ValueError(
+            f"{n} devices do not divide into {num_granules} granules "
+            f"(num_granules must be a positive divisor of the device "
+            f"count)"
+        )
     if num_granules == 1:
         names, sizes = factor_axes(n)
     else:

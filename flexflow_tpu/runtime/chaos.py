@@ -525,6 +525,193 @@ def scenario_serving_overload_shed(root: str) -> Tuple[bool, str]:
                   f"no-shedding run (padded AND paged layouts)")
 
 
+# -- multi-host elastic scenarios (RESILIENCE.md "Host loss & elastic
+# resize") -----------------------------------------------------------------
+#
+# These run the REAL jax.distributed rig: fresh 2-process CPU worlds
+# (gloo collectives, 4 virtual devices per process) supervised by
+# ``run_rig``.  Both scenarios reconstruct their trajectories from the
+# telemetry JSONL streams alone — the log, not the in-memory return
+# value, is the evidence (the chaos contract extended across process
+# boundaries).  Rig generations are jit-compile dominated, so these
+# are the slowest rows of the matrix (~2 min together).
+
+#: Grace window before the supervisor reclaims wedged survivors.  XLA
+#: CPU gloo collectives have NO timeout, so a survivor blocked in an
+#: all-reduce against a dead peer never exits on its own; 12 s is
+#: plenty for the survivor exit paths that DO raise.
+_RIG_GRACE_S = 12.0
+
+_RIG_BASELINES: Dict[int, Dict] = {}
+
+
+def rig_baseline(root: str, world: int = 2) -> Dict:
+    """One clean ``run_rig`` trajectory per world size (cached — the
+    rig is deterministic, so one run serves every scenario)."""
+    if world not in _RIG_BASELINES:
+        from flexflow_tpu.runtime.elastic import run_rig
+
+        d = os.path.join(root, f"rig_base_w{world}")
+        out = run_rig(
+            world, os.path.join(d, "ckpt"), iters=ITERS, k=K,
+            save_every=SAVE_EVERY, telemetry_dir=os.path.join(d, "tel"),
+            log_dir=os.path.join(d, "logs"), grace_s=_RIG_GRACE_S,
+        )
+        assert out["restarts"] == 0 and len(out["losses"]) == ITERS
+        _RIG_BASELINES[world] = out
+    return _RIG_BASELINES[world]
+
+
+def _rig_runs(tel_dir: str) -> Dict[Tuple[int, int], object]:
+    """Map a rig telemetry dir to ``{(generation, process_id):
+    RunLog}`` — ``run_start`` carries the generation (worker meta) and
+    the fingerprint carries the process id."""
+    from flexflow_tpu.obs.reader import RunLog, run_files
+
+    out: Dict[Tuple[int, int], object] = {}
+    for path in run_files(tel_dir):
+        log = RunLog.load(path)
+        rs = log.run_start
+        if rs is None:
+            continue
+        gen = int(rs.get("generation", 0))
+        pid = int((log.fingerprint or {}).get("process_id", -1))
+        out[(gen, pid)] = log
+    return out
+
+
+def _prune_to_snapshot(ckpt_dir: str, ref_dir: str, step: int) -> None:
+    """Copy ``ckpt_dir`` to ``ref_dir`` pruned to the snapshot at
+    ``step``: the world ledger, the supervision result and every later
+    checkpoint go — what remains is exactly what a fresh world would
+    find had the machine died right after that save."""
+    import shutil
+
+    shutil.copytree(ckpt_dir, ref_dir)
+    for name in ("result.json", "world.json"):
+        p = os.path.join(ref_dir, name)
+        if os.path.exists(p):
+            os.remove(p)
+    for name in os.listdir(ref_dir):
+        if name.isdigit() and int(name) > step:
+            shutil.rmtree(os.path.join(ref_dir, name))
+
+
+def scenario_host_loss(root: str) -> Tuple[bool, str]:
+    """Host loss + elastic resize on the live 2-process rig: worker 1
+    is SIGKILLed mid-superstep (step 11, inside the k=8 group
+    assembly — instant and unflushable).  The launcher classifies
+    ``host_loss`` and restarts the survivor as a world=1 generation,
+    which restores the step-8 checkpoint and re-derives its batch
+    schedule from the new world.  Pins: (a) the gen-1 prefix read from
+    telemetry matches the clean world=2 baseline bit-identically;
+    (b) the post-resize trajectory is bit-identical to a FRESH world=1
+    rig launched from the kill-time checkpoint — resize is
+    indistinguishable from having started small."""
+    from flexflow_tpu.runtime.elastic import run_rig
+
+    d = os.path.join(root, "host_loss")
+    out = run_rig(
+        2, os.path.join(d, "ckpt"), iters=ITERS, k=K,
+        save_every=SAVE_EVERY, kill_process=1, kill_at_step=11,
+        telemetry_dir=os.path.join(d, "tel"),
+        log_dir=os.path.join(d, "logs"), grace_s=_RIG_GRACE_S,
+    )
+    gens = out["generations"]
+    if (out["restarts"] != 1 or len(gens) != 2
+            or gens[0].get("classified") != "host_loss"
+            or [g["world"] for g in gens] != [2, 1]
+            or out["final"].get("world") != 1
+            or out["final"].get("step") != ITERS):
+        return False, f"host_loss: unexpected supervision history {gens}"
+    # Reconstruct from the telemetry JSONL alone.
+    runs = _rig_runs(os.path.join(d, "tel"))
+    g1, g2 = runs.get((1, 0)), runs.get((2, 0))
+    if g1 is None or g2 is None:
+        return False, f"host_loss: missing rig logs {sorted(runs)}"
+    resize = g2.first("elastic_resize")
+    if (resize is None or resize.get("from_world") != 2
+            or resize.get("to_world") != 1):
+        return False, "host_loss: gen-2 log carries no 2->1 elastic_resize"
+    if not any(e.get("step") == SAVE_EVERY
+               for e in g2.select("ckpt_restore")):
+        return False, f"host_loss: gen 2 did not restore step {SAVE_EVERY}"
+    base = {int(s): v for s, v in rig_baseline(root)["losses"].items()}
+    prefix = g1.losses()
+    if any(prefix.get(i) != base[i] for i in range(SAVE_EVERY)):
+        return False, ("host_loss: gen-1 world=2 prefix diverged from "
+                       "the clean world=2 baseline")
+    # The resize pin: fresh world=1 from the kill-time snapshot.
+    ref_dir = os.path.join(d, "ref_ckpt")
+    _prune_to_snapshot(os.path.join(d, "ckpt"), ref_dir, SAVE_EVERY)
+    ref = run_rig(
+        1, ref_dir, iters=ITERS, k=K, save_every=SAVE_EVERY,
+        log_dir=os.path.join(d, "ref_logs"), grace_s=_RIG_GRACE_S,
+    )
+    resized = {int(s): v for s, v in out["final"]["losses"].items()}
+    fresh = {int(s): v for s, v in ref["final"]["losses"].items()}
+    if resized != fresh:
+        return False, ("host_loss: post-resize trajectory diverged from "
+                       "a fresh world=1 run off the same checkpoint")
+    tail = {i: v for i, v in g2.losses().items() if i >= SAVE_EVERY}
+    if tail != resized:
+        return False, ("host_loss: gen-2 telemetry does not reconstruct "
+                       "the resized trajectory")
+    return True, ("host_loss: survivor resized 2->1, restored step "
+                  f"{SAVE_EVERY}; post-resize trajectory bit-identical "
+                  "to a fresh world=1 run from that checkpoint "
+                  "(reconstructed from telemetry)")
+
+
+def scenario_coordinator_loss(root: str) -> Tuple[bool, str]:
+    """Coordinator loss on the live rig: process 0 is SIGKILLed at
+    step 11.  Survivors cannot resize around a dead coordinator, so
+    the launcher restarts the SAME world under a fresh coordinator
+    (new port, generation 2) within the restart budget; generation 2
+    restores step 8 and finishes.  The merged trajectory — gen-1
+    prefix from the victim's own telemetry + gen-2 tail — is
+    bit-identical to the clean world=2 baseline."""
+    from flexflow_tpu.runtime.elastic import run_rig
+
+    d = os.path.join(root, "coord_loss")
+    out = run_rig(
+        2, os.path.join(d, "ckpt"), iters=ITERS, k=K,
+        save_every=SAVE_EVERY, kill_process=0, kill_at_step=11,
+        telemetry_dir=os.path.join(d, "tel"),
+        log_dir=os.path.join(d, "logs"), grace_s=_RIG_GRACE_S,
+    )
+    gens = out["generations"]
+    if (out["restarts"] != 1 or len(gens) != 2
+            or gens[0].get("classified") != "coordinator_loss"
+            or [g["world"] for g in gens] != [2, 2]
+            or out["final"].get("world") != 2
+            or out["final"].get("step") != ITERS):
+        return False, f"coordinator_loss: unexpected history {gens}"
+    runs = _rig_runs(os.path.join(d, "tel"))
+    g1, g2 = runs.get((1, 0)), runs.get((2, 0))
+    if g1 is None or g2 is None:
+        return False, f"coordinator_loss: missing rig logs {sorted(runs)}"
+    c1 = (g1.first("distributed_init") or {}).get("coordinator")
+    c2 = (g2.first("distributed_init") or {}).get("coordinator")
+    if not c1 or not c2 or c1 == c2:
+        return False, (f"coordinator_loss: generation 2 reused the dead "
+                       f"coordinator ({c1!r} -> {c2!r})")
+    if g2.first("elastic_resize") is not None:
+        return False, "coordinator_loss: same-world restart emitted a resize"
+    # The victim's log is complete through the step-8 save (rare
+    # events flush immediately); merged with gen 2's tail it must
+    # reproduce the clean world=2 run exactly.
+    merged = {i: v for i, v in g1.losses().items() if i < SAVE_EVERY}
+    merged.update({int(s): v for s, v in out["final"]["losses"].items()})
+    base = {int(s): v for s, v in rig_baseline(root)["losses"].items()}
+    if merged != base:
+        return False, ("coordinator_loss: merged trajectory diverged "
+                       "from the clean world=2 baseline")
+    return True, ("coordinator_loss: same-world restart under a new "
+                  "coordinator; merged trajectory bit-identical to the "
+                  "clean world=2 run (reconstructed from telemetry)")
+
+
 SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "raised_fault": scenario_raised_fault,
     "nan_batch": scenario_nan_batch,
@@ -536,6 +723,8 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "loader_fault": scenario_loader_fault,
     "serving_decode_fault": scenario_serving_decode_fault,
     "serving_overload_shed": scenario_serving_overload_shed,
+    "host_loss": scenario_host_loss,
+    "coordinator_loss": scenario_coordinator_loss,
 }
 
 

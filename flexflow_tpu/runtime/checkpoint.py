@@ -97,7 +97,15 @@ class CheckpointManager:
             directory if "://" in directory else os.path.abspath(directory)
         )
         self.async_save = async_save
-        if "://" not in self.directory:
+        # Multi-process worlds: every process calls save/restore
+        # COLLECTIVELY (orbax coordinates the write and only the
+        # primary commits), but the out-of-band local filesystem
+        # surgery below (crash recovery, force-replace renames) is
+        # SINGLE-WRITER — process 0 only.  Two processes renaming the
+        # same step directory is exactly the torn-world hazard
+        # RESILIENCE.md's coordinator rule exists to prevent.
+        self.is_primary = jax.process_index() == 0
+        if "://" not in self.directory and self.is_primary:
             # Finish any force-replace a previous process died inside —
             # BEFORE orbax scans the directory for steps.
             self._recover_pending_force()
@@ -230,7 +238,12 @@ class CheckpointManager:
         directories the way a killed local rmtree does).
         """
         ocp = _ocp()
-        if "://" in self.directory:
+        if "://" in self.directory or jax.process_count() > 1:
+            # Remote stores have no atomic rename; multi-process worlds
+            # must not have N processes racing the same local renames.
+            # Both take orbax's coordinated delete-then-rewrite path
+            # (the primary performs the I/O, everyone participates in
+            # the collective).
             self._mgr.delete(step)
             saved = self._mgr.save(
                 step, args=ocp.args.Composite(**items), force=True

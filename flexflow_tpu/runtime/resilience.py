@@ -71,6 +71,15 @@ class FailurePolicy:
     # traceback under max_restarts replays.  Those must surface
     # immediately (pinned by tests/test_resilience.py).
     recoverable: tuple = (RuntimeError, OSError)
+    # Classifier for failures that are recoverable BY TYPE but cannot
+    # be recovered in-process: a True verdict re-raises immediately
+    # instead of burning the restart budget on doomed replays.  The
+    # elastic rig installs ``elastic.classify_world_failure`` here — a
+    # gloo peer-loss surfaces as XlaRuntimeError (a RuntimeError), yet
+    # every in-process retry re-enters the same dead world; the
+    # SUPERVISOR must resize, so the process's job is to exit fast
+    # (RESILIENCE.md "Host loss & elastic resize").
+    fatal: Optional[Callable[[BaseException], bool]] = None
 
 
 class StepFailure(RuntimeError):
@@ -578,6 +587,11 @@ class ResilientTrainer:
                         )
                         break
                 except self.policy.recoverable as e:  # noqa: PERF203
+                    if self.policy.fatal is not None and self.policy.fatal(e):
+                        # World-level failure: in-process recovery would
+                        # replay into the same dead collective; surface
+                        # to the supervising launcher for a resize.
+                        raise
                     pending = []
                     new_ex, step, params, opt_state, state = self._recover(
                         ex, seed, e, loader

@@ -151,6 +151,15 @@ def current():
     return _current if _current is not None else NULL
 
 
+def process_tag() -> str:
+    """``-p<N>`` when this process is one of a multi-host world
+    (``JAX_PROCESS_ID`` set — the elastic rig, TPU pods), else empty:
+    N processes sharing one ``--telemetry DIR`` get per-process run
+    JSONL and heartbeat files instead of clobbering each other."""
+    p = os.environ.get("JAX_PROCESS_ID", "")
+    return f"-p{int(p)}" if p.isdigit() else ""
+
+
 def maybe_run(config=None, meta: Optional[Dict[str, Any]] = None):
     """Context manager for an optionally-telemetered run: a fresh
     :class:`Telemetry` when ``config.telemetry_dir`` (or the
@@ -235,7 +244,9 @@ class Telemetry:
         self.meta: Dict[str, Any] = dict(meta or {})
         if directory:
             os.makedirs(directory, exist_ok=True)
-            self.path = os.path.join(directory, f"run-{self.run_id}.jsonl")
+            self.path = os.path.join(
+                directory, f"run-{self.run_id}{process_tag()}.jsonl"
+            )
             self._f = open(self.path, "a")
         #: Box-state identity stamped onto run_start and the run index
         #: (the round-6 drift attribution; cached per process —
@@ -274,7 +285,8 @@ class Telemetry:
         self._hb_path = (
             heartbeat_path
             or os.environ.get("FF_HEARTBEAT_FILE")
-            or (os.path.join(directory, "heartbeat") if directory else None)
+            or (os.path.join(directory, "heartbeat" + process_tag())
+                if directory else None)
         )
         self._hb_warned = False
         self._hb_created = False

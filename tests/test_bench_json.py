@@ -232,7 +232,7 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     # pinned here.
     fp = record["extra"]["fingerprint"]
     assert set(fp) == {"git_sha", "jax", "jaxlib", "platform",
-                       "devices", "host"}
+                       "devices", "host", "process_id", "process_count"}
     assert fp["jax"] is not None
     assert fp["platform"] == "cpu"
     # The chatter landed on stderr, not stdout.

@@ -4,6 +4,7 @@ topology, ``simulator.cc:32-33``)."""
 
 import jax
 import numpy as np
+import pytest
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.graph import FFModel
@@ -89,6 +90,58 @@ def test_initialize_rejects_partial_config(monkeypatch):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="process_id"):
         initialize()
+
+
+def test_initialize_env_arg_precedence(monkeypatch):
+    """The fallback ladder: explicit args win over JAX_* env, env wins
+    over nothing — captured at the jax.distributed boundary."""
+    from flexflow_tpu.parallel.distributed import initialize
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "env-host:1111")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    initialize()
+    assert calls[-1] == {"coordinator_address": "env-host:1111",
+                         "num_processes": 4, "process_id": 3}
+    initialize(coordinator_address="arg-host:2222",
+               num_processes=2, process_id=1)
+    assert calls[-1] == {"coordinator_address": "arg-host:2222",
+                         "num_processes": 2, "process_id": 1}
+
+
+def test_initialize_autodetect_failure_degrades(monkeypatch):
+    """Cluster markers present but jax auto-detection unavailable
+    (ordinary Slurm/k8s job with no JAX cluster behind it) must
+    degrade to the single-process no-op, not crash the run."""
+    from flexflow_tpu.parallel.distributed import initialize
+
+    def boom(**kw):
+        raise RuntimeError("Could not find coordinator address")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLURM_JOB_ID", "12345")
+    initialize()  # must not raise
+
+
+def test_granule_count_validated():
+    """User-facing ValueError (not a bare assert, which vanishes under
+    ``python -O``) for granule counts that don't divide the devices."""
+    with pytest.raises(ValueError, match="granule"):
+        build_hybrid_mesh_plan(num_granules=3)
+    with pytest.raises(ValueError, match="granule"):
+        build_hybrid_mesh_plan(num_granules=0)
+
+
+def test_world_single_process():
+    from flexflow_tpu.parallel.distributed import world
+
+    assert world() == (0, 1)
 
 
 def test_moe_expert_parallel_on_hybrid_mesh(rng):

@@ -1,0 +1,297 @@
+"""Multi-host elastic training: classification, ledger, world-invariant
+data schedule, and the live 2-process rig (RESILIENCE.md "Host loss &
+elastic resize").
+
+Fast cases exercise the pieces in-process (single-process world); the
+``@pytest.mark.slow`` cases spawn REAL 2-process ``jax.distributed``
+CPU worlds through ``run_rig`` — those, plus the ``host_loss`` /
+``coordinator_loss`` rows of the chaos matrix (``test_chaos.py``),
+are the end-to-end pins.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.runtime.elastic import (
+    ELASTIC_CURSOR_TAG,
+    ElasticHostLoader,
+    LedgeredCheckpointManager,
+    TornWorldError,
+    WorldLedger,
+    classify_world_failure,
+    elastic_dataset,
+    elastic_executor_factory,
+    worldify,
+)
+
+
+# -- world-failure classification -------------------------------------------
+
+
+@pytest.mark.parametrize("exc,expect", [
+    (RuntimeError("gloo: Connection reset by peer"), True),
+    (RuntimeError("XlaRuntimeError: UNAVAILABLE: socket closed"), True),
+    (OSError("Broken pipe"), True),
+    (RuntimeError("coordination service heartbeat failure"), True),
+    (TornWorldError("stale generation"), True),
+    # Step-local faults must NOT read as host loss:
+    (RuntimeError("NaN loss at step 11"), False),
+    (OSError("injected disk fault at read 2"), False),
+    # Non-recoverable families never classify, whatever the text —
+    # a ValueError mentioning gloo is a programmer error:
+    (ValueError("gloo misconfigured"), False),
+    (KeyError("gloo"), False),
+])
+def test_classify_world_failure(exc, expect):
+    assert classify_world_failure(exc) is expect
+
+
+# -- torn-world guard --------------------------------------------------------
+
+
+def test_world_ledger_generations(tmp_path):
+    d = str(tmp_path)
+    ledger = WorldLedger(d)
+    ledger.claim(1, 2)
+    assert ledger.read() == {"generation": 1, "world": 2, "writer": 0}
+    ledger.assert_current(1)
+    # Non-primary processes validate but never write.
+    WorldLedger(d).claim(2, 1, primary=False)
+    assert ledger.read()["generation"] == 1
+    # The resized generation takes over; the stale world is torn.
+    WorldLedger(d).claim(2, 1)
+    with pytest.raises(TornWorldError):
+        ledger.assert_current(1)
+    with pytest.raises(TornWorldError):
+        WorldLedger(d).claim(1, 2)
+    # Re-claiming the CURRENT generation is fine (coordinator restart
+    # relaunches the same world at a higher generation, scale-up
+    # relaunches at generation 1 against an equal on-disk claim).
+    WorldLedger(d).claim(2, 1)
+
+
+def test_ledgered_save_refuses_torn_world(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path)
+    ledger = WorldLedger(d)
+    ledger.claim(1, 2)
+    ck = LedgeredCheckpointManager(d, ledger, 1)
+    try:
+        assert ck.save(1, {"w": jnp.zeros(4)}, None, {})
+        WorldLedger(d).claim(2, 1)  # a newer world owns the directory
+        with pytest.raises(TornWorldError):
+            ck.save(2, {"w": jnp.ones(4)}, None, {})
+    finally:
+        ck.close()
+    # And the refusal classifies as a world failure — the stale
+    # process exits the world path, not the replay path.
+    try:
+        raise TornWorldError("x")
+    except TornWorldError as e:
+        assert classify_world_failure(e)
+
+
+# -- world-invariant data schedule -------------------------------------------
+
+
+def test_elastic_loader_world_invariance():
+    """The concatenation of per-host slices (process-major) is
+    byte-identical at every world size — the property the resize
+    leans on.  20 steps crosses the 16-batch epoch boundary, so the
+    reshuffle is covered too."""
+    data = elastic_dataset()
+    for world in (2, 4):
+        ref = ElasticHostLoader(data, 8, seed=0, host_id=0, num_hosts=1)
+        hosts = [ElasticHostLoader(data, 8, seed=0, host_id=h,
+                                   num_hosts=world) for h in range(world)]
+        for _ in range(20):
+            want = next(ref)
+            parts = [next(h) for h in hosts]
+            for key in want:
+                got = np.concatenate([p[key] for p in parts])
+                np.testing.assert_array_equal(got, want[key])
+
+
+def test_elastic_loader_cursor_roundtrip_across_worlds():
+    loader = ElasticHostLoader(elastic_dataset(), 8, host_id=0, num_hosts=2)
+    next(loader)
+    next(loader)
+    state = loader.state_dict()
+    assert int(state["cursor"][2]) == ELASTIC_CURSOR_TAG
+    # A 2-host cursor restores into a 1-host world untranslated.
+    fresh = ElasticHostLoader(elastic_dataset(), 8, host_id=0, num_hosts=1)
+    fresh.load_state_dict(state)
+    assert fresh.global_step == 2
+    two = ElasticHostLoader(elastic_dataset(), 8, host_id=0, num_hosts=2)
+    two.global_step = 2
+    # Host 0's rows lead the global batch (process-major layout).
+    np.testing.assert_array_equal(next(fresh)["x"][:4], next(two)["x"])
+
+
+def test_elastic_loader_validation():
+    data = elastic_dataset()
+    with pytest.raises(ValueError, match="divide"):
+        ElasticHostLoader(data, 8, host_id=0, num_hosts=3)
+    with pytest.raises(ValueError, match="samples"):
+        ElasticHostLoader(data, 256, host_id=0, num_hosts=1)
+    loader = ElasticHostLoader(data, 8, host_id=0, num_hosts=1)
+    with pytest.raises(ValueError, match="elastic"):
+        loader.load_state_dict({
+            "cursor": np.array([0, 8, 7], np.int64),
+            "rng": np.zeros(6, np.uint64),
+        })
+    with pytest.raises(ValueError, match="global_batch"):
+        loader.load_state_dict({
+            "cursor": np.array([0, 16, ELASTIC_CURSOR_TAG], np.int64),
+            "rng": np.zeros(6, np.uint64),
+        })
+
+
+# -- single-process world -----------------------------------------------------
+
+
+def test_worldify_noop_single_process():
+    """At process_count 1 ``worldify`` must leave the executor
+    untouched — no new code on the non-elastic path."""
+    ex = elastic_executor_factory()()
+    assert worldify(ex) is ex
+    assert "shard_batch" not in vars(ex)
+    assert "stack_steps" not in vars(ex)
+
+
+def test_policy_fatal_short_circuits_recovery(tmp_path):
+    """A world failure re-raises IMMEDIATELY — no checkpoint rollback,
+    no restart-budget burn — while the SAME policy still recovers
+    step-local faults (``classify_world_failure`` is the gate)."""
+    from flexflow_tpu.runtime.chaos import chaos_batch_fn, tiny_factory
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+    from flexflow_tpu.runtime.resilience import (
+        FailurePolicy,
+        FaultInjector,
+        ResilientTrainer,
+    )
+
+    class OneShotWorldFault:
+        def __init__(self, at):
+            self.at, self.fired = at, 0
+
+        def __call__(self, step):
+            if step == self.at and not self.fired:
+                self.fired += 1
+                raise RuntimeError("gloo: connection reset by peer")
+
+    inj = OneShotWorldFault(11)
+    with CheckpointManager(str(tmp_path / "fatal"), async_save=True) as ck:
+        rt = ResilientTrainer(
+            tiny_factory(), ck,
+            policy=FailurePolicy(max_restarts=3,
+                                 fatal=classify_world_failure),
+            fault_injector=inj,
+        )
+        with pytest.raises(RuntimeError, match="gloo"):
+            rt.fit(iterations=16, batch_fn=chaos_batch_fn,
+                   save_every=8, steps_per_call=8)
+    assert inj.fired == 1  # raised out, not replayed in-process
+
+    # Control: a step-local fault under the SAME fatal gate recovers.
+    with CheckpointManager(str(tmp_path / "local"), async_save=True) as ck:
+        rt = ResilientTrainer(
+            tiny_factory(), ck,
+            policy=FailurePolicy(max_restarts=3,
+                                 fatal=classify_world_failure),
+            fault_injector=FaultInjector(raise_at=(11,)),
+        )
+        out = rt.fit(iterations=16, batch_fn=chaos_batch_fn,
+                     save_every=8, steps_per_call=8)
+    assert out["restarts"] == 1 and out["step"] == 16
+
+
+def test_single_process_elastic_fit(tmp_path):
+    """The whole elastic stack at world=1: hybrid mesh plan, host
+    loader, ledgered checkpoints, world-failure gate — degrades to a
+    plain resilient run."""
+    from flexflow_tpu.runtime.resilience import FailurePolicy, ResilientTrainer
+
+    d = str(tmp_path / "ck")
+    ledger = WorldLedger(d)
+    ledger.claim(1, 1)
+    loader = ElasticHostLoader(elastic_dataset(), 8)
+    ck = LedgeredCheckpointManager(d, ledger, 1)
+    try:
+        rt = ResilientTrainer(
+            elastic_executor_factory(), ck,
+            policy=FailurePolicy(max_restarts=1,
+                                 fatal=classify_world_failure),
+        )
+        out = rt.fit(iterations=4, save_every=2, steps_per_call=2,
+                     seed=0, loader=loader)
+    finally:
+        ck.close()
+        loader.close()
+    assert out["restarts"] == 0 and len(out["losses"]) == 4
+
+
+# -- per-process observability ------------------------------------------------
+
+
+def test_process_tag_suffix(monkeypatch):
+    from flexflow_tpu.runtime.telemetry import process_tag
+
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    assert process_tag() == ""
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    assert process_tag() == "-p3"
+    monkeypatch.setenv("JAX_PROCESS_ID", "bogus")
+    assert process_tag() == ""
+
+
+def test_fingerprint_world_identity():
+    from flexflow_tpu.obs.registry import box_fingerprint
+
+    fp = box_fingerprint()
+    assert fp["process_id"] == 0
+    assert fp["process_count"] == 1
+
+
+# -- the live rig (slow: real 2-process jax.distributed worlds) --------------
+
+
+@pytest.mark.slow
+def test_rig_scale_up(tmp_path):
+    """Scale-UP is the resize path in reverse: a world=1 run leaves a
+    checkpoint, and relaunching the SAME directory at world=2 restores
+    it and finishes — the strategy-portable handoff plus the
+    world-invariant cursor, end to end.  (The relaunch doubles as the
+    clean 2-process rig pin: fresh coordinator, gloo collectives,
+    per-process telemetry streams.)"""
+    from flexflow_tpu.obs.reader import RunLog, run_files
+    from flexflow_tpu.runtime.elastic import run_rig
+
+    ckpt = str(tmp_path / "ckpt")
+    tel = str(tmp_path / "tel")
+    small = run_rig(1, ckpt, iters=8, k=4, save_every=4,
+                    telemetry_dir=tel, grace_s=12.0)
+    assert small["restarts"] == 0
+    assert sorted(small["losses"]) == list(range(8))
+    big = run_rig(2, ckpt, iters=16, k=4, save_every=4,
+                  telemetry_dir=tel, grace_s=12.0)
+    assert big["restarts"] == 0
+    assert big["final"]["world"] == 2
+    # Restored at step 8 — only the tail is (re)trained.
+    assert sorted(big["losses"]) == list(range(8, 16))
+    # Per-process streams: the world=2 generation wrote one JSONL per
+    # process (-p suffixed), each fingerprinting its world identity.
+    files = run_files(tel)
+    assert any(f.endswith("-p1.jsonl") for f in files)
+    by_pid = {}
+    for f in files:
+        import os
+
+        log = RunLog.load(os.path.join(tel, f))
+        fp = log.fingerprint
+        if fp.get("process_count") == 2:
+            by_pid[fp["process_id"]] = log
+    assert sorted(by_pid) == [0, 1]
+    restores = by_pid[0].select("ckpt_restore")
+    assert any(e.get("step") == 8 for e in restores)

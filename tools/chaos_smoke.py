@@ -8,10 +8,14 @@ kill-between-force-save-phases — each required to finish with a loss
 trajectory bit-identical to the unfaulted run — plus the serving
 fault-isolation scenario (NaN logits / raised exception inside a
 decode superstep: the faulted request errors out, surviving slots'
-sequences byte-identical; SERVING.md).  <2 min on the 8-device
-virtual CPU mesh; never touches the TPU claim (the child is pinned to
-``JAX_PLATFORMS=cpu`` with the axon sitecustomize dropped from
-PYTHONPATH, per CLAUDE.md).
+sequences byte-identical; SERVING.md) — and the multi-host world
+failures, ``host_loss`` and ``coordinator_loss``, on the live
+2-process ``jax.distributed`` rig (RESILIENCE.md "Host loss & elastic
+resize": launcher-classified kill, elastic resize / same-world
+coordinator restart, post-recovery trajectory bit-identical).
+<2 min on the 8-device virtual CPU mesh; never touches the TPU claim
+(the child is pinned to ``JAX_PLATFORMS=cpu`` with the axon
+sitecustomize dropped from PYTHONPATH, per CLAUDE.md).
 
 Usage: python tools/chaos_smoke.py [scenario ...]
 Exit code 0 iff every scenario passed.

@@ -4,7 +4,9 @@
 # chunked-scan dispatch + pipeline-superstep numerics,
 # test_pipeline_chunk.py), superstep execution, the resilience/
 # checkpoint subsystem, the run-telemetry layer, the streaming data
-# plane (test_data_stream.py, DATA.md), and the
+# plane (test_data_stream.py, DATA.md), the multi-host elastic
+# layer (test_distributed.py + test_elastic.py fast cases; the live
+# 2-process rig cases are @slow), and the
 # strategy/execution search — ~5 min on the 8-dev virtual CPU mesh,
 # vs ~14 min+ for the full suite.  Cases marked @pytest.mark.slow are
 # excluded here as in the tier-1 budget run; they stay covered by the
@@ -28,6 +30,8 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_superstep.py \
     tests/test_resilience.py \
     tests/test_checkpoint.py \
+    tests/test_distributed.py \
+    tests/test_elastic.py \
     tests/test_telemetry.py \
     tests/test_obs.py \
     tests/test_data_stream.py \
