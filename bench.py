@@ -823,6 +823,26 @@ def bench_serving(n_chips: int, on_tpu: bool):
         3,
     )
 
+    # Failure-model columns (SERVING.md "Failure model"): the same
+    # workload with one injected slot fault and one engine-class fault
+    # under a retry/restart budget — the counters prove the recovery
+    # machinery ran (a healthy run reports zeros).
+    from flexflow_tpu.runtime.serving import ServingFaultInjector
+    from flexflow_tpu.serving import ServingResilience
+
+    rsrv = ScheduledServer(
+        sex, params, state, decode_steps=8,
+        policy=SchedulerPolicy(name="slo"),
+        resilience=ServingResilience(max_retries=1, max_restarts=1),
+        fault_injector=ServingFaultInjector(
+            nan_cache_at={1: 0},
+            engine_raise_at={3: "injected engine fault"}),
+    )
+    _, rstats = rsrv.run(workload())
+    out["request_retries"] = rstats["request_retries"]
+    out["request_expiries"] = rstats["request_expiries"]
+    out["engine_restarts"] = rstats["engine_restarts"]
+
     # Capacity columns (SERVING.md "Cache layout"): per-slot HBM under
     # both layouts at the leg's typical short prompt, the max batch a
     # fixed cache budget admits (the paged-vs-padded capacity win), and

@@ -366,6 +366,8 @@ FF008_EVENT_NAMES = frozenset({
     "request_start", "prefill", "decode_superstep", "request_end",
     "serving_program",
     "sched_decision", "request_preempt", "request_shed",
+    "request_retry", "request_expire", "serving_drain",
+    "engine_restart", "degraded_mode",
     "distributed_init", "elastic_resize",
 })
 

@@ -66,6 +66,26 @@ Scheduler flags (each enables the scheduled path):
                      latency model and run the winner (--calibration
                      feeds constants)
 
+Failure-model flags (SERVING.md "Failure model"):
+  --journal PATH     append-only request journal (JSONL), written at
+                     the existing decode-superstep fence (no added
+                     fences); re-running with the same PATH replays
+                     it — completed requests are not re-run, in-flight
+                     requests resume with carried tokens, byte-
+                     identical to an uninterrupted run.  Also arms
+                     drain-on-SIGTERM.  Works on the legacy AND the
+                     scheduled path.
+  --serve-retries N  per-request retry budget for slot-isolated faults
+                     (deterministic exponential backoff on the virtual
+                     clock; scheduled path)
+  --retry-backoff-ms X  base backoff, virtual ms (8.0)
+  --serve-max-restarts N  engine-restart (crash-loop) budget; budget
+                     exhausted exits 77 (EXIT_SERVING_FAILURE) for an
+                     external supervisor (default: cfg --max-restarts
+                     when the failure model is armed)
+  --expire-waiting   expire waiting requests past their deadline
+                     (counted as SLO misses — attainment is goodput)
+
 ``--arrival-every`` is RETIRED (PR 12's one-release deprecation grace
 is up): the run refuses it loudly — use ``--workload-trace`` or
 ``serving.workload.uniform_workload(every_ms=...)``.
@@ -219,6 +239,12 @@ def main(argv=None) -> int:
     priorities = pop_int(argv, "--priorities", 0)
     shed_depth = pop_int(argv, "--shed-depth", 0)
     serve_auto = _pop_flag(argv, "--serve-auto")
+    # Failure-model flags (SERVING.md "Failure model").
+    journal_path = _pop_str(argv, "--journal", "")
+    serve_retries = pop_int(argv, "--serve-retries", 0)
+    retry_backoff_ms = pop_float(argv, "--retry-backoff-ms", 8.0)
+    serve_max_restarts = pop_int(argv, "--serve-max-restarts", -1)
+    expire_waiting = _pop_flag(argv, "--expire-waiting")
     cfg = FFConfig.parse_args(argv)
     try:
         lo, hi = (int(v) for v in plen_s.split(":"))
@@ -246,9 +272,13 @@ def main(argv=None) -> int:
                                 max_seq}))
     buckets = tuple(b for b in buckets if b <= max_seq)
 
+    # Retry/expiry/restart knobs are scheduler semantics (virtual-clock
+    # backoff); the journal alone stays on whichever path was chosen.
     use_sched = bool(
         sched_s or workload_trace is not None or slo_ms > 0
         or priorities > 0 or shed_depth > 0 or serve_auto
+        or serve_retries > 0 or serve_max_restarts >= 0
+        or expire_waiting
     )
     if not use_sched:
         return _run_legacy(
@@ -258,7 +288,7 @@ def main(argv=None) -> int:
             heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
             no_kernel=no_kernel, kv_block=kv_block, kv_blocks=kv_blocks,
             shard=shard, temperature=temperature, top_k=top_k,
-            sample_seed=sample_seed,
+            sample_seed=sample_seed, journal_path=journal_path,
         )
     return _run_scheduled(
         cfg, max_seq=max_seq, max_batch=max_batch,
@@ -271,14 +301,18 @@ def main(argv=None) -> int:
         workload_trace=workload_trace, trace_alpha=trace_alpha,
         mean_gap_ms=mean_gap_ms, burst=burst, slo_ms=slo_ms,
         priorities=max(priorities, 1), shed_depth=shed_depth,
-        serve_auto=serve_auto,
+        serve_auto=serve_auto, journal_path=journal_path,
+        serve_retries=serve_retries, retry_backoff_ms=retry_backoff_ms,
+        serve_max_restarts=serve_max_restarts,
+        expire_waiting=expire_waiting,
     )
 
 
 def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 max_new, eos, vocab, d_model, heads, layers, lo, hi,
                 buckets, no_kernel, kv_block, kv_blocks, shard,
-                temperature, top_k, sample_seed) -> int:
+                temperature, top_k, sample_seed,
+                journal_path="") -> int:
     """The closed-loop FIFO path — still the chaos decode-fault
     harness and the scheduler's numerics oracle."""
     from flexflow_tpu.runtime import telemetry as _telemetry
@@ -287,6 +321,7 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         ServingExecutor,
         synthetic_requests,
     )
+    from flexflow_tpu.serving import RequestJournal
 
     ff = build_transformer_lm(
         batch_size=max_batch, seq_len=max_seq, vocab_size=vocab,
@@ -317,13 +352,18 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         srv = Server(sex, params, state, decode_steps=decode_steps,
                      eos_id=None if eos < 0 else eos,
                      temperature=temperature, top_k=top_k,
-                     sample_seed=sample_seed)
+                     sample_seed=sample_seed,
+                     journal=(RequestJournal(journal_path)
+                              if journal_path else None))
         t0 = time.perf_counter()
         results, stats = srv.run(requests)
         elapsed = time.perf_counter() - t0
     print(f"requests = {stats['requests']} "
           f"completed = {stats['completed']} failed = {stats['failed']}")
     _print_layout(stats)
+    if stats.get("drained"):
+        print(f"drained: remainder journaled in {journal_path or '?'} "
+              f"(re-run with the same --journal to resume)")
     print(f"time = {elapsed:.4f}s")
     print(f"tokens/s = {stats['tokens_per_s']:.1f}")
     print(f"request latency p50 = {stats['request_latency_ms_p50']:.1f} ms "
@@ -339,14 +379,23 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                    buckets, no_kernel, kv_block, kv_blocks, shard,
                    temperature, top_k, sample_seed, policy_name,
                    workload_trace, trace_alpha, mean_gap_ms, burst,
-                   slo_ms, priorities, shed_depth, serve_auto) -> int:
+                   slo_ms, priorities, shed_depth, serve_auto,
+                   journal_path="", serve_retries=0,
+                   retry_backoff_ms=8.0, serve_max_restarts=-1,
+                   expire_waiting=False) -> int:
     from flexflow_tpu.runtime import telemetry as _telemetry
-    from flexflow_tpu.runtime.serving import ServingExecutor
+    from flexflow_tpu.runtime.serving import (
+        EXIT_SERVING_FAILURE,
+        ServingCrashLoop,
+        ServingExecutor,
+    )
     from flexflow_tpu.runtime.trainer import relay_safe_steps
     from flexflow_tpu.serving import (
+        RequestJournal,
         ScheduledServer,
         SchedulerPolicy,
         ServingConfig,
+        ServingResilience,
         SlotShape,
         WorkloadSpec,
         make_workload,
@@ -356,6 +405,14 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
     )
 
     decode_steps = relay_safe_steps(decode_steps, what="decode_steps")
+    resilience = ServingResilience(
+        max_retries=serve_retries,
+        retry_backoff_ms=retry_backoff_ms,
+        max_restarts=(serve_max_restarts if serve_max_restarts >= 0
+                      else cfg.max_restarts),
+        expire_waiting=expire_waiting,
+    ) if (serve_retries > 0 or serve_max_restarts >= 0
+          or expire_waiting or journal_path) else None
     base_slo = slo_ms if slo_ms > 0 else float("inf")
     if policy_name == "fifo":
         policy = SchedulerPolicy.fifo()
@@ -458,10 +515,19 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             sex, params, state, decode_steps=decode_steps,
             eos_id=None if eos < 0 else eos, policy=policy,
             latency_model=model, temperature=temperature, top_k=top_k,
-            sample_seed=sample_seed,
+            sample_seed=sample_seed, resilience=resilience,
+            journal=(RequestJournal(journal_path)
+                     if journal_path else None),
         )
         t0 = time.perf_counter()
-        results, stats = srv.run(requests)
+        try:
+            results, stats = srv.run(requests)
+        except ServingCrashLoop as e:
+            print(f"serving crash loop: {e}", file=sys.stderr)
+            print(f"exiting {EXIT_SERVING_FAILURE} for the external "
+                  f"supervisor (engine restart budget exhausted; the "
+                  f"journal carries completed + in-flight state)")
+            return EXIT_SERVING_FAILURE
         elapsed = time.perf_counter() - t0
 
     print(f"policy = {policy.describe()}")
@@ -482,6 +548,17 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
     print(f"decode supersteps = {stats['decode_supersteps']} "
           f"(k<={stats['decode_steps_per_call']}, 1 dispatch + 1 fence "
           f"per superstep)")
+    if stats.get("request_retries") or stats.get("request_expiries") \
+            or stats.get("engine_restarts"):
+        print(f"failure model: retries = {stats['request_retries']} "
+              f"expiries = {stats['request_expiries']} "
+              f"engine restarts = {stats['engine_restarts']}")
+    if stats.get("degraded_rungs"):
+        print(f"DEGRADED: rungs taken = "
+              f"{', '.join(stats['degraded_rungs'])}")
+    if stats.get("drained"):
+        print(f"drained: remainder journaled in {journal_path or '?'} "
+              f"(re-run with the same --journal to resume)")
     if choice is not None:
         print(f"serve-auto: predicted e2e p99 "
               f"{choice.predicted_p99_ms:.3f} ms, measured "

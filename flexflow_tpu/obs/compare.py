@@ -44,6 +44,9 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # so any change is a scheduling regression, not box noise.
     "request_sheds": 0.01,
     "request_preempts": 0.01,
+    "request_retries": 0.01,
+    "request_expiries": 0.01,
+    "engine_restarts": 0.01,
     "queue_wait_ms_p50": 0.01,
     "queue_wait_ms_p99": 0.01,
     "slo_attainment": 0.01,
@@ -57,6 +60,8 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
 #: Metrics read from the run summary vs the calibration block.
 _SUMMARY_METRICS = ("fences_per_step", "programs_per_step",
                     "request_sheds", "request_preempts",
+                    "request_retries", "request_expiries",
+                    "engine_restarts",
                     "queue_wait_ms_p50", "queue_wait_ms_p99",
                     "slo_attainment",
                     "step_ms_p50", "step_ms_p95", "input_wait_ms_p50")

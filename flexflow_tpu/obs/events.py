@@ -53,6 +53,12 @@ EVENT_CATALOG = frozenset({
     "sched_decision",
     "request_preempt",
     "request_shed",
+    # serving failure model (SERVING.md "Failure model")
+    "request_retry",
+    "request_expire",
+    "serving_drain",
+    "engine_restart",
+    "degraded_mode",
     # multi-host / elastic (RESILIENCE.md "Host loss & elastic resize")
     "distributed_init",
     "elastic_resize",

@@ -240,6 +240,7 @@ class RunLog:
         queue_waits: List[float] = []
         slo_oks: List[bool] = []
         steps = fences = sheds = preempts = 0
+        retries = expiries = restarts = 0
         for e in self.events:
             if e.ev == "step":
                 steps += 1
@@ -263,6 +264,12 @@ class RunLog:
                 sheds += 1
             elif e.ev == "request_preempt":
                 preempts += 1
+            elif e.ev == "request_retry":
+                retries += 1
+            elif e.ev == "request_expire":
+                expiries += 1
+            elif e.ev == "engine_restart":
+                restarts += 1
         out: Dict[str, Any] = {"steps": steps, "fences": fences}
         out["fences_per_step"] = round(fences / max(steps, 1), 4)
         if step_walls:
@@ -287,6 +294,14 @@ class RunLog:
             out["queue_wait_ms_p99"] = round(_pct(qs, 0.99), 3)
             out["request_sheds"] = sheds
             out["request_preempts"] = preempts
+        if queue_waits or retries or expiries or restarts:
+            # Failure-model counters (SERVING.md "Failure model"):
+            # present whenever the run was a scheduled serving run or
+            # any fault-recovery event fired, matching the
+            # scheduler's note_summary field set.
+            out["request_retries"] = retries
+            out["request_expiries"] = expiries
+            out["engine_restarts"] = restarts
         if slo_oks:
             out["slo_attainment"] = round(sum(slo_oks) / len(slo_oks), 4)
         return out

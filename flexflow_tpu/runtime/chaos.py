@@ -352,13 +352,15 @@ def scenario_loader_fault(root: str) -> Tuple[bool, str]:
                     trajectory(out["losses"], ITERS), out)
 
 
-def _serving_setup(kv_block: int = 0):
+def _serving_setup(kv_block: int = 0, buckets: Tuple[int, ...] = (8,)):
     """Tiny transformer LM serving stack shared by the baseline and
     faulted runs of the serving chaos scenario (one instance = shared
     compiled programs; params deterministic from the seed).
     ``kv_block > 0`` builds the paged-KV variant of the same stack —
     params are identical across layouts, so paged survivor sequences
-    must stay byte-identical to the padded baseline."""
+    must stay byte-identical to the padded baseline.  The recovery
+    scenarios pass wider ``buckets`` so the re-prefill resume path
+    (prompt ‖ carried tokens) stays bucketable."""
     from flexflow_tpu.models.transformer import build_transformer_lm
     from flexflow_tpu.runtime.serving import ServingExecutor
 
@@ -366,7 +368,7 @@ def _serving_setup(kv_block: int = 0):
         batch_size=2, seq_len=32, vocab_size=32, d_model=16,
         num_heads=2, num_layers=1, config=FFConfig(batch_size=2),
     )
-    sex = ServingExecutor(ff, max_batch=2, max_seq=32, buckets=(8,),
+    sex = ServingExecutor(ff, max_batch=2, max_seq=32, buckets=buckets,
                           kv_block=kv_block)
     params, state = sex.init(seed=0)
     return sex, params, state
@@ -523,6 +525,177 @@ def scenario_serving_overload_shed(root: str) -> Tuple[bool, str]:
                   f"deterministically across replays; all "
                   f"{len(res_c)} survivors byte-identical to the "
                   f"no-shedding run (padded AND paged layouts)")
+
+
+def _merge_tokens(results) -> Dict[int, List[int]]:
+    return {rid: list(r.tokens) for rid, r in results.items()}
+
+
+def scenario_serving_engine_crash(root: str) -> Tuple[bool, str]:
+    """Journaled engine-crash recovery (SERVING.md "Failure model"):
+    an ENGINE-class fault (injected compiled-program death) kills the
+    scheduled server mid-run with the crash-loop budget at 0 — the
+    process-death case.  A fresh server pointed at the SAME journal
+    replays it: completed requests are restored without re-running,
+    in-flight requests resume via re-prefill over (prompt ‖ carried
+    tokens), and the merged output is byte-identical to an
+    uninterrupted run.  A second variant keeps the budget at 1 and
+    recovers IN-PROCESS (programs/caches/ledger rebuilt, journal
+    replayed internally) — same byte-identical contract, plus the
+    paged-KV sub-check."""
+    from flexflow_tpu.runtime.serving import (
+        ServingCrashLoop,
+        ServingFaultInjector,
+    )
+    from flexflow_tpu.serving import (
+        RequestJournal,
+        ScheduledServer,
+        ServingResilience,
+    )
+
+    buckets = (8, 16, 32)
+
+    def run_stack(sex, params, state, journal=None, injector=None,
+                  max_restarts=0):
+        srv = ScheduledServer(
+            sex, params, state, decode_steps=4,
+            resilience=ServingResilience(max_restarts=max_restarts),
+            journal=journal, fault_injector=injector,
+        )
+        results, stats = srv.run(_serving_requests())
+        return results, stats
+
+    sex, params, state = _serving_setup(buckets=buckets)
+    base, _ = run_stack(sex, params, state)
+    if any(r.error for r in base.values()):
+        return False, "engine_crash: unfaulted baseline had errors"
+
+    # 1) Crash: budget 0, the engine fault escalates to ServingCrashLoop
+    # (EXIT_SERVING_FAILURE semantics) — the journal is all that's left.
+    jpath = os.path.join(root, "engine_crash", "journal.jsonl")
+    inj = ServingFaultInjector(
+        engine_raise_at={2: "injected compiled-program death"}
+    )
+    try:
+        run_stack(sex, params, state, journal=RequestJournal(jpath),
+                  injector=inj)
+        return False, "engine_crash: crash-loop budget never tripped"
+    except ServingCrashLoop:
+        pass
+    if not any(m == "engine" for m, _, _ in inj.fired):
+        return False, f"engine_crash: injector fired {inj.fired}"
+    # 2) Recovery: a fresh server replays the SAME journal.
+    res_r, stats_r = run_stack(sex, params, state,
+                               journal=RequestJournal(jpath))
+    if any(r.error for r in res_r.values()):
+        return False, "engine_crash: resumed run had errors"
+    if _merge_tokens(res_r) != _merge_tokens(base):
+        return False, ("engine_crash: resumed outputs DIVERGED from "
+                       "the uninterrupted run")
+    # 3) In-process restart: budget 1 absorbs the same fault.
+    res_i, stats_i = run_stack(
+        sex, params, state,
+        journal=RequestJournal(
+            os.path.join(root, "engine_crash", "journal_inproc.jsonl")),
+        injector=ServingFaultInjector(
+            engine_raise_at={2: "injected compiled-program death"}),
+        max_restarts=1,
+    )
+    if stats_i.get("engine_restarts") != 1:
+        return False, (f"engine_crash: expected 1 in-process restart, "
+                       f"got {stats_i.get('engine_restarts')}")
+    if any(r.error for r in res_i.values()) \
+            or _merge_tokens(res_i) != _merge_tokens(base):
+        return False, ("engine_crash: in-process restart outputs "
+                       "DIVERGED from the uninterrupted run")
+    # 4) Paged sub-check: crash + journal resume on the paged-KV stack,
+    # byte-identical to the PADDED uninterrupted baseline.
+    sexp, pparams, pstate = _serving_setup(kv_block=8, buckets=buckets)
+    pj = os.path.join(root, "engine_crash", "journal_paged.jsonl")
+    try:
+        run_stack(sexp, pparams, pstate, journal=RequestJournal(pj),
+                  injector=ServingFaultInjector(
+                      engine_raise_at={2: "injected death"}))
+        return False, "engine_crash[paged]: budget never tripped"
+    except ServingCrashLoop:
+        pass
+    res_p, stats_p = run_stack(sexp, pparams, pstate,
+                               journal=RequestJournal(pj))
+    if stats_p.get("kv_layout") != "paged":
+        return False, "engine_crash: paged sub-check did not run paged"
+    if any(r.error for r in res_p.values()) \
+            or _merge_tokens(res_p) != _merge_tokens(base):
+        return False, ("engine_crash[paged]: resumed outputs DIVERGED "
+                       "from the padded uninterrupted run")
+    return True, ("engine_crash: journal resume AND in-process restart "
+                  "both byte-identical to the uninterrupted run "
+                  "(padded AND paged layouts)")
+
+
+def scenario_serving_sigterm_drain(root: str) -> Tuple[bool, str]:
+    """Drain-on-SIGTERM (SERVING.md "Failure model"): SIGTERM lands
+    mid-run (injected between decode supersteps, the
+    ``FaultInjector.preempt_at`` pattern) on a journal-armed legacy
+    server — admissions stop, in-flight work is journaled at the next
+    fence, the run exits cleanly with ``drained`` stats and NO errors.
+    A fresh server on the same journal serves the remainder; the
+    merged output is byte-identical to an undrained run.  Paged
+    sub-check included."""
+    from flexflow_tpu.runtime.serving import Server, ServingFaultInjector
+    from flexflow_tpu.serving import RequestJournal
+
+    buckets = (8, 16, 32)
+    sex, params, state = _serving_setup(buckets=buckets)
+    base, _ = Server(sex, params, state, decode_steps=4).run(
+        _serving_requests()
+    )
+    if any(r.error for r in base.values()):
+        return False, "sigterm_drain: unfaulted baseline had errors"
+
+    def drain_and_resume(sex_, params_, state_, jpath):
+        inj = ServingFaultInjector(preempt_at={1})
+        res_d, stats_d = Server(
+            sex_, params_, state_, decode_steps=4,
+            journal=RequestJournal(jpath), fault_injector=inj,
+        ).run(_serving_requests())
+        if not stats_d.get("drained"):
+            return None, f"drain never triggered (fired {inj.fired})"
+        if any(r.error for r in res_d.values()):
+            return None, "drained run had errors"
+        if len(res_d) >= len(base):
+            return None, "drain finished everything (nothing deferred)"
+        res_r, stats_r = Server(
+            sex_, params_, state_, decode_steps=4,
+            journal=RequestJournal(jpath),
+        ).run(_serving_requests())
+        if stats_r.get("drained"):
+            return None, "resume run reported drained"
+        return (res_r, stats_r), None
+
+    out, why = drain_and_resume(
+        sex, params, state,
+        os.path.join(root, "sigterm_drain", "journal.jsonl"))
+    if out is None:
+        return False, f"sigterm_drain: {why}"
+    res_r, _ = out
+    if _merge_tokens(res_r) != _merge_tokens(base):
+        return False, ("sigterm_drain: resumed outputs DIVERGED from "
+                       "the undrained run")
+    sexp, pparams, pstate = _serving_setup(kv_block=8, buckets=buckets)
+    pout, pwhy = drain_and_resume(
+        sexp, pparams, pstate,
+        os.path.join(root, "sigterm_drain", "journal_paged.jsonl"))
+    if pout is None:
+        return False, f"sigterm_drain[paged]: {pwhy}"
+    pres, pstats = pout
+    if pstats.get("kv_layout") != "paged":
+        return False, "sigterm_drain: paged sub-check did not run paged"
+    if _merge_tokens(pres) != _merge_tokens(base):
+        return False, ("sigterm_drain[paged]: resumed outputs DIVERGED "
+                       "from the padded undrained run")
+    return True, ("sigterm_drain: drained cleanly at the superstep "
+                  "boundary; journal resume byte-identical to the "
+                  "undrained run (padded AND paged layouts)")
 
 
 # -- multi-host elastic scenarios (RESILIENCE.md "Host loss & elastic
@@ -723,6 +896,8 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "loader_fault": scenario_loader_fault,
     "serving_decode_fault": scenario_serving_decode_fault,
     "serving_overload_shed": scenario_serving_overload_shed,
+    "serving_engine_crash": scenario_serving_engine_crash,
+    "serving_sigterm_drain": scenario_serving_sigterm_drain,
     "host_loss": scenario_host_loss,
     "coordinator_loss": scenario_coordinator_loss,
 }

@@ -102,6 +102,30 @@ class ServingFault(RuntimeError):
         self.slot = slot
 
 
+class ServingEngineFault(RuntimeError):
+    """An ENGINE-class serving fault: a compiled program raised, the
+    cache pool is suspect, a kernel failed repeatedly — nothing a
+    single slot owns.  The scheduled loop answers with an engine
+    restart (rebuild programs/caches/ledger, requeue in-flight work
+    with carried tokens — SERVING.md "Failure model"); the legacy
+    closed loop lets it propagate, which is the crash the request
+    journal recovers from."""
+
+
+class ServingCrashLoop(RuntimeError):
+    """The engine-restart budget is exhausted — the serving analogue
+    of the training crash-loop guard (``FailurePolicy.max_restarts``).
+    ``apps/serve.py`` maps it to :data:`EXIT_SERVING_FAILURE` for an
+    external supervisor, mirroring ``EXIT_WORLD_FAILURE``."""
+
+
+#: Process exit code for an unrecoverable serving engine (crash-loop
+#: budget exhausted): the supervisor-facing signal that restarting the
+#: SAME process is pointless, next to ``elastic.EXIT_WORLD_FAILURE``'s
+#: 76 in the supervisor's decision table (RESILIENCE.md).
+EXIT_SERVING_FAILURE = 77
+
+
 class ServingFaultInjector:
     """Scheduled chaos for the serving loop (the FaultInjector pattern
     from ``runtime/resilience.py``, keyed by decode-superstep index).
@@ -114,23 +138,62 @@ class ServingFaultInjector:
     - ``raise_at``: ``{superstep_index: slot}`` — a host-side raise
       attributed to the slot before the dispatch (the raised-failure
       class); the superstep never runs, so neighbors lose nothing.
+    - ``engine_raise_at``: ``{superstep_index: message}`` — an
+      ENGINE-class :class:`ServingEngineFault` before the dispatch
+      (compiled-program death, poisoned pool): no slot to blame, the
+      whole engine restarts (or the process dies, in the legacy loop).
+    - ``preempt_at``: ``{superstep_index}`` — SIGTERM to our own
+      process before the dispatch (the ``FaultInjector.preempt_at``
+      pattern): with a drain-armed server the run drains at the next
+      boundary and exits cleanly.
+
+    The same schedule drives the REAL loop (device caches NaN'd) and
+    the scheduler's compute-free simulate loop (``caches=None``: the
+    target slot is returned for the sim to mark non-finite) — keyed
+    by superstep index, both fire identically, which is what keeps
+    sim-vs-real dispatch exactness through faults.
     """
 
     def __init__(self, nan_cache_at: Optional[Dict[int, int]] = None,
-                 raise_at: Optional[Dict[int, int]] = None):
+                 raise_at: Optional[Dict[int, int]] = None,
+                 engine_raise_at: Optional[Dict[int, str]] = None,
+                 preempt_at: Optional[Sequence[int]] = None):
         self.nan_cache_at = dict(nan_cache_at or {})
         self.raise_at = dict(raise_at or {})
-        #: Log of ("nan_cache"|"raise", superstep, slot) fired.
+        self.engine_raise_at = dict(engine_raise_at or {})
+        self.preempt_at = set(preempt_at or ())
+        #: Log of ("nan_cache"|"raise"|"engine"|"preempt",
+        #: superstep, slot-or--1) fired.
         self.fired: List[Tuple[str, int, int]] = []
 
     def before_superstep(self, idx: int, caches, block_table=None):
-        """Returns possibly-corrupted caches; may raise ServingFault.
+        """Returns ``(caches, nan_slot)``; may raise
+        :class:`ServingFault` / :class:`ServingEngineFault` or SIGTERM
+        the process.  ``nan_slot`` is the slot whose cache was NaN'd
+        (None otherwise) — the real loop ignores it (the device
+        finiteness flag detects the fault), the simulate loop flips
+        that slot's fabricated flag.
 
         ``block_table`` (host (B, nblk) int32) switches the NaN
         injection to the paged layout: the target slot's FIRST owned
         pool block goes NaN — the paged analogue of NaNing the slot's
         padded cache row (never the shared scratch block 0, which
         would leak the fault across slots)."""
+        if idx in self.preempt_at:
+            self.preempt_at.discard(idx)
+            self.fired.append(("preempt", idx, -1))
+            import os
+            import signal
+
+            os.kill(os.getpid(), signal.SIGTERM)
+        if idx in self.engine_raise_at:
+            msg = self.engine_raise_at.pop(idx)
+            self.fired.append(("engine", idx, -1))
+            _telemetry.current().emit("fault", mode="serving_engine",
+                                      superstep=idx, slot=None)
+            raise ServingEngineFault(
+                msg or f"injected engine fault at superstep {idx}"
+            )
         if idx in self.raise_at:
             slot = self.raise_at.pop(idx)
             self.fired.append(("raise", idx, slot))
@@ -142,18 +205,21 @@ class ServingFaultInjector:
             self.fired.append(("nan_cache", idx, slot))
             _telemetry.current().emit("fault", mode="serving_nan",
                                       superstep=idx, slot=slot)
+            if caches is None:
+                return None, slot  # simulate mode: no device caches
             name = next(iter(caches))
             k = caches[name]["k"]
             if block_table is not None:
                 dest = int(block_table[slot][0])
                 if dest == 0:  # slot owns no blocks: nothing to corrupt
-                    return caches
+                    return caches, None
                 k = k.at[dest].set(jnp.nan)
             else:
                 k = k.at[slot].set(jnp.nan)
             caches = dict(caches)
             caches[name] = {"k": k, "v": caches[name]["v"]}
-        return caches
+            return caches, slot
+        return caches, None
 
 
 @dataclasses.dataclass
@@ -277,9 +343,16 @@ class _Slot:
     request: Request
     pos: int                 # position of the NEXT token to decode
     last_tok: int            # token at position pos-1... fed to decode
-    tokens: List[int]
+    tokens: List[int]        # tokens generated THIS occupancy
     t_eligible: float
     prefill_s: float
+    #: Tokens carried from a previous (crashed / drained) run via the
+    #: journal — the re-prefill-over-(prompt ‖ carried) resume.
+    carried: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def all_tokens(self) -> List[int]:
+        return self.carried + self.tokens
 
 
 class ServingExecutor:
@@ -688,18 +761,52 @@ class ServingExecutor:
 
     # -- compiled programs ---------------------------------------------------
 
-    def build_prefill(self, bucket: int):
+    def build_prefill(self, bucket: int,
+                      sample: Optional[Tuple[float, int, int]] = None):
         """One jitted prefill program per pad bucket: ``(params,
         op_state, tokens (1, bucket), length ()) -> (cache_rows,
         first_token, finite)``.  ``cache_rows`` are (max_seq, h, hd)
         per layer (rows beyond ``bucket`` zero), ready for
-        :meth:`install` into a slot."""
-        fn = self._prefill_fns.get(bucket)
+        :meth:`install` into a slot.
+
+        ``sample=(temperature, top_k, seed)`` builds the SAMPLED
+        variant — ``(params, op_state, tokens, length, prompt_len,
+        req_id) -> ...`` — needed by the loss-free resume primitive
+        (preemption and journal recovery, SERVING.md "Failure model"):
+        a re-prefill over (prompt ‖ carried) regenerates a position
+        the decode head SAMPLED, so its token must be the identical
+        ``fold_in(fold_in(key(seed), req_id), length - 1)`` draw the
+        unresumed run made there.  A fresh admission
+        (``length == prompt_len``) keeps the greedy first token — the
+        decode head only ever samples positions past the prompt."""
+        if sample is not None:
+            temperature, top_k, sample_seed = sample
+            sample = (float(temperature), int(top_k), int(sample_seed))
+        key = bucket if sample is None else (bucket, sample)
+        fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
         S = self.max_seq
+        base_key = (
+            jax.random.key(sample[2]) if sample is not None else None
+        )
 
-        def prefill(params, op_state, tokens, length):
+        def pick_first(last, length, plen, rid):
+            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            if sample is None:
+                return greedy
+            temperature, top_k, _seed = sample
+            kkey = jax.random.fold_in(
+                jax.random.fold_in(base_key, rid), length - 1
+            )
+            lg = last.astype(jnp.float32) / temperature
+            if 0 < top_k < lg.shape[-1]:
+                kth = jax.lax.top_k(lg, top_k)[0][-1]
+                lg = jnp.where(lg >= kth, lg, -jnp.inf)
+            drawn = jax.random.categorical(kkey, lg).astype(jnp.int32)
+            return jnp.where(length > plen, drawn, greedy)
+
+        def run(params, op_state, tokens, length, plen, rid):
             caches = {
                 name: {
                     "k": jnp.zeros((1, S, h, hd), dt),
@@ -714,7 +821,7 @@ class ServingExecutor:
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], length - 1, axis=0, keepdims=False
             )
-            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            tok = pick_first(last, length, plen, rid)
             ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)))
             rows = {
                 name: {"k": c["k"][0], "v": c["v"][0]}
@@ -722,9 +829,17 @@ class ServingExecutor:
             }
             return rows, tok, ok
 
-        fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        if sample is not None:
+            def prefill(params, op_state, tokens, length, plen, rid):
+                return run(params, op_state, tokens, length, plen, rid)
+        else:
+            def prefill(params, op_state, tokens, length):
+                return run(params, op_state, tokens, length, None, None)
+
+        fn = self._prefill_fns[key] = jax.jit(prefill)
         _telemetry.current().emit("serving_program", kind="prefill",
-                                  bucket=int(bucket))
+                                  bucket=int(bucket),
+                                  sampled=sample is not None)
         return fn
 
     @functools.cached_property
@@ -960,6 +1075,8 @@ class Server:
         temperature: float = 0.0,
         top_k: int = 0,
         sample_seed: int = 0,
+        journal=None,
+        drain_on_preempt: bool = False,
     ):
         self.ex = executor
         self.params = params
@@ -975,10 +1092,19 @@ class Server:
             (float(temperature), int(top_k), int(sample_seed))
             if temperature > 0.0 else None
         )
+        #: Optional crash-recovery journal
+        #: (``serving/journal.py::RequestJournal``): completed requests
+        #: replay instead of re-running, in-flight requests resume with
+        #: carried tokens.  Arming a journal also arms drain.
+        self.journal = journal
+        self.drain_on_preempt = bool(drain_on_preempt) or \
+            journal is not None
 
     # -- loop ----------------------------------------------------------------
 
     def run(self, requests: Sequence[Request]):
+        from flexflow_tpu.runtime.resilience import PreemptionHandler
+
         tel = _telemetry.current()
         ex = self.ex
         B, k = ex.max_batch, self.decode_steps
@@ -1001,31 +1127,64 @@ class Server:
         prefills = 0
         decode_s = 0.0
         t_run0 = time.perf_counter()
+        # -- journal replay: completed requests are NOT re-run,
+        # in-flight requests resume with their fence-validated tokens
+        # carried (re-prefill over prompt ‖ carried at admission).
+        jr = self.journal
+        carried_map: Dict[int, List[int]] = {}
+        if jr is not None:
+            st = jr.replay()
+            for rid, rec in st.completed.items():
+                results[rid] = RequestResult(
+                    id=rid, prompt_len=int(rec.get("plen") or 0),
+                    tokens=list(rec.get("tokens", [])),
+                    error=rec.get("error"),
+                    latency_s=float(rec.get("latency_s") or 0.0),
+                )
+            carried_map = {int(rid): list(t)
+                           for rid, t in st.in_flight.items()}
+            queue = collections.deque(
+                r for r in queue if r.id not in results
+            )
+            if not st.empty:
+                _log.info(
+                    "journal replay (%s): %d completed restored, %d "
+                    "in flight resume with carried tokens%s",
+                    jr.path, len(st.completed), len(carried_map),
+                    " [torn tail tolerated]" if st.torn_tail else "",
+                )
+        drained = False
+        preempt = PreemptionHandler(install=self.drain_on_preempt)
 
         def finish(slot_i: int, error: Optional[str] = None):
             sl = slots[slot_i]
+            toks = sl.all_tokens
             lat = time.perf_counter() - sl.t_eligible
             results[sl.request.id] = RequestResult(
                 id=sl.request.id,
                 prompt_len=len(sl.request.prompt),
-                tokens=list(sl.tokens),
+                tokens=list(toks),
                 error=error,
                 latency_s=lat,
                 prefill_s=sl.prefill_s,
             )
             tel.emit("request_end", id=sl.request.id,
-                     tokens=len(sl.tokens), error=error,
+                     tokens=len(toks), error=error,
                      latency_s=round(lat, 6))
+            if jr is not None:
+                jr.done(sl.request.id, len(sl.request.prompt),
+                        len(toks), error, latency_s=round(lat, 6))
             if ledger is not None:
                 ledger.free(slot_i)
                 block_table[slot_i] = 0
             slots[slot_i] = None
 
         def slot_done(sl: _Slot) -> bool:
-            if self.eos_id is not None and sl.tokens and \
-                    sl.tokens[-1] == self.eos_id:
+            toks = sl.all_tokens
+            if self.eos_id is not None and toks and \
+                    toks[-1] == self.eos_id:
                 return True
-            if len(sl.tokens) >= sl.request.max_new_tokens:
+            if len(toks) >= sl.request.max_new_tokens:
                 return True
             return sl.pos >= ex.max_seq  # context limit
         def reject(r: Request, err: str):
@@ -1042,135 +1201,224 @@ class Server:
             )
             tel.emit("request_end", id=r.id, tokens=0,
                      error=err, latency_s=round(lat, 6))
+            if jr is not None:
+                jr.done(r.id, plen, 0, err, latency_s=round(lat, 6))
 
-        while queue or any(slots):
-            # -- admissions (between decode supersteps) --
-            while queue and None in slots:
-                r = queue[0]
-                plen = len(r.prompt)
-                try:
-                    bucket = ex.bucket_for(plen)
-                except ValueError as e:
-                    queue.popleft()
-                    reject(r, str(e))
-                    continue
-                if ledger is not None:
-                    need = ledger.blocks_for(plen, r.max_new_tokens)
-                    if need > ledger.capacity_blocks:
-                        queue.popleft()
-                        reject(r, (
-                            f"request needs {need} KV blocks but the "
-                            f"paged pool holds {ledger.capacity_blocks}"
-                        ))
-                        continue
-                    if not ledger.can_admit(need):
-                        # Head-of-line wait: blocks free up when an
-                        # active slot finishes (deterministic FIFO —
-                        # no reorder, no livelock: the whole pool
-                        # covers any single admissible request).
-                        break
-                queue.popleft()
-                slot_i = slots.index(None)
-                tel.emit("request_start", id=r.id, prompt_len=plen,
-                         bucket=bucket, slot=slot_i)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :plen] = np.asarray(r.prompt, np.int32)
-                t0 = time.perf_counter()
-                tel.program_cost(
-                    "prefill", ex.build_prefill(bucket),
-                    (self.params, self.op_state, padded, np.int32(plen)),
-                    bucket=bucket)
-                rows, tok0, okf = ex.build_prefill(bucket)(
-                    self.params, self.op_state, padded,
-                    np.int32(plen),
-                )
-                tok0, ok = tel.fence((tok0, okf), "prefill")
-                pf_s = time.perf_counter() - t0
-                prefills += 1
-                tel.emit("prefill", id=r.id, bucket=bucket,
-                         wall_s=round(pf_s, 6))
-                if not bool(ok):
-                    sl = _Slot(r, plen, 0, [], t_run0, pf_s)
-                    slots[slot_i] = sl
-                    finish(slot_i, error="non-finite logits in prefill")
-                    continue
-                if ledger is not None:
-                    row = ledger.alloc(slot_i, need)
-                    block_table[slot_i] = row
-                    caches = ex.install_paged(caches, rows, row)
-                else:
-                    caches = ex.install(caches, rows, slot_i)
-                sl = _Slot(
-                    request=r, pos=plen, last_tok=int(tok0),
-                    tokens=[int(tok0)], t_eligible=t_run0,
-                    prefill_s=pf_s,
-                )
-                total_tokens += 1
-                slots[slot_i] = sl
-                if slot_done(sl):
-                    finish(slot_i)
+        def resume_complete(r: Request, prior: List[int]) -> bool:
+            """A journaled in-flight sequence that is ALREADY finished
+            (the crash landed between the token write and the done
+            record): restore the result without re-prefilling."""
+            plen = len(r.prompt)
+            if len(prior) < r.max_new_tokens and \
+                    plen + len(prior) < ex.max_seq and \
+                    not (self.eos_id is not None and prior and
+                         prior[-1] == self.eos_id):
+                return False
+            tel.emit("request_start", id=r.id, prompt_len=plen,
+                     bucket=None, slot=None)
+            lat = time.perf_counter() - t_run0
+            results[r.id] = RequestResult(
+                id=r.id, prompt_len=plen, tokens=list(prior),
+                error=None, latency_s=lat,
+            )
+            tel.emit("request_end", id=r.id, tokens=len(prior),
+                     error=None, latency_s=round(lat, 6))
+            if jr is not None:
+                jr.done(r.id, plen, len(prior), None,
+                        latency_s=round(lat, 6))
+            return True
 
-            active = [i for i, sl in enumerate(slots) if sl is not None]
-            if not active:
-                break
-
-            # -- one fused decode superstep over the whole batch --
-            if self.injector is not None:
-                try:
-                    caches = self.injector.before_superstep(
-                        superstep_idx, caches, block_table
+        preempt.__enter__()
+        try:
+            while queue or any(slots):
+                if preempt.triggered and self.drain_on_preempt:
+                    # -- drain-on-SIGTERM: stop admissions; in-flight
+                    # work is already journaled at the last fence, so
+                    # exiting here loses nothing — a resume from the
+                    # journal serves the remainder byte-identically.
+                    drained = True
+                    n_flight = sum(1 for sl in slots if sl is not None)
+                    tel.emit("serving_drain", signum=preempt.signum,
+                             in_flight=n_flight, queued=len(queue))
+                    _log.warning(
+                        "drain: signal %s — %d in flight journaled, "
+                        "%d queued; resume from the journal to serve "
+                        "the remainder", preempt.signum, n_flight,
+                        len(queue),
                     )
-                except ServingFault as f:
-                    superstep_idx += 1
-                    if slots[f.slot] is not None:
-                        finish(f.slot, error=f"raised fault: {f}")
-                    continue
-            pos_vec = np.array(
-                [sl.pos if sl else 0 for sl in slots], np.int32
-            )
-            tok_vec = np.array(
-                [sl.last_tok if sl else 0 for sl in slots], np.int32
-            )
-            args = (self.params, self.op_state, caches)
-            if block_table is not None:
-                args += (block_table.copy(),)
-            args += (pos_vec, tok_vec)
-            if self.sample is not None:
-                args += (np.array(
-                    [sl.request.id if sl else 0 for sl in slots], np.int32
-                ),)
-            t_call = time.perf_counter()
-            tel.program_cost("decode_superstep", decode_fn, args, k=k)
-            caches, _pos, _tok, (toks, oks) = decode_fn(*args)
-            host_toks, host_oks = tel.fence((toks, oks), "decode_superstep")
-            wall = time.perf_counter() - t_call
-            decode_s += wall
-            supersteps += 1
-            superstep_idx += 1
-            # Training-superstep accounting: ONE host program and one
-            # fence covered k decode steps (programs/step == 1/k).
-            tel.add_programs(1, steps=k)
-            tel.emit("decode_superstep", k=k, active=len(active),
-                     wall_s=round(wall, 6))
-            for j in range(k):
-                tel.record_step((supersteps - 1) * k + j, wall_s=wall / k)
-            for i in active:
-                sl = slots[i]
-                err = None
-                for j in range(k):
-                    if not bool(host_oks[j, i]):
-                        err = "non-finite logits in decode"
-                        break
-                    sl.tokens.append(int(host_toks[j, i]))
-                    sl.pos += 1
+                    if jr is not None:
+                        jr.drain(n_flight, len(queue))
+                    break
+                # -- admissions (between decode supersteps) --
+                while queue and None in slots:
+                    r = queue[0]
+                    plen = len(r.prompt)
+                    prior = carried_map.get(r.id, [])
+                    flen = plen + len(prior)
+                    if prior and resume_complete(r, prior):
+                        queue.popleft()
+                        carried_map.pop(r.id, None)
+                        continue
+                    try:
+                        bucket = ex.bucket_for(flen)
+                    except ValueError as e:
+                        queue.popleft()
+                        carried_map.pop(r.id, None)
+                        reject(r, str(e))
+                        continue
+                    if ledger is not None:
+                        need = ledger.blocks_for(plen, r.max_new_tokens)
+                        if need > ledger.capacity_blocks:
+                            queue.popleft()
+                            reject(r, (
+                                f"request needs {need} KV blocks but "
+                                f"the paged pool holds "
+                                f"{ledger.capacity_blocks}"
+                            ))
+                            continue
+                        if not ledger.can_admit(need):
+                            # Head-of-line wait: blocks free up when an
+                            # active slot finishes (deterministic FIFO —
+                            # no reorder, no livelock: the whole pool
+                            # covers any single admissible request).
+                            break
+                    queue.popleft()
+                    carried_map.pop(r.id, None)
+                    slot_i = slots.index(None)
+                    tel.emit("request_start", id=r.id, prompt_len=plen,
+                             bucket=bucket, slot=slot_i)
+                    # Re-prefill over (prompt ‖ carried) — the
+                    # loss-free resume primitive, shared with the
+                    # scheduler's preemption path.
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, :plen] = np.asarray(r.prompt, np.int32)
+                    if prior:
+                        padded[0, plen:flen] = np.asarray(
+                            prior, np.int32
+                        )
+                    t0 = time.perf_counter()
+                    # Sampled runs prefill through the sampled
+                    # variant so a RESUMED position replays the
+                    # decode head's exact draw (greedy when
+                    # flen == plen, i.e. a fresh admission).
+                    pf = ex.build_prefill(bucket, sample=self.sample)
+                    pf_args = (self.params, self.op_state, padded,
+                               np.int32(flen))
+                    if self.sample is not None:
+                        pf_args += (np.int32(plen), np.int32(r.id))
+                    tel.program_cost("prefill", pf, pf_args,
+                                     bucket=bucket)
+                    rows, tok0, okf = pf(*pf_args)
+                    tok0, ok = tel.fence((tok0, okf), "prefill")
+                    pf_s = time.perf_counter() - t0
+                    prefills += 1
+                    tel.emit("prefill", id=r.id, bucket=bucket,
+                             wall_s=round(pf_s, 6))
+                    if jr is not None:
+                        jr.admit(r.id, plen,
+                                 int(tok0) if bool(ok) else None,
+                                 resumed=len(prior))
+                    if not bool(ok):
+                        sl = _Slot(r, flen, 0, [], t_run0, pf_s,
+                                   carried=list(prior))
+                        slots[slot_i] = sl
+                        finish(slot_i,
+                               error="non-finite logits in prefill")
+                        continue
+                    if ledger is not None:
+                        row = ledger.alloc(slot_i, need)
+                        block_table[slot_i] = row
+                        caches = ex.install_paged(caches, rows, row)
+                    else:
+                        caches = ex.install(caches, rows, slot_i)
+                    sl = _Slot(
+                        request=r, pos=flen, last_tok=int(tok0),
+                        tokens=[int(tok0)], t_eligible=t_run0,
+                        prefill_s=pf_s, carried=list(prior),
+                    )
                     total_tokens += 1
+                    slots[slot_i] = sl
                     if slot_done(sl):
-                        break
-                sl.last_tok = sl.tokens[-1] if sl.tokens else 0
-                if err is not None:
-                    finish(i, error=err)
-                elif slot_done(sl):
-                    finish(i)
+                        finish(slot_i)
+
+                active = [i for i, sl in enumerate(slots)
+                          if sl is not None]
+                if not active:
+                    break
+
+                # -- one fused decode superstep over the whole batch --
+                if self.injector is not None:
+                    try:
+                        caches, _nan = self.injector.before_superstep(
+                            superstep_idx, caches, block_table
+                        )
+                    except ServingFault as f:
+                        superstep_idx += 1
+                        if slots[f.slot] is not None:
+                            finish(f.slot, error=f"raised fault: {f}")
+                        continue
+                pos_vec = np.array(
+                    [sl.pos if sl else 0 for sl in slots], np.int32
+                )
+                tok_vec = np.array(
+                    [sl.last_tok if sl else 0 for sl in slots], np.int32
+                )
+                args = (self.params, self.op_state, caches)
+                if block_table is not None:
+                    args += (block_table.copy(),)
+                args += (pos_vec, tok_vec)
+                if self.sample is not None:
+                    args += (np.array(
+                        [sl.request.id if sl else 0 for sl in slots],
+                        np.int32
+                    ),)
+                t_call = time.perf_counter()
+                tel.program_cost("decode_superstep", decode_fn, args, k=k)
+                caches, _pos, _tok, (toks, oks) = decode_fn(*args)
+                host_toks, host_oks = tel.fence(
+                    (toks, oks), "decode_superstep"
+                )
+                wall = time.perf_counter() - t_call
+                decode_s += wall
+                supersteps += 1
+                superstep_idx += 1
+                # Training-superstep accounting: ONE host program and
+                # one fence covered k decode steps (programs/step ==
+                # 1/k).
+                tel.add_programs(1, steps=k)
+                tel.emit("decode_superstep", k=k, active=len(active),
+                         wall_s=round(wall, 6))
+                for j in range(k):
+                    tel.record_step((supersteps - 1) * k + j,
+                                    wall_s=wall / k)
+                for i in active:
+                    sl = slots[i]
+                    err = None
+                    appended: List[int] = []
+                    for j in range(k):
+                        if not bool(host_oks[j, i]):
+                            err = "non-finite logits in decode"
+                            break
+                        tok = int(host_toks[j, i])
+                        sl.tokens.append(tok)
+                        appended.append(tok)
+                        sl.pos += 1
+                        total_tokens += 1
+                        if slot_done(sl):
+                            break
+                    sl.last_tok = sl.tokens[-1] if sl.tokens else 0
+                    # Journal the fence-validated delta BEFORE any done
+                    # record so replay accumulation sees tokens first.
+                    if jr is not None and appended:
+                        jr.tokens(sl.request.id, appended)
+                    if err is not None:
+                        finish(i, error=err)
+                    elif slot_done(sl):
+                        finish(i)
+        finally:
+            preempt.__exit__(None, None, None)
+            if jr is not None:
+                jr.close()
 
         elapsed = time.perf_counter() - t_run0
         lats = sorted(
@@ -1205,6 +1453,8 @@ class Server:
         if ex.paged:
             stats["kv_block"] = ex.kv_block
             stats["kv_blocks"] = ex.kv_blocks
+        if self.drain_on_preempt:
+            stats["drained"] = drained
         return results, tel.fold_stats(stats)
 
 

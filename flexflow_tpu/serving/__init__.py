@@ -4,14 +4,19 @@ The layer ABOVE ``runtime/serving.py`` (which owns programs, caches
 and slots): trace-driven open-loop arrivals (``workload``), the
 latency-aware continuous batcher with priorities / preemption /
 shedding on a deterministic virtual clock (``scheduler``), the
-calibrated serving cost model (``latency_model``) and the
-``--serve-auto`` config search (``search``).
+calibrated serving cost model (``latency_model``), the
+``--serve-auto`` config search (``search``), and the failure model
+(SERVING.md "Failure model"): the crash-recovery request journal
+(``journal``) plus the retry / restart / drain / degraded-mode knobs
+(``ServingResilience``).
 """
 
+from flexflow_tpu.serving.journal import JournalState, RequestJournal
 from flexflow_tpu.serving.latency_model import ServingLatencyModel
 from flexflow_tpu.serving.scheduler import (
     ScheduledServer,
     SchedulerPolicy,
+    ServingResilience,
     SlotShape,
 )
 from flexflow_tpu.serving.search import (
@@ -27,9 +32,12 @@ from flexflow_tpu.serving.workload import (
 )
 
 __all__ = [
+    "JournalState",
+    "RequestJournal",
     "ServingLatencyModel",
     "ScheduledServer",
     "SchedulerPolicy",
+    "ServingResilience",
     "SlotShape",
     "ServingConfig",
     "ServingSearchResult",

@@ -1,0 +1,173 @@
+"""Append-only request journal: the serving crash-recovery substrate
+(SERVING.md "Failure model").
+
+One JSONL file per server.  Every record is written AT an existing
+fence boundary — admissions after the prefill fence, token deltas
+after the decode-superstep fence, completions when a request leaves
+the loop — so journaling adds ZERO fences (FFP004 accounting is
+unchanged at one fence per K tokens) and at most one superstep of
+generated tokens can be lost to a crash.  Lost tokens are harmless:
+the journal's replay re-enters the request with its fence-validated
+prefix carried, and the existing re-prefill path (re-prefill over
+``prompt ‖ carried``, the loss-free preemption primitive) regenerates
+the tail byte-identically — greedy because decode logits match the
+full-seq forward, sampled because draws are keyed (seed, request id,
+position).
+
+Record shapes (every line carries ``ev`` so :class:`~flexflow_tpu.obs
+.reader.RunLog` — THE tolerant JSONL parser — can load a journal with
+its torn-tail / mid-file-garbage handling; a crash mid-append never
+wedges recovery):
+
+- ``sv_admit``  {id, plen, tok, resumed} — prefill fenced; ``tok`` is
+  the first generated token (absent on a non-finite prefill),
+  ``resumed`` the carried-token count of a re-admission.
+- ``sv_tokens`` {id, toks} — the fence-validated tokens one slot
+  appended in one decode superstep.
+- ``sv_done``   {id, plen, n, error, ...metrics} — the request left
+  the loop (completed, errored, shed, expired or rejected); carries
+  the rounded virtual-clock split so a resumed run's stats cover the
+  whole workload.
+- ``sv_drain``  {in_flight, queued} — a drain-on-SIGTERM completed;
+  the journal is a full statement of remaining work.
+
+Replay folds the line stream into :class:`JournalState`: requests with
+an ``sv_done`` are COMPLETED (never re-run), requests admitted but not
+done are IN-FLIGHT (resume with carried tokens), everything else is
+simply still queued.  A resumed server appends to the same file, so a
+second crash replays the union.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+EV_ADMIT = "sv_admit"
+EV_TOKENS = "sv_tokens"
+EV_DONE = "sv_done"
+EV_DRAIN = "sv_drain"
+
+
+@dataclasses.dataclass
+class JournalState:
+    """What a journal says about a workload's progress."""
+
+    #: id -> the finished record: {"tokens", "plen", "error", and any
+    #: recorded metrics (qw/e2e/slo_ok/latency_s)}.
+    completed: Dict[int, Dict[str, Any]]
+    #: id -> fence-validated generated tokens of admitted-but-unfinished
+    #: requests (the carried prefix for the re-prefill resume).
+    in_flight: Dict[int, List[int]]
+    #: A drain marker closed the journal (the run exited cleanly with
+    #: work remaining — resume serves the rest).
+    drained: bool = False
+    #: The last line was torn mid-append (crash artifact, tolerated).
+    torn_tail: bool = False
+    #: Mid-file garbage lines dropped by the tolerant parser.
+    malformed: int = 0
+
+    @property
+    def empty(self) -> bool:
+        return not self.completed and not self.in_flight
+
+
+class RequestJournal:
+    """Append-only JSONL journal for one serving loop.
+
+    Writes are line-at-a-time and flushed immediately (the journal is
+    only ever appended to at fence boundaries, so flush cost is
+    amortized over a whole superstep); :meth:`replay` reads back
+    through ``RunLog``'s tolerant parser.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._f = None
+
+    # -- write side ---------------------------------------------------------
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._f.flush()
+
+    def admit(self, rid: int, prompt_len: int, tok0: Optional[int],
+              resumed: int = 0) -> None:
+        rec: Dict[str, Any] = {"ev": EV_ADMIT, "id": int(rid),
+                               "plen": int(prompt_len),
+                               "resumed": int(resumed)}
+        if tok0 is not None:
+            rec["tok"] = int(tok0)
+        self._write(rec)
+
+    def tokens(self, rid: int, toks: List[int]) -> None:
+        if not toks:
+            return
+        self._write({"ev": EV_TOKENS, "id": int(rid),
+                     "toks": [int(t) for t in toks]})
+
+    def done(self, rid: int, prompt_len: int, n_tokens: int,
+             error: Optional[str] = None, **metrics: Any) -> None:
+        rec: Dict[str, Any] = {"ev": EV_DONE, "id": int(rid),
+                               "plen": int(prompt_len),
+                               "n": int(n_tokens), "error": error}
+        rec.update({k: v for k, v in metrics.items() if v is not None})
+        self._write(rec)
+
+    def drain(self, in_flight: int, queued: int) -> None:
+        self._write({"ev": EV_DRAIN, "in_flight": int(in_flight),
+                     "queued": int(queued)})
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    # -- read side ----------------------------------------------------------
+
+    def replay(self) -> JournalState:
+        """Fold the journal into a :class:`JournalState`.  A missing
+        file is an empty (fresh) journal; a torn tail or mid-file
+        garbage is tolerated exactly like a telemetry log
+        (``obs/reader.py::RunLog.load``)."""
+        state = JournalState(completed={}, in_flight={})
+        if not os.path.exists(self.path):
+            return state
+        from flexflow_tpu.obs.reader import RunLog
+
+        log = RunLog.load(self.path)
+        state.torn_tail = bool(log.torn_tail)
+        state.malformed = int(log.malformed)
+        acc: Dict[int, List[int]] = {}
+        for e in log.events:
+            if e.ev == EV_ADMIT:
+                rid = int(e["id"])
+                toks = acc.setdefault(rid, [])
+                if e.get("tok") is not None:
+                    toks.append(int(e["tok"]))
+            elif e.ev == EV_TOKENS:
+                acc.setdefault(int(e["id"]), []).extend(
+                    int(t) for t in e.get("toks", ())
+                )
+            elif e.ev == EV_DONE:
+                rid = int(e["id"])
+                rec = {k: v for k, v in e.data.items()
+                       if k not in ("ev", "id", "n", "ts", "seq")}
+                rec["tokens"] = acc.pop(rid, [])
+                rec.setdefault("error", None)
+                rec.setdefault("plen", 0)
+                state.completed[rid] = rec
+            elif e.ev == EV_DRAIN:
+                state.drained = True
+        state.in_flight = {
+            rid: toks for rid, toks in acc.items()
+            if rid not in state.completed
+        }
+        return state

@@ -59,7 +59,10 @@ from flexflow_tpu.runtime import telemetry as _telemetry
 from flexflow_tpu.runtime.serving import (
     Request,
     RequestResult,
+    ServingCrashLoop,
+    ServingEngineFault,
     ServingExecutor,
+    ServingFault,
 )
 from flexflow_tpu.serving.latency_model import ServingLatencyModel
 
@@ -103,6 +106,54 @@ class SchedulerPolicy:
         if self.shed_depth:
             bits.append(f"shed>{self.shed_depth}")
         return ", ".join(bits) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingResilience:
+    """The serving failure model's knobs (SERVING.md "Failure model").
+
+    Passing one ARMS the failure model: slot-isolated faults retry
+    with virtual-clock exponential backoff instead of erroring the
+    request, engine-class faults restart the engine (rebuild
+    programs/caches/ledger, requeue in-flight work with carried
+    tokens) against a crash-loop budget, waiting requests past their
+    deadline expire as SLO misses, and SIGTERM drains at the next
+    fence.  ``resilience=None`` (the default) keeps the legacy
+    behavior byte-for-byte: slot faults error out, engine faults
+    propagate.
+    """
+
+    #: Per-request retry budget for slot-isolated faults (raised
+    #: ServingFault, non-finite fence).  0 = fail fast (legacy).
+    max_retries: int = 0
+    #: Base of the exponential backoff (virtual-clock ms): attempt
+    #: ``a`` waits ``retry_backoff_ms * 2**a`` before re-queueing —
+    #: deterministic in simulate mode, like every other decision.
+    retry_backoff_ms: float = 8.0
+    #: Engine-restart budget; exceeding it raises
+    #: :class:`~flexflow_tpu.runtime.serving.ServingCrashLoop`
+    #: (``apps/serve.py`` → ``EXIT_SERVING_FAILURE``).
+    max_restarts: int = 0
+    #: Deadline-based expiry of WAITING requests: a finite-SLO request
+    #: still queued past ``deadline_ms`` is refused and counted as an
+    #: SLO miss (attainment stays goodput — expiry can't game the bar).
+    expire_waiting: bool = False
+    #: Degraded-mode ladder rung 1: after this many decode-phase
+    #: engine faults the decode kernel falls back to the
+    #: ``_einsum_decode`` oracle (loud + telemetered).  0 = never.
+    kernel_fault_rung: int = 2
+    #: Drain on SIGTERM/SIGINT (``PreemptionHandler``-wired): stop
+    #: admissions, journal in-flight work at the next fence, return
+    #: cleanly with ``stats["drained"]``.
+    drain_on_preempt: bool = True
+
+    def __post_init__(self):
+        if self.max_retries < 0 or self.max_restarts < 0:
+            raise ValueError("retry/restart budgets must be >= 0")
+        if self.retry_backoff_ms <= 0:
+            raise ValueError("retry_backoff_ms must be > 0")
+        if self.kernel_fault_rung < 0:
+            raise ValueError("kernel_fault_rung must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,24 +240,27 @@ class _RealEngine:
         self.caches = ex.init_cache()
 
     def prefill(self, prompt: np.ndarray, bucket: int, slot_i: int,
-                row: Optional[np.ndarray] = None):
+                row: Optional[np.ndarray] = None,
+                plen: Optional[int] = None, rid: int = 0):
         """Pad-to-bucket prefill + cache install into ``slot_i``
         (padded rows, or the ledger-assigned block ``row`` on the
         paged layout): ``(first_token, finite, wall_s)`` after one
-        fence."""
+        fence.  ``prompt`` is the full (prompt ‖ carried) sequence;
+        ``plen``/``rid`` key the sampled variant so a RESUMED
+        position replays the decode head's draw."""
         tel = _telemetry.current()
         ex = self.ex
-        plen = len(prompt)
+        flen = len(prompt)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = np.asarray(prompt, np.int32)
+        padded[0, :flen] = np.asarray(prompt, np.int32)
         t0 = time.perf_counter()
-        tel.program_cost(
-            "prefill", ex.build_prefill(bucket),
-            (self.params, self.op_state, padded, np.int32(plen)),
-            bucket=bucket)
-        rows, tok0, okf = ex.build_prefill(bucket)(
-            self.params, self.op_state, padded, np.int32(plen)
-        )
+        pf = ex.build_prefill(bucket, sample=self.sample)
+        pf_args = (self.params, self.op_state, padded, np.int32(flen))
+        if self.sample is not None:
+            pf_args += (np.int32(flen if plen is None else plen),
+                        np.int32(rid))
+        tel.program_cost("prefill", pf, pf_args, bucket=bucket)
+        rows, tok0, okf = pf(*pf_args)
         tok0, ok = tel.fence((tok0, okf), "prefill")
         wall = time.perf_counter() - t0
         if bool(ok):
@@ -247,7 +301,8 @@ class _SimEngine:
     def __init__(self, shape: SlotShape):
         self.shape = shape
 
-    def prefill(self, prompt, bucket, slot_i, row=None):
+    def prefill(self, prompt, bucket, slot_i, row=None, plen=None,
+                rid=0):
         return 1, True, 0.0
 
     def decode(self, pos_vec, tok_vec, k, block_table=None,
@@ -297,6 +352,9 @@ class ScheduledServer:
         temperature: float = 0.0,
         top_k: int = 0,
         sample_seed: int = 0,
+        resilience: Optional[ServingResilience] = None,
+        journal=None,
+        fault_injector=None,
         _engine=None,
     ):
         from flexflow_tpu.runtime.trainer import relay_safe_steps
@@ -313,11 +371,23 @@ class ScheduledServer:
         # batch composition replay the same sequence).
         self.sample = (temperature, top_k, sample_seed) \
             if temperature > 0.0 else None
-        self.engine = _engine or _RealEngine(executor, params, op_state,
-                                             sample=self.sample)
+        #: Failure model (None = legacy fail-fast; SERVING.md
+        #: "Failure model") + crash-recovery journal
+        #: (``serving/journal.py``) + scheduled chaos
+        #: (``ServingFaultInjector`` — drives the real AND the
+        #: simulate loop from the same superstep-indexed plan).
+        self.resilience = resilience
+        self.journal = journal
+        self.injector = fault_injector
+        #: Degraded-mode ladder state (rungs taken, in order).
+        self.degraded_rungs: List[Dict[str, Any]] = []
+        self._decode_faults = 0
+        self._degraded_oracle = False
         #: The replayable decision trace: one dict per admit / evict /
         #: shed / reject / decode / advance decision, vclock-stamped.
         self.decisions: List[Dict[str, Any]] = []
+        self._params, self._op_state = params, op_state
+        self.engine = _engine or self._build_engine(initial=True)
         # Bounded k candidate set (compile cache stays small).
         ks = set(ADAPTIVE_K_CANDIDATES) | {self.decode_steps}
         self._k_candidates = tuple(sorted(
@@ -331,13 +401,69 @@ class ScheduledServer:
         decode_steps: int = 8,
         policy: Optional[SchedulerPolicy] = None,
         latency_model: Optional[ServingLatencyModel] = None,
+        resilience: Optional[ServingResilience] = None,
+        journal=None,
+        fault_injector=None,
     ) -> "ScheduledServer":
         """The compute-free pricing loop (no jax touched): identical
         decisions and dispatch counts to a real run of the same
-        (workload, config, policy) with EOS off."""
+        (workload, config, policy) with EOS off — INCLUDING through
+        retries and engine restarts when the same ``fault_injector``
+        plan drives both (the ``--serve-auto`` exactness contract)."""
         return cls(shape, None, None, decode_steps=decode_steps,
                    eos_id=None, policy=policy, latency_model=latency_model,
+                   resilience=resilience, journal=journal,
+                   fault_injector=fault_injector,
                    _engine=_SimEngine(shape))
+
+    # -- engine (re)build + the degraded-mode ladder ------------------------
+
+    def _build_engine(self, initial: bool = False):
+        """(Re)build the device engine.  On a RESTART (``initial``
+        False) the compiled-program caches are dropped first — the
+        rebuild starts from nothing, like a fresh process.  Either way
+        the ``DeviceMemoryError`` degraded rung applies: when the KV
+        cache misses the device budget, shrink capacity stepwise
+        (padded: halve ``max_batch``; paged: halve the block pool) —
+        loudly, telemetered — and refuse only at the floor."""
+        from flexflow_tpu.data.loader import DeviceMemoryError
+
+        if getattr(getattr(self, "engine", None), "simulated", False):
+            return _SimEngine(self.ex)
+        ex = self.ex
+        if not initial:
+            ex._prefill_fns.clear()
+            ex._decode_fns.clear()
+        while True:
+            try:
+                return _RealEngine(ex, self._params, self._op_state,
+                                   sample=self.sample)
+            except DeviceMemoryError:
+                if ex.paged:
+                    nb = ex.kv_blocks // 2
+                    if nb < max(ex.blocks_per_slot + 1, 2):
+                        raise  # floor: pool can't hold one worst slot
+                    rung = {"rung": "shrink_pool", "kv_blocks": nb,
+                            "prev": ex.kv_blocks}
+                    ex.kv_blocks = nb
+                else:
+                    nb = ex.max_batch // 2
+                    if ex.shard is not None:
+                        n = ex.shard[0]
+                        nb = max(nb - nb % n, n)
+                    if nb < 1 or nb == ex.max_batch:
+                        raise  # floor: one slot still over budget
+                    rung = {"rung": "shrink_batch", "max_batch": nb,
+                            "prev": ex.max_batch}
+                    ex.max_batch = nb
+                self.degraded_rungs.append(rung)
+                _log.warning(
+                    "degraded mode (%s): KV cache over the device "
+                    "budget, stepping down %s -> %s before refusing",
+                    rung["rung"], rung["prev"],
+                    rung.get("max_batch", rung.get("kv_blocks")),
+                )
+                _telemetry.current().emit("degraded_mode", **rung)
 
     # -- policy orderings ---------------------------------------------------
 
@@ -373,6 +499,8 @@ class ScheduledServer:
     # -- the loop -----------------------------------------------------------
 
     def run(self, requests: Sequence[Request]):
+        from flexflow_tpu.runtime.resilience import PreemptionHandler
+
         tel = _telemetry.current()
         ex, pol, model = self.ex, self.policy, self.model
         B = ex.max_batch
@@ -392,7 +520,7 @@ class ScheduledServer:
         results: Dict[int, RequestResult] = {}
         #: id -> (first-admission vclock, generated tokens carried
         #: across preemptions, preempt count) for re-queued requests.
-        carried: Dict[int, Tuple[float, List[int], int]] = {}
+        carried: Dict[int, Tuple[Optional[float], List[int], int]] = {}
         qwaits: Dict[int, float] = {}   # id -> queue wait (vclock ms)
         e2es: Dict[int, float] = {}
         slo_oks: Dict[int, bool] = {}
@@ -400,6 +528,48 @@ class ScheduledServer:
         total_tokens = 0
         decode_s = 0.0
         t_wall0 = time.perf_counter()
+        # -- the failure model (SERVING.md "Failure model") --
+        res = self.resilience
+        jr = self.journal
+        max_retries = res.max_retries if res is not None else 0
+        retry_backoff = res.retry_backoff_ms if res is not None else 8.0
+        drain_armed = res is not None and res.drain_on_preempt
+        retries = expiries = restarts = 0
+        drained = False
+        superstep_idx = 0
+        attempts: Dict[int, int] = {}       # id -> retry attempts
+        #: (eligible-at vclock ms, id, request) — kept sorted; drained
+        #: back into ``waiting`` by scan_retries.
+        retrying: List[Tuple[float, int, Request]] = []
+        # -- journal replay: completed requests are NOT re-run,
+        # in-flight requests re-enter the queue with carried tokens
+        # and resume via the existing re-prefill path.
+        if jr is not None:
+            st = jr.replay()
+            for rid, rec in st.completed.items():
+                results[rid] = RequestResult(
+                    id=rid, prompt_len=int(rec.get("plen") or 0),
+                    tokens=list(rec.get("tokens", [])),
+                    error=rec.get("error"),
+                    latency_s=float(rec.get("latency_s") or 0.0),
+                )
+                if rec.get("qw") is not None:
+                    qwaits[rid] = float(rec["qw"])
+                if rec.get("e2e") is not None:
+                    e2es[rid] = float(rec["e2e"])
+                if rec.get("slo_ok") is not None:
+                    slo_oks[rid] = bool(rec["slo_ok"])
+            for rid, toks in st.in_flight.items():
+                carried[int(rid)] = (None, list(toks), 0)
+            pending = [r for r in pending if r.id not in results]
+            if st.completed or st.in_flight:
+                _log.info(
+                    "journal replay (%s): %d completed restored, %d "
+                    "in flight resume with carried tokens%s",
+                    jr.path, len(st.completed), len(st.in_flight),
+                    " [torn tail tolerated]" if st.torn_tail else "",
+                )
+        preempt = PreemptionHandler(install=drain_armed)
 
         def log(d: str, **fields):
             rec = {"d": d, "v": round(vclock, 3)}
@@ -427,6 +597,10 @@ class ScheduledServer:
             tel.emit("request_end", id=r.id, tokens=len(toks), error=err,
                      latency_s=round(results[r.id].latency_s, 6),
                      queue_wait_ms=qw, e2e_ms=e2e, **fields)
+            if jr is not None:
+                jr.done(r.id, len(r.prompt), len(toks), err,
+                        qw=qw, e2e=e2e, slo_ok=fields.get("slo_ok"),
+                        latency_s=round(results[r.id].latency_s, 6))
 
         def finish_slot(slot_i: int, err: Optional[str] = None):
             sl = slots[slot_i]
@@ -531,16 +705,48 @@ class ScheduledServer:
                 block_table[slot_i] = 0
             return slot_i
 
+        def resume_done(r: Request, prior: List[int],
+                        admit_v0: Optional[float]) -> bool:
+            """A journal-resumed request whose carried sequence is
+            already terminal (the crash fell between the last token
+            delta and its ``sv_done`` record): finish without
+            re-occupying a slot — re-prefilling would over-generate
+            past ``max_new_tokens``."""
+            terminal = (
+                len(prior) >= r.max_new_tokens
+                or len(r.prompt) + len(prior) >= ex.max_seq
+                or (self.eos_id is not None and prior
+                    and prior[-1] == self.eos_id)
+            )
+            if not terminal:
+                return False
+            tel.emit("request_start", id=r.id, prompt_len=len(r.prompt),
+                     bucket=None, slot=None)
+            log("resume_done", id=r.id, tokens=len(prior))
+            finish_result(r, prior, None, admit_v0, t_wall0)
+            return True
+
         def admit(r: Request, slot_i: int):
             nonlocal vclock, prefills, total_tokens
             waiting.remove(r)
             admit_v0, prior, n_pre = carried.pop(r.id, (vclock, [], 0))
+            if prior and resume_done(r, prior, admit_v0):
+                return
             # Re-prefill over (prompt ‖ carried) — loss-free resume.
             full = np.concatenate([
                 np.asarray(r.prompt, np.int32),
                 np.asarray(prior, np.int32),
             ]) if prior else np.asarray(r.prompt, np.int32)
-            bucket = ex.bucket_for(len(full))
+            try:
+                bucket = ex.bucket_for(len(full))
+            except ValueError as e:
+                # Journal-resumed sequence outgrew the largest bucket.
+                tel.emit("request_start", id=r.id,
+                         prompt_len=len(r.prompt), bucket=None,
+                         slot=None)
+                log("reject", id=r.id, reason="resume_bucket")
+                finish_result(r, prior, str(e), admit_v0, t_wall0)
+                return
             others = [w for w in waiting if w is not r]
             tel.emit("request_start", id=r.id, prompt_len=len(r.prompt),
                      bucket=bucket, slot=slot_i)
@@ -555,11 +761,28 @@ class ScheduledServer:
                 row = ledger.alloc(slot_i, ledger.blocks_for(
                     len(r.prompt), r.max_new_tokens))
                 block_table[slot_i] = row
-            tok0, ok, pf_s = self.engine.prefill(full, bucket, slot_i,
-                                                 row=row)
+            try:
+                tok0, ok, pf_s = self.engine.prefill(
+                    full, bucket, slot_i, row=row,
+                    plen=len(r.prompt), rid=r.id,
+                )
+            except (RuntimeError, OSError) as e:
+                if res is None or isinstance(e, ServingFault):
+                    raise
+                # Engine-class fault mid-prefill: roll the admission
+                # back so the restart path re-queues it cleanly.
+                if ledger is not None:
+                    ledger.free(slot_i)
+                    block_table[slot_i] = 0
+                carried[r.id] = (admit_v0, prior, n_pre)
+                waiting.append(r)
+                raise ServingEngineFault(str(e)) from e
             prefills += 1
             tel.emit("prefill", id=r.id, bucket=bucket,
                      wall_s=round(pf_s, 6))
+            if jr is not None:
+                jr.admit(r.id, len(r.prompt),
+                         int(tok0) if ok else None, resumed=len(prior))
             sl = _SchedSlot(
                 request=r, pos=len(full), last_tok=tok0,
                 tokens=[] if not ok else [tok0], carried=list(prior),
@@ -574,109 +797,316 @@ class ScheduledServer:
             if slot_done(sl):
                 finish_slot(slot_i)
 
-        while pending or waiting or any(sl is not None for sl in slots):
-            scan_arrivals()
-            if not waiting and not any(sl is not None for sl in slots):
-                # Idle gap: jump the virtual clock to the next arrival.
-                vclock = max(vclock, pending[0].arrival_ms)
-                log("advance")
-                continue
+        def scan_retries():
+            while retrying and retrying[0][0] <= vclock + 1e-9:
+                _t, _rid, r = retrying.pop(0)
+                waiting.append(r)
 
-            # -- admissions (vclock moves per prefill; re-scan) --
-            while waiting:
-                scan_arrivals()
-                waiting.sort(key=self._admit_key)
-                cand = waiting[0]
-                slot_i = next(
-                    (i for i, sl in enumerate(slots) if sl is None), None
-                )
-                if slot_i is None:
-                    slot_i = try_preempt(cand)
-                if slot_i is None:
-                    break
-                if ledger is not None and not ledger.can_admit(
-                        ledger.blocks_for(len(cand.prompt),
-                                          cand.max_new_tokens)):
-                    # Free slot but not enough free KV blocks:
-                    # head-of-line wait for block turnover (an active
-                    # slot finishing frees its reservation; the pool
-                    # covers any single admissible request, so no
-                    # livelock).
-                    log("kv_wait", id=cand.id,
-                        free_blocks=ledger.free_blocks)
-                    break
-                admit(cand, slot_i)
+        def expire_waiting():
+            nonlocal expiries
+            if res is None or not res.expire_waiting:
+                return
+            for r in [w for w in waiting
+                      if math.isfinite(w.deadline_ms)
+                      and w.deadline_ms < vclock - 1e-9]:
+                waiting.remove(r)
+                expiries += 1
+                _v, prior, _n = carried.pop(r.id, (None, [], 0))
+                tel.emit("request_expire", id=r.id,
+                         deadline_ms=round(r.deadline_ms, 3),
+                         vclock_ms=round(vclock, 3))
+                log("expire", id=r.id)
+                tel.emit("request_start", id=r.id,
+                         prompt_len=len(r.prompt), bucket=None,
+                         slot=None)
+                finish_result(r, prior, (
+                    f"expired: deadline {r.deadline_ms:.0f}ms passed "
+                    f"at vclock {vclock:.0f}ms"
+                ), None, t_wall0)
 
-            # -- shed the overload past the queue-depth bound --
-            if pol.shed_depth:
-                while len(waiting) > pol.shed_depth:
-                    victim = max(waiting, key=self._shed_key)
-                    waiting.remove(victim)
-                    sheds += 1
-                    tel.emit("request_shed", id=victim.id,
-                             tier=victim.priority,
-                             queue_depth=len(waiting) + 1,
-                             vclock_ms=round(vclock, 3))
-                    log("shed", id=victim.id, tier=victim.priority)
-                    finish_result(
-                        victim, [],
-                        f"shed: queue depth > {pol.shed_depth}",
-                        None, t_wall0,
-                    )
-
-            active = [i for i, sl in enumerate(slots) if sl is not None]
-            if not active:
-                continue
-
-            # -- one fused decode superstep over the whole batch --
-            k = self._choose_k(slots, len(waiting))
-            tel.emit("sched_decision", k=k, active=len(active),
-                     waiting=len(waiting), policy=pol.name,
+        def slot_fault(slot_i: int, err: str):
+            """Slot-class fault: spend a retry (deterministic
+            exponential backoff on the virtual clock) or error out."""
+            nonlocal retries
+            sl = slots[slot_i]
+            r = sl.request
+            a = attempts.get(r.id, 0)
+            if a >= max_retries:
+                finish_slot(slot_i, err)
+                return
+            attempts[r.id] = a + 1
+            backoff = retry_backoff * (2 ** a)
+            retries += 1
+            carried[r.id] = (sl.admit_v, sl.all_tokens, sl.preempts)
+            retrying.append((round(vclock + backoff, 3), r.id, r))
+            retrying.sort(key=lambda t: (t[0], t[1]))
+            tel.emit("request_retry", id=r.id, attempt=a + 1,
+                     backoff_ms=round(backoff, 3), error=err,
                      vclock_ms=round(vclock, 3))
-            log("decode", k=k, active=len(active), waiting=len(waiting))
-            pos_vec = np.array(
-                [sl.pos if sl else 0 for sl in slots], np.int32
-            )
-            tok_vec = np.array(
-                [sl.last_tok if sl else 0 for sl in slots], np.int32
-            )
-            req_vec = np.array(
-                [sl.request.id if sl else 0 for sl in slots], np.int32
-            )
-            vclock += model.decode_ms(k)
-            toks, oks, wall = self.engine.decode(
-                pos_vec, tok_vec, k,
-                block_table=(block_table.copy()
-                             if ledger is not None else None),
-                req_ids=req_vec,
-            )
-            decode_s += wall
-            supersteps += 1
-            # Training-superstep accounting: one host program + one
-            # fence covered k decode steps (programs/step == 1/k).
-            tel.add_programs(1, steps=k)
-            tel.emit("decode_superstep", k=k, active=len(active),
-                     wall_s=round(wall, 6))
-            for j in range(k):
-                tel.record_step((supersteps - 1) * k + j,
-                                wall_s=wall / k)
-            for i in active:
-                sl = slots[i]
-                err = None
+            log("retry", id=r.id, attempt=a + 1,
+                backoff=round(backoff, 3))
+            slots[slot_i] = None
+            if ledger is not None:
+                ledger.free(slot_i)
+                block_table[slot_i] = 0
+
+        def engine_restart(why: str, phase: str):
+            """Engine-class fault: requeue every active slot with its
+            carried tokens, rebuild programs/caches/ledger from
+            scratch, and bound restarts with the crash-loop budget."""
+            nonlocal restarts, ledger, block_table, slots, B
+            restarts += 1
+            budget = res.max_restarts if res is not None else 0
+            tel.emit("engine_restart", restart=restarts, phase=phase,
+                     error=str(why)[:200], vclock_ms=round(vclock, 3))
+            log("engine_restart", n=restarts, phase=phase)
+            _log.warning("serving engine fault (%s): %s — restart "
+                         "%d/%d", phase, why, restarts, budget)
+            if res is None or restarts > budget:
+                raise ServingCrashLoop(
+                    f"serving engine restart budget ({budget}) "
+                    f"exhausted: {why}"
+                )
+            # Degraded-mode rung: repeated decode-phase kernel failure
+            # -> fall back loudly to the _einsum_decode oracle.
+            if phase == "decode" and res.kernel_fault_rung > 0:
+                self._decode_faults += 1
+                if self._decode_faults >= res.kernel_fault_rung and \
+                        not self._degraded_oracle:
+                    self._degraded_oracle = True
+                    rung = {"rung": "decode_oracle",
+                            "after_faults": self._decode_faults}
+                    self.degraded_rungs.append(rung)
+                    if not getattr(self.engine, "simulated", False):
+                        self.ex.decode_kernel = False
+                    _log.warning(
+                        "degraded mode (decode_oracle): %d decode-"
+                        "phase engine faults — flash_decode disabled, "
+                        "serving from the _einsum_decode oracle",
+                        self._decode_faults)
+                    tel.emit("degraded_mode", **rung)
+                    log("degraded", rung="decode_oracle")
+            for i, sl in enumerate(slots):
+                if sl is None:
+                    continue
+                carried[sl.request.id] = (sl.admit_v, sl.all_tokens,
+                                          sl.preempts)
+                waiting.append(sl.request)
+                slots[i] = None
+            self.engine = self._build_engine()
+            B = self.ex.max_batch
+            slots = [None] * B
+            if ledger is not None:
+                ledger = self.ex.make_ledger()
+                block_table = np.zeros(
+                    (B, ledger.blocks_per_slot), np.int32
+                )
+
+        preempt.__enter__()
+        try:
+            while pending or waiting or retrying or \
+                    any(sl is not None for sl in slots):
+                scan_arrivals()
+                scan_retries()
+                if preempt.triggered and drain_armed and not drained:
+                    # -- drain-on-SIGTERM: stop admissions, journal
+                    # in-flight work (already journaled at every
+                    # fence), exit cleanly for the supervisor.
+                    drained = True
+                    n_flight = sum(1 for sl in slots if sl is not None)
+                    n_q = len(waiting) + len(pending) + len(retrying)
+                    tel.emit("serving_drain", signum=preempt.signum,
+                             in_flight=n_flight, queued=n_q,
+                             vclock_ms=round(vclock, 3))
+                    log("drain", in_flight=n_flight, queued=n_q)
+                    _log.warning(
+                        "drain: signal %s — %d in flight, %d queued; "
+                        "journal %s carries the remainder",
+                        preempt.signum, n_flight, n_q,
+                        jr.path if jr is not None else "(none)")
+                    if jr is not None:
+                        jr.drain(n_flight, n_q)
+                    break
+                expire_waiting()
+                if not waiting and \
+                        not any(sl is not None for sl in slots):
+                    # Idle gap: jump the virtual clock to the next
+                    # arrival or retry-eligibility instant.
+                    targets = []
+                    if pending:
+                        targets.append(pending[0].arrival_ms)
+                    if retrying:
+                        targets.append(retrying[0][0])
+                    vclock = max(vclock, min(targets))
+                    log("advance")
+                    continue
+
+                # -- admissions (vclock moves per prefill; re-scan) --
+                engine_down = False
+                while waiting:
+                    scan_arrivals()
+                    scan_retries()
+                    expire_waiting()
+                    if not waiting:
+                        break
+                    waiting.sort(key=self._admit_key)
+                    cand = waiting[0]
+                    slot_i = next(
+                        (i for i, sl in enumerate(slots)
+                         if sl is None), None
+                    )
+                    if slot_i is None:
+                        slot_i = try_preempt(cand)
+                    if slot_i is None:
+                        break
+                    if ledger is not None and not ledger.can_admit(
+                            ledger.blocks_for(len(cand.prompt),
+                                              cand.max_new_tokens)):
+                        # Free slot but not enough free KV blocks:
+                        # head-of-line wait for block turnover (an
+                        # active slot finishing frees its reservation;
+                        # the pool covers any single admissible
+                        # request, so no livelock).
+                        log("kv_wait", id=cand.id,
+                            free_blocks=ledger.free_blocks)
+                        break
+                    try:
+                        admit(cand, slot_i)
+                    except ServingEngineFault as e:
+                        engine_restart(str(e), "prefill")
+                        engine_down = True
+                        break
+                if engine_down:
+                    continue
+
+                # -- shed the overload past the queue-depth bound --
+                if pol.shed_depth:
+                    while len(waiting) > pol.shed_depth:
+                        victim = max(waiting, key=self._shed_key)
+                        waiting.remove(victim)
+                        sheds += 1
+                        tel.emit("request_shed", id=victim.id,
+                                 tier=victim.priority,
+                                 queue_depth=len(waiting) + 1,
+                                 vclock_ms=round(vclock, 3))
+                        log("shed", id=victim.id, tier=victim.priority)
+                        finish_result(
+                            victim, [],
+                            f"shed: queue depth > {pol.shed_depth}",
+                            None, t_wall0,
+                        )
+
+                active = [i for i, sl in enumerate(slots)
+                          if sl is not None]
+                if not active:
+                    continue
+
+                # -- injected faults, at the same before-superstep
+                # site as the legacy Server (superstep_idx counts
+                # raised supersteps too, matching its semantics) --
+                if self.injector is not None:
+                    try:
+                        caches = getattr(self.engine, "caches", None)
+                        new_caches, sim_nan = \
+                            self.injector.before_superstep(
+                                superstep_idx, caches,
+                                block_table if ledger is not None
+                                else None,
+                            )
+                        if new_caches is not None:
+                            self.engine.caches = new_caches
+                    except ServingFault as f:
+                        superstep_idx += 1
+                        if slots[f.slot] is not None:
+                            slot_fault(f.slot, f"raised fault: {f}")
+                        continue
+                    except ServingEngineFault as e:
+                        superstep_idx += 1
+                        engine_restart(str(e), "decode")
+                        continue
+                else:
+                    sim_nan = None
+
+                # -- one fused decode superstep over the whole batch --
+                k = self._choose_k(slots, len(waiting))
+                tel.emit("sched_decision", k=k, active=len(active),
+                         waiting=len(waiting), policy=pol.name,
+                         vclock_ms=round(vclock, 3))
+                log("decode", k=k, active=len(active),
+                    waiting=len(waiting))
+                pos_vec = np.array(
+                    [sl.pos if sl else 0 for sl in slots], np.int32
+                )
+                tok_vec = np.array(
+                    [sl.last_tok if sl else 0 for sl in slots], np.int32
+                )
+                req_vec = np.array(
+                    [sl.request.id if sl else 0 for sl in slots],
+                    np.int32
+                )
+                vclock += model.decode_ms(k)
+                try:
+                    toks, oks, wall = self.engine.decode(
+                        pos_vec, tok_vec, k,
+                        block_table=(block_table.copy()
+                                     if ledger is not None else None),
+                        req_ids=req_vec,
+                    )
+                except (RuntimeError, OSError) as e:
+                    if res is None:
+                        raise
+                    superstep_idx += 1
+                    engine_restart(str(e), "decode")
+                    continue
+                if sim_nan is not None and \
+                        getattr(self.engine, "simulated", False):
+                    # The simulated engine has no caches to poison:
+                    # mirror the NaN'd slot as non-finite decodes so
+                    # sim decisions match the real engine's exactly.
+                    oks = np.array(oks, copy=True)
+                    oks[:, sim_nan] = False
+                decode_s += wall
+                supersteps += 1
+                superstep_idx += 1
+                # Training-superstep accounting: one host program +
+                # one fence covered k decode steps
+                # (programs/step == 1/k).
+                tel.add_programs(1, steps=k)
+                tel.emit("decode_superstep", k=k, active=len(active),
+                         wall_s=round(wall, 6))
                 for j in range(k):
-                    if not bool(oks[j, i]):
-                        err = "non-finite logits in decode"
-                        break
-                    sl.tokens.append(int(toks[j, i]))
-                    sl.pos += 1
-                    total_tokens += 1
-                    if slot_done(sl):
-                        break
-                sl.last_tok = sl.tokens[-1] if sl.tokens else 0
-                if err is not None:
-                    finish_slot(i, err)
-                elif slot_done(sl):
-                    finish_slot(i)
+                    tel.record_step((supersteps - 1) * k + j,
+                                    wall_s=wall / k)
+                for i in active:
+                    sl = slots[i]
+                    if sl is None:
+                        continue
+                    err = None
+                    appended: List[int] = []
+                    for j in range(k):
+                        if not bool(oks[j, i]):
+                            err = "non-finite logits in decode"
+                            break
+                        tok = int(toks[j, i])
+                        sl.tokens.append(tok)
+                        appended.append(tok)
+                        sl.pos += 1
+                        total_tokens += 1
+                        if slot_done(sl):
+                            break
+                    sl.last_tok = sl.tokens[-1] if sl.tokens else 0
+                    # Journal the fence-validated token delta BEFORE
+                    # any completion record (replay folds in order).
+                    if jr is not None and appended:
+                        jr.tokens(sl.request.id, appended)
+                    if err is not None:
+                        slot_fault(i, err)
+                    elif slot_done(sl):
+                        finish_slot(i)
+        finally:
+            preempt.__exit__(None, None, None)
+            if jr is not None:
+                jr.close()
 
         elapsed = time.perf_counter() - t_wall0
         # Per-request virtual-clock splits, exposed for the measure
@@ -688,11 +1118,21 @@ class ScheduledServer:
         stats = self._stats(results, qwaits, e2es, slo_oks, sheds,
                             preempts, prefills, supersteps,
                             total_tokens, decode_s, elapsed)
+        stats["request_retries"] = retries
+        stats["request_expiries"] = expiries
+        stats["engine_restarts"] = restarts
+        if res is not None or jr is not None:
+            stats["drained"] = drained
+        if self.degraded_rungs:
+            stats["degraded_rungs"] = [
+                d["rung"] for d in self.degraded_rungs
+            ]
         tel.note_summary(**{
             kk: stats[kk] for kk in (
                 "queue_wait_ms_p50", "queue_wait_ms_p95",
                 "queue_wait_ms_p99", "request_sheds",
-                "request_preempts",
+                "request_preempts", "request_retries",
+                "request_expiries", "engine_restarts",
             ) if kk in stats
         }, **({"slo_attainment": stats["slo_attainment"]}
               if "slo_attainment" in stats else {}))

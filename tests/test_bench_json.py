@@ -95,6 +95,9 @@ def stubbed_bench(monkeypatch):
             "fifo_queue_wait_ms_p99": 45.0,
             "fifo_slo_attainment": 0.8,
             "fifo_vs_slo_queue_wait_p99": 1.5,
+            "request_retries": 1,
+            "request_expiries": 0,
+            "engine_restarts": 1,
             "hbm_per_slot_bytes": 32768,
             "paged_hbm_per_slot_bytes": 8192,
             "padded_max_admitted_batch": 4,
@@ -191,6 +194,11 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert serving["request_preempts"] == 1
     assert serving["fifo_queue_wait_ms_p99"] == 45.0
     assert serving["fifo_vs_slo_queue_wait_p99"] == 1.5
+    # Failure-model columns (ISSUE 15): injected slot + engine faults
+    # exercise retry / restart; zeros on a healthy run.
+    assert serving["request_retries"] == 1
+    assert serving["request_expiries"] == 0
+    assert serving["engine_restarts"] == 1
     # The capacity columns (ISSUE 13, SERVING.md "Cache layout"):
     # per-slot HBM under both layouts, the paged-vs-padded max batch a
     # fixed cache budget admits, and paged / sharded tokens/s against

@@ -607,3 +607,138 @@ def test_sampling_greedy_default_is_oracle(sex, weights):
     g2, _ = _serve(sex, weights, reqs(), decode_steps=4)
     assert gstats["sampled"] is False
     assert g1[0].tokens == g2[0].tokens
+
+
+# -- failure model: journal & crash resume (SERVING.md "Failure model") -------
+
+
+def _jr(tmp_path, name="serve.jsonl"):
+    from flexflow_tpu.serving import RequestJournal
+
+    return RequestJournal(str(tmp_path / name))
+
+
+def test_journal_roundtrip(tmp_path):
+    """RequestJournal unit contract: admits (tok0), per-fence token
+    deltas and done records fold back into completed/in_flight state;
+    a drain marker flags a clean early exit."""
+    jr = _jr(tmp_path)
+    jr.admit(0, 3, 7)
+    jr.tokens(0, [9, 2])
+    jr.done(0, 3, 3, None, qw=1.5, e2e=2.5, slo_ok=True,
+            latency_s=0.01)
+    jr.admit(1, 4, 5)
+    jr.tokens(1, [8])
+    jr.drain(1, 1)
+    jr.close()
+
+    st = _jr(tmp_path).replay()
+    assert st.completed[0]["tokens"] == [7, 9, 2]
+    assert st.completed[0]["plen"] == 3
+    assert st.completed[0]["error"] is None
+    assert st.completed[0]["slo_ok"] is True
+    assert st.in_flight == {1: [5, 8]}
+    assert st.drained is True
+    assert st.torn_tail is False and st.malformed == 0
+    assert not st.empty
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    """A crash mid-append leaves a torn last line: replay drops it and
+    keeps everything before it (the telemetry-log tolerance, shared
+    through RunLog)."""
+    jr = _jr(tmp_path)
+    jr.admit(0, 3, 7)
+    jr.tokens(0, [9])
+    jr.close()
+    with open(jr.path, "a", encoding="utf-8") as f:
+        f.write('{"ev":"sv_tok')  # no newline: torn mid-append
+
+    st = _jr(tmp_path).replay()
+    assert st.torn_tail is True
+    assert st.in_flight == {0: [7, 9]}
+    missing = _jr(tmp_path, "never_written.jsonl").replay()
+    assert missing.empty and not missing.torn_tail
+
+
+def _crash_resume_reqs():
+    # rid 0 finishes inside superstep 0 (its done record hits the
+    # journal); 1 is mid-flight at the crash; 2 was just admitted into
+    # the freed slot; 3 never left the queue.
+    return [_req(0, [5, 9, 2], max_new=2),
+            _req(1, [3, 1, 4, 2], max_new=5),
+            _req(2, [7, 7], max_new=5),
+            _req(3, [2, 4, 6], max_new=5)]
+
+
+def _crash_then_resume(tmp_path, executor, weights, tear=False, **kw):
+    """Baseline / crashed / resumed triple on one journal; returns
+    (baseline results, resume results, resume stats)."""
+    from flexflow_tpu.runtime.serving import ServingEngineFault
+
+    base, _ = _serve(executor, weights, _crash_resume_reqs(),
+                     decode_steps=2, **kw)
+    jr = _jr(tmp_path)
+    with pytest.raises(ServingEngineFault):
+        _serve(executor, weights, _crash_resume_reqs(), decode_steps=2,
+               journal=jr,
+               fault_injector=ServingFaultInjector(
+                   engine_raise_at={1: "injected engine crash"}),
+               **kw)
+    st = _jr(tmp_path).replay()
+    assert 0 in st.completed and st.in_flight  # real partial progress
+    if tear:
+        with open(jr.path, "rb") as f:
+            raw = f.read()
+        cut = raw.rstrip(b"\n")
+        with open(jr.path, "wb") as f:
+            f.write(cut[: len(cut) - len(cut.splitlines()[-1]) // 2])
+        assert _jr(tmp_path).replay().torn_tail is True
+    res, stats = _serve(executor, weights, _crash_resume_reqs(),
+                        decode_steps=2, journal=_jr(tmp_path), **kw)
+    return base, res, stats
+
+
+def test_server_crash_resume_byte_identical(sex, weights, tmp_path):
+    """Journaled crash recovery (padded, greedy): completed requests
+    restore from the journal without re-running, in-flight requests
+    resume via re-prefill over (prompt ‖ carried) — every final
+    sequence byte-identical to an uncrashed run."""
+    base, res, stats = _crash_then_resume(tmp_path, sex, weights)
+    for rid in range(4):
+        assert res[rid].error is None
+        assert res[rid].tokens == base[rid].tokens
+    assert stats["drained"] is False
+
+
+def test_server_crash_resume_sampled(sex, weights, tmp_path):
+    """Seeded sampling survives crash recovery byte-identically: the
+    (seed, request, pos) keying makes the resumed draws independent of
+    batch composition and of WHERE the crash fell."""
+    base, res, _ = _crash_then_resume(
+        tmp_path, sex, weights,
+        temperature=0.7, top_k=5, sample_seed=3)
+    for rid in range(4):
+        assert res[rid].error is None
+        assert res[rid].tokens == base[rid].tokens
+
+
+def test_server_crash_resume_paged(paged_sex, weights, tmp_path):
+    """The paged block-pool layout recovers identically: ledger state
+    is rebuilt fresh on resume, reservations follow the journal's
+    carried lengths."""
+    base, res, _ = _crash_then_resume(tmp_path, paged_sex, weights)
+    for rid in range(4):
+        assert res[rid].error is None
+        assert res[rid].tokens == base[rid].tokens
+
+
+def test_server_crash_resume_torn_tail(sex, weights, tmp_path):
+    """A torn journal tail only shrinks the carried prefix: the resume
+    re-generates the lost delta deterministically — still
+    byte-identical."""
+    base, res, _ = _crash_then_resume(tmp_path, sex, weights,
+                                      tear=True)
+    for rid in range(4):
+        assert res[rid].error is None
+        assert res[rid].tokens == base[rid].tokens

@@ -33,6 +33,7 @@ from flexflow_tpu.models.transformer import build_transformer_lm
 from flexflow_tpu.runtime.serving import (
     Request,
     ServingExecutor,
+    ServingFaultInjector,
     synthetic_requests,
 )
 from flexflow_tpu.serving import (
@@ -40,6 +41,7 @@ from flexflow_tpu.serving import (
     SchedulerPolicy,
     ServingConfig,
     ServingLatencyModel,
+    ServingResilience,
     SlotShape,
     WorkloadSpec,
     make_workload,
@@ -250,6 +252,27 @@ def test_preempt_byte_parity(lm, weights):
     evicts = [d for d in srv.decisions if d["d"] == "evict"]
     assert len(evicts) == 1 and evicts[0]["id"] == 0
     assert evicts[0]["by"] == 1
+
+
+def test_preempt_byte_parity_sampled(lm, weights):
+    """Sampled preemption is loss-free too: the resume re-prefill
+    replays the decode head's (seed, request, pos) draw at the
+    regenerated position (the sampled ``build_prefill`` variant), so
+    the evicted request's sequence matches an unpreempted solo run."""
+    params, state = weights
+    sex1 = ServingExecutor(lm, max_batch=1, max_seq=S, buckets=(8, S),
+                           decode_kernel=False)
+    pol = SchedulerPolicy(name="slo")
+    kw = dict(temperature=0.8, top_k=8, sample_seed=3)
+    srv = ScheduledServer(sex1, params, state, decode_steps=8,
+                          policy=pol, **kw)
+    res, st = srv.run(_preempt_pair())
+    assert st["request_preempts"] == 1
+    assert res[0].error is None and res[1].error is None
+    solo, _ = ScheduledServer(sex1, params, state, decode_steps=8,
+                              policy=pol, **kw).run(
+        [_req(0, 4, 40, 0.0, priority=1)])
+    assert res[0].tokens == solo[0].tokens
 
 
 def test_preempt_infeasible_deadline_not_honored(lm, weights):
@@ -643,3 +666,178 @@ def test_production_workload_live_source():
                           r.id * hi + len(r.prompt))["sparse_input"][:, 0]
         assert (r.prompt == expect.astype(np.int32)).all()
         assert r.prompt.max() < V
+
+
+# -- failure model (SERVING.md "Failure model") -------------------------------
+
+
+def test_resilience_validation():
+    with pytest.raises(ValueError):
+        ServingResilience(max_retries=-1)
+    with pytest.raises(ValueError):
+        ServingResilience(max_restarts=-1)
+    with pytest.raises(ValueError):
+        ServingResilience(retry_backoff_ms=0.0)
+    with pytest.raises(ValueError):
+        ServingResilience(kernel_fault_rung=-1)
+
+
+def test_retry_backoff_deterministic_sim():
+    """Slot-isolated faults spend the per-request retry budget with
+    DETERMINISTIC virtual-clock exponential backoff (8, 16, ... ms):
+    the retry decisions are part of the replayable decision log, and
+    the request still completes once the fault clears."""
+    def run():
+        srv = ScheduledServer.simulated(
+            SHAPE, decode_steps=4, policy=SchedulerPolicy(name="slo"),
+            resilience=ServingResilience(max_retries=2),
+            fault_injector=ServingFaultInjector(
+                nan_cache_at={0: 0, 1: 0}),
+        )
+        results, stats = srv.run([_req(0, 4, 6)])
+        return srv, results, stats
+
+    a, res_a, st_a = run()
+    b, res_b, st_b = run()
+    assert st_a["request_retries"] == 2
+    assert res_a[0].error is None and len(res_a[0].tokens) == 6
+    backoffs = [d["backoff"] for d in a.decisions if d["d"] == "retry"]
+    assert backoffs == [8.0, 16.0]
+    assert a.decisions == b.decisions
+    assert _virt(st_a) == _virt(st_b)
+
+
+def test_retry_budget_exhaustion_fails_request_sim():
+    """A fault past the retry budget errors the request out — the
+    legacy fail-fast behavior is the budget-0 fixed point."""
+    srv = ScheduledServer.simulated(
+        SHAPE, decode_steps=4, policy=SchedulerPolicy(name="slo"),
+        resilience=ServingResilience(max_retries=1),
+        fault_injector=ServingFaultInjector(
+            nan_cache_at={0: 0, 1: 0}),
+    )
+    results, stats = srv.run([_req(0, 4, 6)])
+    assert stats["request_retries"] == 1
+    assert results[0].error is not None
+    assert stats["failed"] == 1
+
+
+def test_expiry_counts_as_miss_sim():
+    """``expire_waiting``: a finite-SLO request still queued past its
+    deadline is refused — and counted as an SLO miss (attainment stays
+    goodput; expiry can't game the bar)."""
+    reqs = [_req(0, 4, 12, priority=0),
+            _req(1, 4, 12, priority=0),
+            _req(2, 4, 4, priority=1, slo_ms=1.0)]
+    srv = ScheduledServer.simulated(
+        SHAPE, decode_steps=4, policy=SchedulerPolicy(name="slo"),
+        resilience=ServingResilience(expire_waiting=True),
+    )
+    results, stats = srv.run(reqs)
+    assert results[2].error is not None
+    assert results[2].error.startswith("expired")
+    assert stats["request_expiries"] == 1
+    assert stats["completed"] == 2 and stats["failed"] == 1
+    # r2 is the only finite-SLO request and it missed.
+    assert stats["slo_attainment"] == 0.0
+
+
+def test_sim_matches_real_through_retry_and_restart(sex, weights):
+    """The serve-auto exactness contract survives the failure model:
+    with the SAME fault plan (one slot-NaN retry + one engine-class
+    crash/restart), simulate mode matches the real engine decision for
+    decision and dispatch for dispatch."""
+    params, state = weights
+    spec = WorkloadSpec(n_requests=8, vocab=V, prompt_len=(3, 6),
+                        max_new=(2, 8), mean_gap_ms=1.0, burst=4,
+                        priorities=2, slo_ms=60.0, seed=7)
+    pol = SchedulerPolicy(name="slo")
+    res = ServingResilience(max_retries=1, max_restarts=1)
+
+    def injector():
+        return ServingFaultInjector(nan_cache_at={1: 0},
+                                    engine_raise_at={3: "boom"})
+
+    real = ScheduledServer(sex, params, state, decode_steps=8,
+                           policy=pol, resilience=res,
+                           fault_injector=injector())
+    _, real_st = real.run(make_workload(spec))
+    sim = ScheduledServer.simulated(
+        SlotShape(max_batch=2, max_seq=S, buckets=(8, S)),
+        decode_steps=8, policy=pol, resilience=res,
+        fault_injector=injector())
+    _, sim_st = sim.run(make_workload(spec))
+    assert real_st["request_retries"] == 1
+    assert real_st["engine_restarts"] == 1
+    assert sim.decisions == real.decisions
+    assert sim_st["prefills"] == real_st["prefills"]
+    assert sim_st["decode_supersteps"] == real_st["decode_supersteps"]
+    assert sim_st["request_retries"] == real_st["request_retries"]
+    assert sim_st["engine_restarts"] == real_st["engine_restarts"]
+    assert _virt(sim_st) == _virt(real_st)
+
+
+def test_degraded_decode_oracle_rung(lm, weights):
+    """Degraded-mode ladder rung 1: after ``kernel_fault_rung``
+    decode-phase engine faults the flash_decode kernel is disabled and
+    serving falls back to the ``_einsum_decode`` oracle — loudly,
+    recorded in ``degraded_rungs`` — with tokens byte-identical to an
+    unfaulted run (the kernel-vs-oracle numerics pin)."""
+    params, state = weights
+
+    def reqs():
+        return [_req(0, 4, 6), _req(1, 5, 6)]
+
+    base_ex = ServingExecutor(lm, max_batch=2, max_seq=S,
+                              buckets=(8, S), decode_kernel=True)
+    base = ScheduledServer(base_ex, params, state, decode_steps=4,
+                           policy=SchedulerPolicy(name="slo"))
+    base_res, _ = base.run(reqs())
+
+    ex = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                         decode_kernel=True)
+    srv = ScheduledServer(
+        ex, params, state, decode_steps=4,
+        policy=SchedulerPolicy(name="slo"),
+        resilience=ServingResilience(max_restarts=3,
+                                     kernel_fault_rung=2),
+        fault_injector=ServingFaultInjector(
+            engine_raise_at={0: "kernel fault 1", 1: "kernel fault 2"}),
+    )
+    results, stats = srv.run(reqs())
+    assert stats["engine_restarts"] == 2
+    assert stats["degraded_rungs"] == ["decode_oracle"]
+    assert ex.decode_kernel is False
+    for rid in (0, 1):
+        assert results[rid].error is None
+        assert results[rid].tokens == base_res[rid].tokens
+
+
+def test_degraded_shrink_batch_rung(lm, weights, monkeypatch):
+    """Degraded-mode capacity rung (padded layout): a KV cache over
+    ``FF_DEVICE_MEM_BYTES`` shrinks ``max_batch`` stepwise — loudly,
+    recorded — and refuses only at the one-slot floor."""
+    from flexflow_tpu.data.loader import DeviceMemoryError
+
+    params, state = weights
+    # 512 B/token at (D=32, H=2, L=2); a max_seq=64 slot = 32768 B.
+    # 4 slots = 131072 B > 70000 > 2 slots = 65536 B: exactly one rung.
+    monkeypatch.setenv("FF_DEVICE_MEM_BYTES", "70000")
+    ex = ServingExecutor(lm, max_batch=4, max_seq=S, buckets=(8,),
+                         decode_kernel=False)
+    srv = ScheduledServer(ex, params, state, decode_steps=4,
+                          policy=SchedulerPolicy(name="slo"))
+    assert ex.max_batch == 2
+    assert srv.degraded_rungs == [
+        {"rung": "shrink_batch", "max_batch": 2, "prev": 4}]
+    results, stats = srv.run([_req(i, 4, 4) for i in range(3)])
+    assert stats["degraded_rungs"] == ["shrink_batch"]
+    assert all(results[i].error is None for i in range(3))
+
+    # Below the one-slot floor the refusal stays loud.
+    monkeypatch.setenv("FF_DEVICE_MEM_BYTES", "20000")
+    ex1 = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8,),
+                          decode_kernel=False)
+    with pytest.raises(DeviceMemoryError):
+        ScheduledServer(ex1, params, state, decode_steps=4,
+                        policy=SchedulerPolicy(name="slo"))

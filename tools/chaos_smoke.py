@@ -6,9 +6,14 @@ fault / NaN batch / NaN loss inside a k=8 superstep, SIGTERM
 preemption + resume, checkpoint corruption fallback,
 kill-between-force-save-phases — each required to finish with a loss
 trajectory bit-identical to the unfaulted run — plus the serving
-fault-isolation scenario (NaN logits / raised exception inside a
-decode superstep: the faulted request errors out, surviving slots'
-sequences byte-identical; SERVING.md) — and the multi-host world
+fault scenarios (SERVING.md): fault isolation (NaN logits / raised
+exception inside a decode superstep: the faulted request errors out,
+surviving slots' sequences byte-identical), overload shedding,
+``serving_engine_crash`` (journaled crash recovery: engine-class
+fault kills / in-process-restarts the scheduled server, journal
+replay resumes byte-identically, padded AND paged) and
+``serving_sigterm_drain`` (drain-on-SIGTERM: in-flight work journaled
+at the fence, clean exit, resume byte-identical) — and the multi-host world
 failures, ``host_loss`` and ``coordinator_loss``, on the live
 2-process ``jax.distributed`` rig (RESILIENCE.md "Host loss & elastic
 resize": launcher-classified kill, elastic resize / same-world
