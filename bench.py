@@ -883,6 +883,37 @@ def bench_serving(n_chips: int, on_tpu: bool):
     out["sharded_tokens_per_s"] = round(sstats["tokens_per_s"], 1)
     out["sharded_vs_single_mesh_tokens_per_s"] = round(
         sstats["tokens_per_s"] / max(out["k8_tokens_per_s"], 1e-9), 3)
+
+    # Speculation columns (SERVING.md "Speculative decoding"): a d=12
+    # full self-draft (the degenerate fully-accepting case — the draft
+    # SOURCE on a real deployment is a checkpoint or truncation, a
+    # deployment fact, but the dispatch accounting is the same) vs the
+    # plain fused k=8 run.  Tokens per decode dispatch is the headline
+    # (the relay's ~16 ms/call floor is the denominator; d=12 emits up
+    # to 13 tokens per dispatch where plain decode is capped at k=8);
+    # the match bit proves acceptance decides dispatch count, never
+    # content.
+    def reqs13():
+        return synthetic_requests(
+            n_req, vocab, prompt_len=(4, max_seq // 4),
+            max_new_tokens=max_new, seed=13,
+        )
+
+    plain_res, _ = Server(sex, params, state, decode_steps=8).run(reqs13())
+    spec_srv = Server(sex, params, state, decode_steps=8, speculate=12)
+    spec_srv.run(reqs13())  # warm: compiles outside the measured run
+    spec_res, spec_stats = spec_srv.run(reqs13())
+    out["speculate"] = spec_stats["speculate"]
+    out["spec_tokens_per_s"] = round(spec_stats["tokens_per_s"], 1)
+    out["spec_acceptance_rate"] = spec_stats["spec_acceptance_rate"]
+    out["spec_tokens_per_dispatch"] = spec_stats["spec_tokens_per_dispatch"]
+    plain_tpd = (k8_stats["tokens"] - k8_stats["prefills"]) / max(
+        k8_stats["decode_supersteps"], 1)
+    out["plain_tokens_per_dispatch"] = round(plain_tpd, 3)
+    out["spec_vs_plain_tokens_per_dispatch"] = round(
+        spec_stats["spec_tokens_per_dispatch"] / max(plain_tpd, 1e-9), 3)
+    out["spec_match"] = all(
+        spec_res[r].tokens == plain_res[r].tokens for r in plain_res)
     return out
 
 

@@ -677,7 +677,7 @@ def _serving_decode_args(sex, params, op_state, caches):
 
 
 def audit_serving(sex, decode_steps: int = 8, prefix: str = "serving",
-                  sample=None) -> List[ProgramViolation]:
+                  sample=None, speculate: int = 0) -> List[ProgramViolation]:
     """Trace-only audit of a built ``ServingExecutor``: purity of
     every prefill bucket and the fused decode superstep (FFP001 is
     exempt — forward-only programs may reach AD-rule-less kernels),
@@ -686,7 +686,9 @@ def audit_serving(sex, decode_steps: int = 8, prefix: str = "serving",
     executor was built with — the paged variant traces with the block
     table, the sharded one through its shard_map-wrapped kernels, and
     ``sample=(temperature, top_k, seed)`` audits the in-program
-    sampling head."""
+    sampling head.  ``speculate=d`` additionally audits the spec
+    family: every draft-prefill bucket and the fused draft+verify
+    round, whose FFP004 accounting is (d+1) tokens per dispatch."""
     import jax
     import jax.numpy as jnp
 
@@ -736,6 +738,78 @@ def audit_serving(sex, decode_steps: int = 8, prefix: str = "serving",
             f"decode superstep stacks {tuple(toks_out.shape)} tokens, "
             f"expected (k={k}, B={B}) — one fence per K tokens would "
             f"be false"))
+    if speculate:
+        out += _audit_spec(sex, speculate, prefix, sample,
+                           params, op_state, caches)
+    return out
+
+
+def _audit_spec(sex, d: int, prefix: str, sample,
+                params, op_state, caches) -> List[ProgramViolation]:
+    """The speculative program family (SERVING.md "Speculative
+    decoding"): purity of every draft-prefill bucket and the fused
+    draft+verify round, plus its FFP004 accounting — the one fence
+    reads back a (d+1, B) verified-token stack (up to d+1 tokens per
+    dispatch across the whole slot batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.runtime.trainer import relay_safe_steps
+
+    d = relay_safe_steps(d, what="speculate")
+    out: List[ProgramViolation] = []
+    B, S = sex.max_batch, sex.max_seq
+    for bucket in sex.buckets:
+        toks = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+        name = f"{prefix}/draft_prefill_L{bucket}"
+        try:
+            jaxpr = jax.make_jaxpr(sex.build_draft_prefill(bucket))(
+                params, op_state, toks
+            )
+        except Exception as e:
+            out.append(ProgramViolation(
+                "FFP002", name,
+                f"draft prefill failed to trace: "
+                f"{type(e).__name__}: {e}"))
+            continue
+        out += purity_violations(jaxpr, name)
+    # The draft model's own caches are ALWAYS the padded layout
+    # (init_draft_cache), whatever the verify caches use.
+    dcaches = {
+        name: {
+            "k": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+            "v": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+        }
+        for name, (h, hd, dt) in sex._draft_cache_specs.items()
+    }
+    pos = jax.ShapeDtypeStruct((B,), jnp.int32)
+    tok = jax.ShapeDtypeStruct((B,), jnp.int32)
+    args = (params, params, op_state, caches, dcaches)
+    if sex.paged:
+        args += (jax.ShapeDtypeStruct((B, sex.blocks_per_slot),
+                                      jnp.int32),)
+    args += (pos, tok)
+    if sample is not None:
+        args += (jax.ShapeDtypeStruct((B,), jnp.int32),)
+    name = f"{prefix}/spec_d{d}"
+    spec = sex.build_spec_step(d, sample=sample)
+    try:
+        jaxpr = jax.make_jaxpr(spec)(*args)
+    except Exception as e:
+        return out + [ProgramViolation(
+            "FFP002", name,
+            f"spec round failed to trace: {type(e).__name__}: {e}")]
+    out += purity_violations(jaxpr, name)
+    # FFP004: the single fence carries a (d+1, B) verified-token
+    # stack — up to d+1 accepted tokens per dispatch.
+    shapes = jax.eval_shape(spec, *args)
+    ys = shapes[4][0]
+    if tuple(ys.shape) != (d + 1, B):
+        out.append(ProgramViolation(
+            "FFP004", name,
+            f"spec round stacks {tuple(ys.shape)} verified tokens, "
+            f"expected (d+1={d + 1}, B={B}) — the tokens-per-dispatch "
+            f"accounting would be false"))
     return out
 
 
@@ -832,7 +906,10 @@ def audit_repo(fast: bool = True) -> List[ProgramViolation]:
     out += _audit_pipeline(pipec, prefix="pipeline_compiled", fast=fast)
 
     # Serving families: padded baseline, in-program sampling head,
-    # paged KV pool, and the sharded (n x c) decode mesh.
+    # paged KV pool, the sharded (n x c) decode mesh, the speculative
+    # draft+verify round (full-graph self-draft: draft_layers is a
+    # deployment knob, the program shape is the audited property), and
+    # the paged x sharded composition.
     sex = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
                           buckets=(8, 16))
     out += audit_serving(sex, decode_steps=4)
@@ -844,6 +921,12 @@ def audit_repo(fast: bool = True) -> List[ProgramViolation]:
     sex_shard = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
                                 buckets=(8, 16), shard=(2, 2))
     out += audit_serving(sex_shard, decode_steps=4, prefix="serving_sharded")
+    out += audit_serving(sex, decode_steps=4, prefix="serving_spec",
+                         speculate=4)
+    sex_ps = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
+                             buckets=(8, 16), kv_block=4, shard=(2, 2))
+    out += audit_serving(sex_ps, decode_steps=4,
+                         prefix="serving_paged_sharded")
 
     if not fast:
         out += _donation_serving(sex, decode_steps=4)

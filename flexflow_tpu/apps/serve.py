@@ -38,6 +38,18 @@ Capacity flags (SERVING.md "Cache layout"):
                      KV heads over c (build_mesh_plan over N*C
                      devices); falls back loudly below N*C devices
 
+Speculation flags (SERVING.md "Speculative decoding"):
+  --speculate d      speculative decoding: draft d tokens + verify
+                     d+1 in ONE fused dispatch; each round emits
+                     accepted+1 tokens (clamped at 20 with the other
+                     fused chains).  Greedy output is bit-identical
+                     to plain decode; only the dispatch count changes.
+  --draft-ckpt PATH  restore the DRAFT model's params from their own
+                     training checkpoint (same architecture; default:
+                     the serving params — self-draft)
+  --draft-layers L   self-draft via the first L transformer blocks
+                     only (0 = the full model, acceptance 1.0)
+
 Sampling flags (greedy stays the default and the parity oracle):
   --temperature T    in-program temperature sampling (0 = greedy)
   --top-k N          restrict sampling to the N best logits (0 = all)
@@ -62,9 +74,9 @@ Scheduler flags (each enables the scheduled path):
   --shed-depth N     shed waiting requests past this queue depth (0 =
                      off)
   --serve-auto       search (buckets x K x max_batch x kv layout x
-                     policy knobs) against the calibrated serving
-                     latency model and run the winner (--calibration
-                     feeds constants)
+                     policy knobs, + draft depth d when --speculate)
+                     against the calibrated serving latency model and
+                     run the winner (--calibration feeds constants)
 
 Failure-model flags (SERVING.md "Failure model"):
   --journal PATH     append-only request journal (JSONL), written at
@@ -141,13 +153,15 @@ def _pop_opt_str(argv, flag):
     return ""
 
 
-def _dry_run(sex, decode_ks) -> int:
+def _dry_run(sex, decode_ks, speculate=0) -> int:
     """Compute-free serving validation: eval_shape every prefill
     bucket and every decode-superstep width the scheduler may
-    dispatch, print the program/cache table (the --dry-run contract of
-    the training apps)."""
+    dispatch (plus the draft-prefill and fused spec programs when
+    speculating), print the program/cache table (the --dry-run
+    contract of the training apps)."""
     decode_ks = sorted(set(decode_ks))
-    table = sex.abstract_programs(decode_steps=decode_ks[-1])
+    table = sex.abstract_programs(decode_steps=decode_ks[-1],
+                                  speculate=speculate)
     print(f"{'program':<18} {'shape':<28} notes")
     for name, aval in sorted(table["cache"].items()):
         print(f"{'cache ' + name:<18} {str(tuple(aval.shape)):<28} "
@@ -161,6 +175,12 @@ def _dry_run(sex, decode_ks) -> int:
         print(f"{'decode k=' + str(k):<18} "
               f"{str(shape) + ' tokens':<28} "
               f"1 dispatch + 1 fence per {k} tokens")
+    if speculate:
+        shape = tuple(table["spec"].shape)
+        print(f"{'spec d=' + str(speculate):<18} "
+              f"{str(shape) + ' tokens':<28} "
+              f"1 dispatch + 1 fence per round "
+              f"(<= {speculate + 1} accepted)")
     # The program audit over the exact serving programs this run would
     # build (purity + K-tokens-per-dispatch accounting, ANALYSIS.md) —
     # every decode width the scheduler may choose is audited.
@@ -169,7 +189,8 @@ def _dry_run(sex, decode_ks) -> int:
 
     violations = []
     for k in decode_ks:
-        violations += analysis.audit_serving(sex, decode_steps=k)
+        violations += analysis.audit_serving(sex, decode_steps=k,
+                                             speculate=speculate)
     print(analysis.summary_line(violations))
     for v in violations:
         print(f"  {v}")
@@ -228,6 +249,9 @@ def main(argv=None) -> int:
     temperature = pop_float(argv, "--temperature", 0.0)
     top_k = pop_int(argv, "--top-k", 0)
     sample_seed = pop_int(argv, "--sample-seed", 0)
+    speculate = pop_int(argv, "--speculate", 0)
+    draft_ckpt = _pop_str(argv, "--draft-ckpt", "")
+    draft_layers = pop_int(argv, "--draft-layers", 0)
     # Scheduler flags (SERVING.md "Scheduler policy"): any of them
     # routes through the SLO-aware scheduled path.
     sched_s = _pop_str(argv, "--sched", "")
@@ -257,6 +281,13 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"--workload-trace expects nothing, 'zipf' or "
             f"'prod[:alpha=A]', got {workload_trace!r}"
+        )
+    if speculate < 0:
+        raise SystemExit(f"--speculate expects d >= 0, got {speculate}")
+    if (draft_ckpt or draft_layers) and not speculate:
+        raise SystemExit(
+            "--draft-ckpt/--draft-layers configure the DRAFT source "
+            "and need --speculate d to arm speculation"
         )
     shard = None
     if shard_s:
@@ -289,6 +320,8 @@ def main(argv=None) -> int:
             no_kernel=no_kernel, kv_block=kv_block, kv_blocks=kv_blocks,
             shard=shard, temperature=temperature, top_k=top_k,
             sample_seed=sample_seed, journal_path=journal_path,
+            speculate=speculate, draft_ckpt=draft_ckpt,
+            draft_layers=draft_layers,
         )
     return _run_scheduled(
         cfg, max_seq=max_seq, max_batch=max_batch,
@@ -304,7 +337,8 @@ def main(argv=None) -> int:
         serve_auto=serve_auto, journal_path=journal_path,
         serve_retries=serve_retries, retry_backoff_ms=retry_backoff_ms,
         serve_max_restarts=serve_max_restarts,
-        expire_waiting=expire_waiting,
+        expire_waiting=expire_waiting, speculate=speculate,
+        draft_ckpt=draft_ckpt, draft_layers=draft_layers,
     )
 
 
@@ -312,7 +346,8 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 max_new, eos, vocab, d_model, heads, layers, lo, hi,
                 buckets, no_kernel, kv_block, kv_blocks, shard,
                 temperature, top_k, sample_seed,
-                journal_path="") -> int:
+                journal_path="", speculate=0, draft_ckpt="",
+                draft_layers=0) -> int:
     """The closed-loop FIFO path — still the chaos decode-fault
     harness and the scheduler's numerics oracle."""
     from flexflow_tpu.runtime import telemetry as _telemetry
@@ -331,12 +366,13 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         ff, cfg, max_batch=max_batch, max_seq=max_seq, buckets=buckets,
         decode_kernel=False if no_kernel else None,
         kv_block=kv_block, kv_blocks=kv_blocks or None, shard=shard,
+        draft_layers=draft_layers,
     )
     if cfg.dry_run:
         # Inside maybe_run so the dry run's `analysis` audit event
         # lands in the JSONL stream when telemetry is armed.
         with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
-            return _dry_run(sex, [decode_steps])
+            return _dry_run(sex, [decode_steps], speculate=speculate)
 
     with _telemetry.maybe_run(cfg, meta={"app": "serve"}):
         if cfg.ckpt_dir:
@@ -345,6 +381,11 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                   f"from {cfg.ckpt_dir}")
         else:
             params, state = sex.init(cfg.seed)
+        draft_params = None
+        if draft_ckpt:
+            dstep, draft_params, _ds = sex.restore(draft_ckpt)
+            print(f"restored draft checkpoint step {dstep} "
+                  f"from {draft_ckpt}")
         requests = synthetic_requests(
             n_requests, vocab, prompt_len=(lo, hi),
             max_new_tokens=max_new, seed=cfg.seed,
@@ -354,7 +395,8 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                      temperature=temperature, top_k=top_k,
                      sample_seed=sample_seed,
                      journal=(RequestJournal(journal_path)
-                              if journal_path else None))
+                              if journal_path else None),
+                     speculate=speculate, draft_params=draft_params)
         t0 = time.perf_counter()
         results, stats = srv.run(requests)
         elapsed = time.perf_counter() - t0
@@ -382,7 +424,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                    slo_ms, priorities, shed_depth, serve_auto,
                    journal_path="", serve_retries=0,
                    retry_backoff_ms=8.0, serve_max_restarts=-1,
-                   expire_waiting=False) -> int:
+                   expire_waiting=False, speculate=0, draft_ckpt="",
+                   draft_layers=0) -> int:
     from flexflow_tpu.runtime import telemetry as _telemetry
     from flexflow_tpu.runtime.serving import (
         EXIT_SERVING_FAILURE,
@@ -456,7 +499,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 buckets=buckets, decode_steps=decode_steps,
                 max_batch=max_batch, max_seq=max_seq, policy=policy,
                 kv_block=kv_block, kv_blocks=kv_blocks or None,
-                shard=shard,
+                shard=shard, speculate=speculate,
             )
             res = search_serving_config(requests, baseline, model)
             choice = res.chosen
@@ -471,6 +514,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             policy = choice.config.policy
             kv_block = choice.config.kv_block
             kv_blocks = choice.config.kv_blocks or 0
+            speculate = choice.config.speculate
             _telemetry.current().emit(
                 "search", kind="serving",
                 chosen=choice.config.to_json(),
@@ -494,6 +538,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             buckets=buckets,
             decode_kernel=False if no_kernel else None,
             kv_block=kv_block, kv_blocks=kv_blocks or None, shard=shard,
+            draft_layers=draft_layers,
         )
         srv_proto = ScheduledServer.simulated(
             SlotShape(max_batch=max_batch, max_seq=max_seq,
@@ -503,7 +548,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             latency_model=model,
         )
         if cfg.dry_run:
-            return _dry_run(sex, srv_proto._k_candidates)
+            return _dry_run(sex, srv_proto._k_candidates,
+                            speculate=speculate)
 
         if cfg.ckpt_dir:
             step, params, state = sex.restore(cfg.ckpt_dir)
@@ -511,6 +557,11 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                   f"from {cfg.ckpt_dir}")
         else:
             params, state = sex.init(cfg.seed)
+        draft_params = None
+        if draft_ckpt:
+            dstep, draft_params, _ds = sex.restore(draft_ckpt)
+            print(f"restored draft checkpoint step {dstep} "
+                  f"from {draft_ckpt}")
         srv = ScheduledServer(
             sex, params, state, decode_steps=decode_steps,
             eos_id=None if eos < 0 else eos, policy=policy,
@@ -518,6 +569,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             sample_seed=sample_seed, resilience=resilience,
             journal=(RequestJournal(journal_path)
                      if journal_path else None),
+            speculate=speculate, draft_params=draft_params,
         )
         t0 = time.perf_counter()
         try:
@@ -578,6 +630,12 @@ def _print_layout(stats) -> None:
         print(f"mesh shard = batch n={n} x heads c={c}")
     if stats.get("sampled"):
         print("sampling = seeded temperature/top-k (replayable)")
+    if stats.get("speculate"):
+        print(f"speculation = d={stats['speculate']} "
+              f"(draft_layers={stats['draft_layers']}, acceptance "
+              f"{stats['spec_acceptance_rate'] * 100:.1f}%, "
+              f"{stats['spec_tokens_per_dispatch']:.2f} tokens/"
+              f"dispatch, {stats['draft_prefills']} draft prefills)")
 
 
 def _report_failures(results, stats) -> int:

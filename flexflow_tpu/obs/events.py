@@ -47,6 +47,7 @@ EVENT_CATALOG = frozenset({
     "request_start",
     "prefill",
     "decode_superstep",
+    "spec_verify",
     "request_end",
     "serving_program",
     # serving scheduler (SERVING.md "Scheduler policy")

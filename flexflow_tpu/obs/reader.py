@@ -241,6 +241,7 @@ class RunLog:
         slo_oks: List[bool] = []
         steps = fences = sheds = preempts = 0
         retries = expiries = restarts = 0
+        spec_rounds = spec_accepted = spec_draft = spec_emitted = 0
         for e in self.events:
             if e.ev == "step":
                 steps += 1
@@ -270,6 +271,14 @@ class RunLog:
                 expiries += 1
             elif e.ev == "engine_restart":
                 restarts += 1
+            elif e.ev == "spec_verify":
+                # One event per speculative round (= per decode
+                # dispatch in spec mode), so the counts reproduce the
+                # server's acceptance/tokens-per-dispatch exactly.
+                spec_rounds += 1
+                spec_accepted += int(e.get("accepted", 0))
+                spec_draft += int(e.get("draft", 0))
+                spec_emitted += int(e.get("emitted", 0))
         out: Dict[str, Any] = {"steps": steps, "fences": fences}
         out["fences_per_step"] = round(fences / max(steps, 1), 4)
         if step_walls:
@@ -304,6 +313,15 @@ class RunLog:
             out["engine_restarts"] = restarts
         if slo_oks:
             out["slo_attainment"] = round(sum(slo_oks) / len(slo_oks), 4)
+        if spec_rounds:
+            # Same formulas and rounding as the serving stats block
+            # (runtime/serving.py / serving/scheduler.py).
+            out["spec_acceptance_rate"] = round(
+                spec_accepted / max(spec_draft, 1), 4
+            )
+            out["spec_tokens_per_dispatch"] = round(
+                spec_emitted / max(spec_rounds, 1), 3
+            )
         return out
 
     def summary(self) -> Dict[str, Any]:

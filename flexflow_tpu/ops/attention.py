@@ -282,6 +282,29 @@ class MultiHeadAttention(Op):
     #   pools and decode scatters/gathers through the table
     #   (runtime/serving.py KVBlockLedger).
     #
+    # **Speculative rollback contract** (SERVING.md "Speculative
+    # decoding"): the fused verify scan drives this same t == 1 path
+    # once per draft position, so a rejected draft leaves K/V rows
+    # written PAST the accepted position.  No explicit rollback is
+    # needed — a row at position p participates in attention only
+    # when the querying token's ``pos >= p`` (the ``<= pos`` mask),
+    # and the position walk resumes from ``accepted + 1``, so every
+    # stale row is either never attended or overwritten by the token
+    # that legitimately owns that position before any query can see
+    # it.  Paged layouts get the same guarantee one level up:
+    # out-of-reservation scatters land in scratch block 0, which the
+    # ledger never allocates and the mask never admits.
+    #
+    # **Paged × sharded**: the paged decode branch below is pure jnp
+    # (scatter + table gather + einsum oracle — no pallas_call), so
+    # under a serving mesh it partitions via plain GSPMD: the pool
+    # shards its HEAD axis on 'c' exactly like the padded cache, the
+    # host-side block table replicates, and 'n' replicates the pool
+    # (block indices are batch-global, so there is no batch axis to
+    # split).  ``_project``'s fused-QKV matmul keeps fused-vs-split
+    # numerics bit-identical, which is what pins the sharded paged
+    # path to the single-mesh paged oracle (tests/test_serving.py).
+    #
     # Training never sets cache keys, so the differentiable pure-jnp
     # contract on the training path is untouched (the decode kernel
     # has no VJP — it is reachable only from the forward-only serving

@@ -698,6 +698,78 @@ def scenario_serving_sigterm_drain(root: str) -> Tuple[bool, str]:
                   "undrained run (padded AND paged layouts)")
 
 
+def scenario_serving_spec_fault(root: str) -> Tuple[bool, str]:
+    """Speculative-decode fault isolation (SERVING.md "Speculative
+    decoding"): the same injected fault matrix as
+    ``serving_decode_fault`` — a NaN'd cache row before one round and
+    a raised exception before another — but against the SPECULATING
+    server (full-graph self-draft, d=4).  Greedy speculation is
+    bit-identical to plain fused decode, so the byte baseline is the
+    UNSPECULATED clean run: the clean speculating run must match it
+    token-for-token, and under faults each faulted slot's request
+    errors at the verify fence (non-finite verify logits are the
+    detector — a poisoned cache can never surface as silently-wrong
+    accepted tokens) while every survivor stays byte-identical to
+    that unspeculated baseline.  Paged sub-check included."""
+    from flexflow_tpu.runtime.serving import Server, ServingFaultInjector
+
+    sex, params, state = _serving_setup()
+    base_results, _ = Server(sex, params, state, decode_steps=4).run(
+        _serving_requests()
+    )
+    if any(r.error for r in base_results.values()):
+        return False, "spec_fault: unspeculated baseline had errors"
+    # Clean speculating run: the parity premise the fault checks
+    # stand on (one diverged token would void the byte baseline).
+    spec_clean, cstats = Server(sex, params, state, decode_steps=4,
+                                speculate=4).run(_serving_requests())
+    if cstats.get("speculate") != 4:
+        return False, "spec_fault: clean run did not speculate"
+    for rid, r in base_results.items():
+        if spec_clean[rid].tokens != r.tokens:
+            return False, (f"spec_fault: request {rid}'s tokens under "
+                           f"clean speculation DIVERGED from plain "
+                           f"fused decode (greedy parity broken)")
+    inj = ServingFaultInjector(nan_cache_at={1: 0}, raise_at={3: 0})
+    results, _ = Server(sex, params, state, decode_steps=4, speculate=4,
+                        fault_injector=inj).run(_serving_requests())
+    fired = {m for m, _, _ in inj.fired}
+    if fired != {"nan_cache", "raise"}:
+        return False, f"spec_fault: injector fired {sorted(fired)}"
+    failed = sorted(rid for rid, r in results.items() if r.error)
+    if failed != [0, 2]:
+        return False, (f"spec_fault: expected requests [0, 2] to "
+                       f"error out, got {failed}")
+    for rid in (1, 3):
+        if results[rid].tokens != base_results[rid].tokens:
+            return False, (f"spec_fault: request {rid}'s tokens "
+                           f"DIVERGED from the unspeculated run "
+                           f"(verify-fence isolation broken)")
+    # Paged sub-check: same faulted spec run over the paged-KV stack
+    # (verify writes page through the block table; the draft cache
+    # stays padded) — same failure set, survivors byte-identical to
+    # the PADDED unspeculated baseline.
+    sexp, pparams, pstate = _serving_setup(kv_block=8)
+    pinj = ServingFaultInjector(nan_cache_at={1: 0}, raise_at={3: 0})
+    presults, pstats = Server(sexp, pparams, pstate, decode_steps=4,
+                              speculate=4, fault_injector=pinj
+                              ).run(_serving_requests())
+    if pstats.get("kv_layout") != "paged":
+        return False, "spec_fault: paged sub-check did not run paged"
+    pfailed = sorted(rid for rid, r in presults.items() if r.error)
+    if pfailed != [0, 2]:
+        return False, (f"spec_fault[paged]: expected requests [0, 2] "
+                       f"to error out, got {pfailed}")
+    for rid in (1, 3):
+        if presults[rid].tokens != base_results[rid].tokens:
+            return False, (f"spec_fault[paged]: request {rid}'s tokens "
+                           f"DIVERGED from the padded unspeculated run")
+    return True, ("spec_fault: clean speculation byte-identical to "
+                  "plain decode; faulted requests [0, 2] errored at "
+                  "the verify fence; survivors byte-identical to the "
+                  "unspeculated run (padded AND paged layouts)")
+
+
 # -- multi-host elastic scenarios (RESILIENCE.md "Host loss & elastic
 # resize") -----------------------------------------------------------------
 #
@@ -898,6 +970,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "serving_overload_shed": scenario_serving_overload_shed,
     "serving_engine_crash": scenario_serving_engine_crash,
     "serving_sigterm_drain": scenario_serving_sigterm_drain,
+    "serving_spec_fault": scenario_serving_spec_fault,
     "host_loss": scenario_host_loss,
     "coordinator_loss": scenario_coordinator_loss,
 }

@@ -27,6 +27,27 @@ training runtime already proved out (PRs 1/3/5):
   whole active batch.  A slot finishing mid-superstep discards its
   tail tokens (bounded speculation waste — the fused-dispatch
   tradeoff, K tokens max).
+- **Speculative decoding** (SERVING.md "Speculative decoding"): the
+  fused superstep buys at most K<=20 tokens per dispatch against the
+  relay floor; :meth:`ServingExecutor.build_spec_step` multiplies
+  tokens per VERIFIED dispatch instead (the SpecInfer move, built on
+  Leviathan et al.).  One jitted program runs d cheap DRAFT steps (a
+  truncated-layer self-draft or a separate draft checkpoint of the
+  same architecture, ``draft_layers``/``draft_params``), then
+  verifies the whole draft with d+1 full-model steps whose scan body
+  IS the decode-superstep body fed the draft tokens instead of its
+  own feedback — so every emitted token is computed from a correct
+  accepted history and the output sequence is BIT-IDENTICAL to
+  sequential decode regardless of the acceptance pattern (greedy AND
+  the keyed-sampling variant; acceptance only changes how many
+  dispatches the sequence costs).  The longest matching prefix is
+  accepted IN-PROGRAM; the single fence reads back
+  ``(tokens (d+1, B), finite (d+1, B), accepted (B,))``.  Rejected
+  draft rows need no explicit rollback: stale K/V at positions past
+  a slot's ``pos`` is masked by the ``<= pos`` decode attention
+  contract and overwritten as the position advances (padded and
+  paged alike — out-of-reservation paged writes land in scratch
+  block 0).
 
 The KV-cache protocol lives on the op layer (``ops/attention.py``):
 ``MultiHeadAttention.forward`` takes a cached path when ``state``
@@ -57,6 +78,16 @@ engine (SERVING.md "Cache layout"):
   writes land there and are never read by an active slot's masked
   attention, keeping survivors byte-identical under chaos.
 
+The two COMPOSE: block tables are host-side int arithmetic with no
+batch axis on the pool, so paged + sharded shards the pool's HEAD
+axis on ``c`` (``NamedSharding (None, None, 'c', None)``) while the
+paged decode path — pure-jnp scatter/gather + the einsum oracle —
+partitions via plain GSPMD; per-(slot, head) softmax is independent,
+so sharded-paged tokens are bit-identical to the single-mesh paged
+oracle.  The ``n`` axis replicates the pool (the pool has no batch
+dimension to shard), so the per-device capacity win of paged+sharded
+comes from ``c`` alone.
+
 Fault isolation (chaos matrix: ``runtime/chaos.py`` serving scenario):
 slots are independent in the batch dimension, per-slot logits carry an
 in-program finiteness flag read at the superstep fence, and a faulted
@@ -69,6 +100,7 @@ import collections
 import dataclasses
 import functools
 import logging
+import re
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -390,8 +422,22 @@ class ServingExecutor:
       padded ``(max_batch, max_seq, ...)`` layout; ``kv_blocks=None``
       defaults to the worst case (every slot at ``max_seq``) + the
       scratch block — the capacity win comes from setting it lower
-      under an HBM budget.  Paged and sharded do not compose yet:
-      paged wins, sharding is dropped with a loud warning.
+      under an HBM budget.  Paged and sharded COMPOSE: the pool
+      shards its head axis on ``c`` (the ``n`` axis replicates the
+      pool — it has no batch dimension), parity-pinned to the
+      single-mesh paged oracle; genuinely unsupported shapes
+      (``num_heads % c``) still refuse loudly, and a box with too few
+      devices still falls back loudly to the single mesh.
+    - ``draft_layers``: speculative decoding's DRAFT truncation — the
+      draft forward runs only the first L ``blk{i}_``-named
+      transformer blocks of the (same-architecture) draft params,
+      passing the residual stream through the skipped blocks.  0 (the
+      default) runs the full graph as the draft: with separate
+      ``draft_params`` that is the draft-checkpoint configuration;
+      with the serving params themselves it is the degenerate
+      full-self-draft whose acceptance is exactly 1.0 —
+      compute-wasteful but dispatch-optimal, the right trade on a
+      dispatch-dominated relay.  See :meth:`build_spec_step`.
     """
 
     def __init__(
@@ -406,6 +452,7 @@ class ServingExecutor:
         kv_block: int = 0,
         kv_blocks: Optional[int] = None,
         shard: Optional[Tuple[int, int]] = None,
+        draft_layers: int = 0,
     ):
         self.model = model
         self.config = config or model.config
@@ -471,15 +518,12 @@ class ServingExecutor:
             self.blocks_per_slot = 0
             self.kv_blocks = 0
         # -- sharded decode (batch on 'n', heads on 'c') --
+        # Paged caches compose: the pool shards heads on 'c' only (no
+        # batch axis to shard on 'n'), block tables stay host-side
+        # ints, and the pure-jnp paged decode path partitions via
+        # plain GSPMD — see the module docstring.
         self._plan = None
         self._pc = None
-        if shard is not None and self.paged:
-            _log.warning(
-                "paged KV caches and sharded decode do not compose yet: "
-                "dropping shard=%s, serving paged on the single mesh",
-                tuple(shard),
-            )
-            shard = None
         if shard is not None:
             n, c = int(shard[0]), int(shard[1])
             if n < 1 or c < 1 or n * c < 2:
@@ -491,7 +535,10 @@ class ServingExecutor:
                     "back to the single-mesh engine", n * c, ndev,
                 )
             else:
-                if self.max_batch % n:
+                if not self.paged and self.max_batch % n:
+                    # The padded cache shards its batch axis on 'n';
+                    # the paged pool has no batch axis, so 'n' only
+                    # sizes the mesh there.
                     raise ValueError(
                         f"shard batch degree n={n} must divide "
                         f"max_batch={self.max_batch}"
@@ -513,6 +560,38 @@ class ServingExecutor:
         self.shard = (
             (self._pc.n, self._pc.c) if self._pc is not None else None
         )
+        # -- speculative drafting (SERVING.md "Speculative decoding") --
+        # ``draft_layers`` truncates the DRAFT forward to the first L
+        # blk{i}_-named transformer blocks; the skipped blocks pass
+        # the residual stream through.  0 = full-graph draft.
+        self.draft_layers = int(draft_layers or 0)
+        blk_of: Dict[str, int] = {}
+        for op in self._layers:
+            m = re.match(r"blk(\d+)_", op.name)
+            if m:
+                blk_of[op.name] = int(m.group(1))
+        n_blocks = max(blk_of.values()) + 1 if blk_of else 0
+        if self.draft_layers:
+            if not blk_of:
+                raise ValueError(
+                    "draft_layers needs blk{i}_-named transformer blocks "
+                    "(models/transformer.py naming); this graph has none"
+                )
+            if not 1 <= self.draft_layers <= n_blocks:
+                raise ValueError(
+                    f"draft_layers must be in [1, {n_blocks}], got "
+                    f"{self.draft_layers}"
+                )
+        self._draft_skip = frozenset(
+            name for name, i in blk_of.items()
+            if self.draft_layers and i >= self.draft_layers
+        )
+        #: Cache specs for the draft forward's OWN (always padded)
+        #: KV caches — the attention ops the truncation keeps.
+        self._draft_cache_specs = {
+            name: spec for name, spec in self._cache_specs.items()
+            if name not in self._draft_skip
+        }
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fns: Dict[Tuple, Any] = {}
 
@@ -575,10 +654,13 @@ class ServingExecutor:
         ``DeviceMemoryError`` budget estimate)."""
         if self.paged:
             total = self.kv_blocks * self.kv_block * self._bytes_per_token
+            if self._pc is not None:
+                # The pool shards heads on 'c' only; 'n' replicates it.
+                total //= self._pc.c
         else:
             total = self.max_batch * self.max_seq * self._bytes_per_token
-        if self._plan is not None:
-            total //= self._plan.num_devices
+            if self._plan is not None:
+                total //= self._plan.num_devices
         return total
 
     def hbm_per_slot_bytes(
@@ -669,6 +751,24 @@ class ServingExecutor:
         self._budget_check()
         if self.paged:
             NB, bs = self.kv_blocks, self.kv_block
+            if self._plan is not None:
+                # Paged + sharded: the pool shards its HEAD axis on
+                # 'c' (block and position axes stay whole so the
+                # host-int block table indexes locally); 'n'
+                # replicates the pool.
+                def put(h, hd, dt):
+                    return jax.device_put(
+                        jnp.zeros((NB, bs, h, hd), dt),
+                        self._plan.sharding(
+                            self._pc, (None, None, "c", None),
+                            (NB, bs, h, hd),
+                        ),
+                    )
+
+                return {
+                    name: {"k": put(h, hd, dt), "v": put(h, hd, dt)}
+                    for name, (h, hd, dt) in self._cache_specs.items()
+                }
             return {
                 name: {
                     "k": self._place(jnp.zeros((NB, bs, h, hd), dt)),
@@ -703,6 +803,41 @@ class ServingExecutor:
             for name, (h, hd, dt) in self._cache_specs.items()
         }
 
+    def init_draft_cache(self):
+        """The DRAFT model's own per-layer KV caches for the
+        speculative path — always the padded ``(max_batch, max_seq,
+        h, hd)`` layout (the draft cache is an acceleration structure,
+        not a capacity-accounted one: it covers only the truncation's
+        kept layers, and a stale draft cache can never corrupt output
+        — draft quality affects acceptance, never correctness)."""
+        B, S = self.max_batch, self.max_seq
+        if self._plan is not None:
+            # Paged engines never validated max_batch % n (the pool
+            # has no batch axis), so the padded draft cache shards
+            # heads only there.
+            axes = (
+                (None, None, "c", None) if self.paged
+                else ("n", None, "c", None)
+            )
+
+            def put(h, hd, dt):
+                return jax.device_put(
+                    jnp.zeros((B, S, h, hd), dt),
+                    self._plan.sharding(self._pc, axes, (B, S, h, hd)),
+                )
+
+            return {
+                name: {"k": put(h, hd, dt), "v": put(h, hd, dt)}
+                for name, (h, hd, dt) in self._draft_cache_specs.items()
+            }
+        return {
+            name: {
+                "k": self._place(jnp.zeros((B, S, h, hd), dt)),
+                "v": self._place(jnp.zeros((B, S, h, hd), dt)),
+            }
+            for name, (h, hd, dt) in self._draft_cache_specs.items()
+        }
+
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
             if b >= prompt_len:
@@ -715,17 +850,27 @@ class ServingExecutor:
     # -- the forward walk ---------------------------------------------------
 
     def _forward(self, params, op_state, tokens, caches, pos,
-                 block_table=None):
+                 block_table=None, skip=None):
         """Forward-only walk over the non-loss op graph in inference
         mode: attention ops get their caches + the per-slot position
         vector through the existing ``state`` mechanism
         (``ops/attention.py`` KV-cache protocol), position embeddings
         get ``pos``; everything else runs its plain eval forward.
         ``block_table`` (paged layout) rides the same state channel.
-        Returns ``(logits, new_caches)``."""
+        ``skip`` (the truncated-layer DRAFT forward) names ops whose
+        outputs pass their first input through unchanged — skipping a
+        whole ``blk{i}_`` group forwards the residual stream past the
+        block, which is safe because every skipped op's internal
+        consumers are skipped with it.  Returns ``(logits,
+        new_caches)``."""
         env: Dict[str, Any] = {self._tokens_name: tokens}
         new_caches: Dict[str, Any] = {}
         for op in self._layers:
+            if skip and op.name in skip:
+                passed = env[op.inputs[0].name]
+                for t in op.outputs:
+                    env[t.name] = passed
+                continue
             # Single-mesh serving binds a mesh-less placement so
             # strategy-bound paths (ring attention, TP linear pinning)
             # stay off regardless of what a training executor last
@@ -874,6 +1019,37 @@ class ServingExecutor:
 
         return jax.jit(install, donate_argnums=(0,))
 
+    def _picker(self, sample: Optional[Tuple[float, int, int]]):
+        """THE in-program token-selection closure, shared by the
+        decode superstep and the speculative draft/verify scans so the
+        three can never drift: greedy argmax, or the keyed
+        temperature/top-k draw whose key is
+        ``fold_in(fold_in(key(seed), req_id), pos)`` — a pure function
+        of (seed, request, position), replayable across batch
+        composition, supersteps, and preemption/resume."""
+        base_key = (
+            jax.random.key(sample[2]) if sample is not None else None
+        )
+
+        def pick_token(logits, req_ids, pos):
+            if sample is None:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            temperature, top_k, _seed = sample
+
+            def draw(lg, rid, p):
+                kkey = jax.random.fold_in(
+                    jax.random.fold_in(base_key, rid), p
+                )
+                lg = lg.astype(jnp.float32) / temperature
+                if 0 < top_k < lg.shape[-1]:
+                    kth = jax.lax.top_k(lg, top_k)[0][-1]
+                    lg = jnp.where(lg >= kth, lg, -jnp.inf)
+                return jax.random.categorical(kkey, lg).astype(jnp.int32)
+
+            return jax.vmap(draw)(logits, req_ids, pos)
+
+        return pick_token
+
     def build_decode_superstep(
         self,
         k: int,
@@ -919,26 +1095,7 @@ class ServingExecutor:
         if fn is not None:
             return fn
         S = self.max_seq
-        base_key = (
-            jax.random.key(sample[2]) if sample is not None else None
-        )
-
-        def pick_token(logits, req_ids, pos):
-            if sample is None:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            temperature, top_k, _seed = sample
-
-            def draw(lg, rid, p):
-                kkey = jax.random.fold_in(
-                    jax.random.fold_in(base_key, rid), p
-                )
-                lg = lg.astype(jnp.float32) / temperature
-                if 0 < top_k < lg.shape[-1]:
-                    kth = jax.lax.top_k(lg, top_k)[0][-1]
-                    lg = jnp.where(lg >= kth, lg, -jnp.inf)
-                return jax.random.categorical(kkey, lg).astype(jnp.int32)
-
-            return jax.vmap(draw)(logits, req_ids, pos)
+        pick_token = self._picker(sample)
 
         def run_scan(params, op_state, caches, pos, tok, block_table,
                      req_ids):
@@ -995,14 +1152,218 @@ class ServingExecutor:
         )
         return fn
 
+    def build_draft_prefill(self, bucket: int):
+        """Draft-side analogue of :meth:`build_prefill`: ``(draft_params,
+        op_state, tokens (1, bucket)) -> draft cache rows`` — the
+        truncated draft forward over the padded prompt, populating the
+        draft's OWN per-layer cache rows for :meth:`install` into a
+        slot of :meth:`init_draft_cache`.  One extra dispatch per
+        admission when speculating (priced by the latency model's
+        ``draft_prefill_ms``).  No token/finiteness output: the draft
+        never emits — a garbage draft row only costs acceptance."""
+        key = ("draft", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        S = self.max_seq
+
+        def prefill(params, op_state, tokens):
+            caches = {
+                name: {
+                    "k": jnp.zeros((1, S, h, hd), dt),
+                    "v": jnp.zeros((1, S, h, hd), dt),
+                }
+                for name, (h, hd, dt) in self._draft_cache_specs.items()
+            }
+            pos = jnp.zeros((1,), jnp.int32)
+            _logits, caches = self._forward(
+                params, op_state, tokens, caches, pos,
+                skip=self._draft_skip,
+            )
+            return {
+                name: {"k": c["k"][0], "v": c["v"][0]}
+                for name, c in caches.items()
+            }
+
+        fn = self._prefill_fns[key] = jax.jit(prefill)
+        _telemetry.current().emit(
+            "serving_program", kind="draft_prefill", bucket=int(bucket),
+            draft_layers=self.draft_layers,
+        )
+        return fn
+
+    def build_spec_step(
+        self,
+        d: int,
+        sample: Optional[Tuple[float, int, int]] = None,
+    ):
+        """One speculative decode round as ONE jitted dispatch
+        (SERVING.md "Speculative decoding"): d DRAFT steps against the
+        draft model's own caches propose tokens t_1..t_d, then d+1
+        VERIFY steps score ``[tok, t_1..t_d]`` against the full model
+        and the longest matching prefix is accepted in-program.
+
+        ``(params, draft_params, op_state, caches, dcaches, pos (B,),
+        tok (B,)) -> (caches, dcaches, pos, tok, (tokens (d+1, B),
+        finite (d+1, B), accepted (B,)))`` — paged inserts the block
+        table after ``dcaches``; the sampled variant appends
+        ``req_ids (B,)``, mirroring :meth:`build_decode_superstep`.
+
+        PARITY BY CONSTRUCTION: the verify scan body is the decode
+        superstep's body — the same :meth:`_forward` single-token
+        path (same kernel routing, same clamped ``min(pos+1, S-1)``
+        position walk, same :meth:`_picker` selection) — fed the
+        draft tokens instead of its own feedback.  Emitted token i
+        (i <= accepted) therefore saw exactly the history the
+        sequential decode would have at that position, so the OUTPUT
+        SEQUENCE is bit-identical to the sequential oracle (greedy
+        and keyed-sampled, padded and paged) regardless of the
+        acceptance pattern: acceptance decides dispatch count, never
+        content.  Rejected draft rows need no rollback — K/V written
+        past the accepted position is masked by the ``<= pos``
+        attention contract and overwritten as ``pos`` advances (paged
+        out-of-reservation writes land in scratch block 0).
+
+        ``d`` passes through :func:`relay_safe_steps` — the draft
+        chain counts against THE clamp site; the fused program runs
+        2d+2 single-token steps (d+1 draft — the +1 primes the draft
+        cache at the verify token's row, making the full-self-draft
+        degenerate case accept everything — plus d+1 verify), each far
+        lighter than the ~20 fused train steps the relay has always
+        tolerated."""
+        if d < 1:
+            raise ValueError(
+                f"speculate depth must be >= 1, got {d} "
+                f"(plain fused decode is build_decode_superstep)"
+            )
+        d = relay_safe_steps(d, what="speculate", log=_log)
+        if sample is not None:
+            temperature, top_k, sample_seed = sample
+            temperature = float(temperature)
+            top_k = int(top_k)
+            if temperature <= 0.0:
+                raise ValueError(
+                    f"sampling needs temperature > 0, got {temperature} "
+                    f"(greedy is sample=None)"
+                )
+            sample = (temperature, top_k, int(sample_seed))
+        key = ("spec", d, self.paged, sample)
+        fn = self._decode_fns.get(key)
+        if fn is not None:
+            return fn
+        S = self.max_seq
+        pick_token = self._picker(sample)
+
+        def run_spec(params, draft_params, op_state, caches, dcaches,
+                     pos, tok, block_table, req_ids):
+            # -- draft: d cheap steps on the truncated forward, own
+            # padded caches, proposing t_1..t_d.  The draw (when
+            # sampling) uses the SAME (seed, req_id, pos) key as the
+            # verify step at that position — identical draft/full
+            # logits then agree by construction (the full-self-draft
+            # degenerate case accepts everything).
+            def dbody(carry, _):
+                dcaches, p, t = carry
+                logits, dcaches = self._forward(
+                    draft_params, op_state, t[:, None], dcaches, p,
+                    skip=self._draft_skip,
+                )
+                nxt = pick_token(logits[:, 0], req_ids, p)
+                return (dcaches, jnp.minimum(p + 1, S - 1), nxt), nxt
+
+            # d+1 steps for d proposals: the extra step feeds the last
+            # proposal t_d at row pos+d, PRIMING the draft cache at the
+            # one position a fully-accepted round would otherwise leave
+            # as a permanent zero row (the verify token's row — the
+            # draft never sees it again once pos jumps past it).  Its
+            # own proposal is discarded; when t_d is rejected the row
+            # holds a wrong KV that the <= pos mask hides until the
+            # position walk overwrites it — the same no-rollback
+            # contract the main cache relies on.
+            (dcaches, _dp, _dt), draft_all = jax.lax.scan(
+                dbody, (dcaches, pos, tok), None, length=d + 1
+            )
+            draft_toks = draft_all[:d]
+            # -- verify: d+1 full-model steps over [tok, t_1..t_d] —
+            # the decode-superstep body fed draft tokens.
+            tok_seq = jnp.concatenate([tok[None], draft_toks], axis=0)
+
+            def vbody(carry, t_in):
+                caches, p = carry
+                logits, caches = self._forward(
+                    params, op_state, t_in[:, None], caches, p,
+                    block_table=block_table,
+                )
+                logits = logits[:, 0]                      # (B, V)
+                y = pick_token(logits, req_ids, p)
+                ok = jnp.all(
+                    jnp.isfinite(logits.astype(jnp.float32)), axis=-1
+                )
+                return (caches, jnp.minimum(p + 1, S - 1)), (y, ok)
+
+            (caches, _vp), (ys, oks) = jax.lax.scan(
+                vbody, (caches, pos), tok_seq
+            )
+            # -- accept the longest matching prefix: draft token
+            # t_{i+1} survives iff it equals verified token y_i; the
+            # first mismatch's y is the (free) correction token, so
+            # every round emits accepted+1 tokens.
+            matches = (draft_toks == ys[:d]).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(matches, axis=0), axis=0)
+            new_pos = jnp.minimum(pos + accepted + 1, S - 1)
+            next_tok = jnp.take_along_axis(
+                ys, accepted[None, :], axis=0
+            )[0]
+            return caches, dcaches, new_pos, next_tok, (ys, oks, accepted)
+
+        if self.paged and sample is not None:
+            def spec(params, draft_params, op_state, caches, dcaches,
+                     block_table, pos, tok, req_ids):
+                return run_spec(params, draft_params, op_state, caches,
+                                dcaches, pos, tok, block_table, req_ids)
+            donate = (3, 4, 6, 7)
+        elif self.paged:
+            def spec(params, draft_params, op_state, caches, dcaches,
+                     block_table, pos, tok):
+                return run_spec(params, draft_params, op_state, caches,
+                                dcaches, pos, tok, block_table, None)
+            donate = (3, 4, 6, 7)
+        elif sample is not None:
+            def spec(params, draft_params, op_state, caches, dcaches,
+                     pos, tok, req_ids):
+                return run_spec(params, draft_params, op_state, caches,
+                                dcaches, pos, tok, None, req_ids)
+            donate = (3, 4, 5, 6)
+        else:
+            def spec(params, draft_params, op_state, caches, dcaches,
+                     pos, tok):
+                return run_spec(params, draft_params, op_state, caches,
+                                dcaches, pos, tok, None, None)
+            donate = (3, 4, 5, 6)
+
+        fn = self._decode_fns[key] = jax.jit(
+            spec, donate_argnums=donate
+        )
+        _telemetry.current().emit(
+            "serving_program", kind="spec", d=int(d),
+            draft_layers=self.draft_layers,
+            layout="paged" if self.paged else "padded",
+            sharded=self.shard is not None,
+            sampled=sample is not None,
+        )
+        return fn
+
     # -- compute-free mode ---------------------------------------------------
 
-    def abstract_programs(self, decode_steps: int = 8):
+    def abstract_programs(self, decode_steps: int = 8,
+                          speculate: int = 0):
         """``jax.eval_shape`` over every prefill bucket and the decode
         superstep — the serving DRY RUN (no device compute): validates
         the whole forward-only graph, the cache protocol and the scan,
         and returns the program table ``{"prefill": {bucket: logits
-        aval...}, "decode": ...}``."""
+        aval...}, "decode": ...}``.  ``speculate=d`` additionally
+        traces the draft prefill and the fused spec round, adding a
+        ``"spec"`` entry (the (d+1, B) verified-token aval)."""
         from flexflow_tpu.runtime.executor import Executor
 
         params, _opt, op_state = Executor(
@@ -1048,6 +1409,28 @@ class ServingExecutor:
                 params, op_state, caches, pos, tok,
             )
         out["decode"] = toks
+        if speculate:
+            dcaches = {
+                name: {
+                    "k": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+                    "v": jax.ShapeDtypeStruct((B, S, h, hd), dt),
+                }
+                for name, (h, hd, dt) in self._draft_cache_specs.items()
+            }
+            for bucket in self.buckets:
+                toks_in = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+                jax.eval_shape(
+                    self.build_draft_prefill(bucket),
+                    params, op_state, toks_in,
+                )
+            spec_args = (params, params, op_state, caches, dcaches)
+            if self.paged:
+                spec_args += (bt,)
+            spec_args += (pos, tok)
+            _, _, _, _, (ys, okf, acc) = jax.eval_shape(
+                self.build_spec_step(speculate), *spec_args
+            )
+            out["spec"] = ys
         return out
 
 
@@ -1077,12 +1460,26 @@ class Server:
         sample_seed: int = 0,
         journal=None,
         drain_on_preempt: bool = False,
+        speculate: int = 0,
+        draft_params=None,
     ):
         self.ex = executor
         self.params = params
         self.op_state = op_state
         self.decode_steps = relay_safe_steps(
             decode_steps, what="decode_steps", log=_log
+        )
+        #: Speculative draft depth d (0 = the plain fused superstep).
+        #: The draft chain counts against THE relay clamp site.
+        self.speculate = (
+            relay_safe_steps(speculate, what="speculate", log=_log)
+            if speculate else 0
+        )
+        #: Draft model params: a separate same-architecture draft
+        #: checkpoint, or (default) the serving params themselves —
+        #: self-drafting, truncated by the executor's ``draft_layers``.
+        self.draft_params = (
+            draft_params if draft_params is not None else params
         )
         self.eos_id = eos_id
         self.injector = fault_injector
@@ -1108,7 +1505,15 @@ class Server:
         tel = _telemetry.current()
         ex = self.ex
         B, k = ex.max_batch, self.decode_steps
-        decode_fn = ex.build_decode_superstep(k, sample=self.sample)
+        spec_d = self.speculate
+        if spec_d:
+            decode_fn = None
+            spec_fn = ex.build_spec_step(spec_d, sample=self.sample)
+            dcaches = ex.init_draft_cache()
+        else:
+            decode_fn = ex.build_decode_superstep(k, sample=self.sample)
+            spec_fn = None
+            dcaches = None
         caches = ex.init_cache()
         ledger = ex.make_ledger() if ex.paged else None
         block_table = (
@@ -1125,6 +1530,10 @@ class Server:
         total_tokens = 0
         supersteps = 0
         prefills = 0
+        draft_prefills = 0
+        decode_tokens = 0
+        spec_accept_total = 0
+        spec_draft_total = 0
         decode_s = 0.0
         t_run0 = time.perf_counter()
         # -- journal replay: completed requests are NOT re-run,
@@ -1331,6 +1740,20 @@ class Server:
                         caches = ex.install_paged(caches, rows, row)
                     else:
                         caches = ex.install(caches, rows, slot_i)
+                    if spec_d:
+                        # Populate the DRAFT model's own cache rows —
+                        # one extra dispatch per admission, priced by
+                        # the latency model's draft_prefill_ms.  No
+                        # fence: nothing to read back, and the next
+                        # spec round synchronizes.
+                        dpf = ex.build_draft_prefill(bucket)
+                        dargs = (self.draft_params, self.op_state,
+                                 padded)
+                        tel.program_cost("draft_prefill", dpf, dargs,
+                                         bucket=bucket)
+                        drows = dpf(*dargs)
+                        dcaches = ex.install(dcaches, drows, slot_i)
+                        draft_prefills += 1
                     sl = _Slot(
                         request=r, pos=flen, last_tok=int(tok0),
                         tokens=[int(tok0)], t_eligible=t_run0,
@@ -1363,39 +1786,73 @@ class Server:
                 tok_vec = np.array(
                     [sl.last_tok if sl else 0 for sl in slots], np.int32
                 )
-                args = (self.params, self.op_state, caches)
-                if block_table is not None:
-                    args += (block_table.copy(),)
-                args += (pos_vec, tok_vec)
+                req_vec = None
                 if self.sample is not None:
-                    args += (np.array(
+                    req_vec = np.array(
                         [sl.request.id if sl else 0 for sl in slots],
                         np.int32
-                    ),)
+                    )
                 t_call = time.perf_counter()
-                tel.program_cost("decode_superstep", decode_fn, args, k=k)
-                caches, _pos, _tok, (toks, oks) = decode_fn(*args)
-                host_toks, host_oks = tel.fence(
-                    (toks, oks), "decode_superstep"
-                )
+                if spec_d:
+                    # -- one fused speculative round: d+1 draft steps
+                    # + d+1 verify steps, one dispatch, one fence
+                    # reading (tokens, finite, accepted).
+                    args = (self.params, self.draft_params,
+                            self.op_state, caches, dcaches)
+                    if block_table is not None:
+                        args += (block_table.copy(),)
+                    args += (pos_vec, tok_vec)
+                    if req_vec is not None:
+                        args += (req_vec,)
+                    tel.program_cost("spec_verify", spec_fn, args,
+                                     d=spec_d)
+                    caches, dcaches, _pos, _tok, (toks, oks, acc) = \
+                        spec_fn(*args)
+                    host_toks, host_oks, host_acc = tel.fence(
+                        (toks, oks, acc), "spec_verify"
+                    )
+                    k_eff = spec_d + 1
+                else:
+                    args = (self.params, self.op_state, caches)
+                    if block_table is not None:
+                        args += (block_table.copy(),)
+                    args += (pos_vec, tok_vec)
+                    if req_vec is not None:
+                        args += (req_vec,)
+                    tel.program_cost("decode_superstep", decode_fn,
+                                     args, k=k)
+                    caches, _pos, _tok, (toks, oks) = decode_fn(*args)
+                    host_toks, host_oks = tel.fence(
+                        (toks, oks), "decode_superstep"
+                    )
+                    host_acc = None
+                    k_eff = k
                 wall = time.perf_counter() - t_call
                 decode_s += wall
                 supersteps += 1
                 superstep_idx += 1
                 # Training-superstep accounting: ONE host program and
-                # one fence covered k decode steps (programs/step ==
-                # 1/k).
-                tel.add_programs(1, steps=k)
-                tel.emit("decode_superstep", k=k, active=len(active),
-                         wall_s=round(wall, 6))
-                for j in range(k):
-                    tel.record_step((supersteps - 1) * k + j,
-                                    wall_s=wall / k)
+                # one fence covered k_eff decode steps (programs/step
+                # == 1/k_eff).
+                tel.add_programs(1, steps=k_eff)
+                if not spec_d:
+                    tel.emit("decode_superstep", k=k, active=len(active),
+                             wall_s=round(wall, 6))
+                for j in range(k_eff):
+                    tel.record_step((supersteps - 1) * k_eff + j,
+                                    wall_s=wall / k_eff)
+                n_active = len(active)
+                emitted_round = 0
                 for i in active:
                     sl = slots[i]
                     err = None
                     appended: List[int] = []
-                    for j in range(k):
+                    if spec_d:
+                        n_take = int(host_acc[i]) + 1
+                        spec_accept_total += int(host_acc[i])
+                    else:
+                        n_take = k
+                    for j in range(n_take):
                         if not bool(host_oks[j, i]):
                             err = "non-finite logits in decode"
                             break
@@ -1407,14 +1864,29 @@ class Server:
                         if slot_done(sl):
                             break
                     sl.last_tok = sl.tokens[-1] if sl.tokens else 0
+                    decode_tokens += len(appended)
+                    emitted_round += len(appended)
                     # Journal the fence-validated delta BEFORE any done
-                    # record so replay accumulation sees tokens first.
+                    # record so replay accumulation sees tokens first —
+                    # under speculation, ``appended`` holds ACCEPTED
+                    # tokens only (rejected draft never reaches the
+                    # host), so resume semantics are unchanged.
                     if jr is not None and appended:
                         jr.tokens(sl.request.id, appended)
                     if err is not None:
                         finish(i, error=err)
                     elif slot_done(sl):
                         finish(i)
+                if spec_d:
+                    acc_round = int(sum(
+                        int(host_acc[i]) for i in active
+                    ))
+                    spec_draft_total += spec_d * n_active
+                    tel.emit("spec_verify", d=spec_d, active=n_active,
+                             accepted=acc_round,
+                             draft=spec_d * n_active,
+                             emitted=emitted_round,
+                             wall_s=round(wall, 6))
         finally:
             preempt.__exit__(None, None, None)
             if jr is not None:
@@ -1453,6 +1925,24 @@ class Server:
         if ex.paged:
             stats["kv_block"] = ex.kv_block
             stats["kv_blocks"] = ex.kv_blocks
+        if self.speculate:
+            stats["speculate"] = self.speculate
+            stats["draft_layers"] = ex.draft_layers
+            stats["draft_prefills"] = draft_prefills
+            stats["spec_acceptance_rate"] = round(
+                spec_accept_total / max(spec_draft_total, 1), 4
+            )
+            stats["spec_tokens_per_dispatch"] = round(
+                decode_tokens / max(supersteps, 1), 3
+            )
+            # Final-rounded into the run_end summary block;
+            # reconstruct_summary recomputes both from the raw
+            # spec_verify events and must match bit-for-bit.
+            tel.note_summary(
+                spec_acceptance_rate=stats["spec_acceptance_rate"],
+                spec_tokens_per_dispatch=stats[
+                    "spec_tokens_per_dispatch"],
+            )
         if self.drain_on_preempt:
             stats["drained"] = drained
         return results, tel.fold_stats(stats)

@@ -23,7 +23,10 @@ wedges recovery):
   the first generated token (absent on a non-finite prefill),
   ``resumed`` the carried-token count of a re-admission.
 - ``sv_tokens`` {id, toks} — the fence-validated tokens one slot
-  appended in one decode superstep.
+  appended in one decode superstep.  Under speculative decoding this
+  is the ACCEPTED prefix (+ the verify token) only: rejected draft
+  tokens never reach the host, so a journal from a speculating run
+  replays and resumes exactly like a plain-decode one.
 - ``sv_done``   {id, plen, n, error, ...metrics} — the request left
   the loop (completed, errored, shed, expired or rejected); carries
   the rounded virtual-clock split so a resumed run's stats cover the

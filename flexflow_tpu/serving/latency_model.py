@@ -15,6 +15,14 @@ Program shapes being priced (runtime/serving.py):
   steps over the whole slot batch ->
   ``dispatch_ms + fence_ms + k * decode_token_ms``
   (batch-width-free: the batch dim rides inside the one program).
+- speculative round d: one dispatch + one fence + d+1 draft steps on
+  the truncated model (the +1 primes the draft cache at the verify
+  token's row) + d+1 verify steps on the full model ->
+  ``dispatch_ms + fence_ms + (d + 1) * draft_token_ms
+  + (d + 1) * decode_token_ms`` — the verify scan IS the decode
+  superstep body, so its slope is ``decode_token_ms``; only the cheap
+  draft chain gets its own slope.  Draft prefill (one per admission in
+  spec mode) prices like a prefill of the same bucket.
 
 The scheduler's virtual clock advances by exactly these quantities, so
 "predicted" and "scheduled" time are the same number by construction —
@@ -32,6 +40,9 @@ from typing import Any, Dict, Iterable, Optional
 #: regime the real box measures (BASELINE.md ~16 ms/call).
 DEFAULT_PREFILL_TOKEN_MS = 0.05
 DEFAULT_DECODE_TOKEN_MS = 0.2
+#: Draft steps run the truncated (or small) model — cheaper than a
+#: full decode step, costlier than free.
+DEFAULT_DRAFT_TOKEN_MS = 0.1
 
 
 @dataclasses.dataclass
@@ -40,10 +51,11 @@ class ServingLatencyModel:
     fence_ms: float
     prefill_token_ms: float = DEFAULT_PREFILL_TOKEN_MS
     decode_token_ms: float = DEFAULT_DECODE_TOKEN_MS
+    draft_token_ms: float = DEFAULT_DRAFT_TOKEN_MS
     calibrated: bool = False
     source: Optional[str] = None
 
-    # -- the two program prices ---------------------------------------------
+    # -- the program prices --------------------------------------------------
 
     def prefill_ms(self, bucket: int) -> float:
         return self.dispatch_ms + self.fence_ms + \
@@ -52,13 +64,27 @@ class ServingLatencyModel:
     def decode_ms(self, k: int) -> float:
         return self.dispatch_ms + self.fence_ms + k * self.decode_token_ms
 
+    def spec_ms(self, d: int) -> float:
+        """One speculative round: d+1 draft + d+1 verify steps fused
+        behind one dispatch/fence pair."""
+        return self.dispatch_ms + self.fence_ms + \
+            (d + 1) * self.draft_token_ms + (d + 1) * self.decode_token_ms
+
+    def draft_prefill_ms(self, bucket: int) -> float:
+        """Draft-cache prefill at admission (spec mode only): a
+        second prefill-shaped dispatch over the truncated model —
+        priced like the full prefill (conservative; the dispatch
+        floor dominates on the relay anyway)."""
+        return self.prefill_ms(bucket)
+
     def describe(self) -> str:
         tag = f"calibrated from {self.source}" if self.calibrated else \
             "uncalibrated defaults"
         return (f"serving latency model ({tag}): dispatch "
                 f"{self.dispatch_ms:.3f} + fence {self.fence_ms:.3f} ms, "
                 f"prefill {self.prefill_token_ms:.4f} ms/token, decode "
-                f"{self.decode_token_ms:.4f} ms/token")
+                f"{self.decode_token_ms:.4f} ms/token, draft "
+                f"{self.draft_token_ms:.4f} ms/token")
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -66,6 +92,7 @@ class ServingLatencyModel:
             "fence_ms": round(self.fence_ms, 4),
             "prefill_token_ms": round(self.prefill_token_ms, 5),
             "decode_token_ms": round(self.decode_token_ms, 5),
+            "draft_token_ms": round(self.draft_token_ms, 5),
             "calibrated": self.calibrated,
             "source": self.source,
         }
@@ -93,11 +120,14 @@ class ServingLatencyModel:
                    source: Optional[str] = None) -> "ServingLatencyModel":
         """Fit the per-token slopes from a serving run's own raw
         events (``prefill`` carries ``bucket``/``wall_s``;
-        ``decode_superstep`` carries ``k``/``wall_s``): slope = median
-        of ``(wall_ms - dispatch_ms - fence_ms) / tokens``, floored at
-        0 — one robust point per event, no regression machinery.
-        Returns a NEW model; self is untouched."""
-        pf, dc = [], []
+        ``decode_superstep`` carries ``k``/``wall_s``; ``spec_verify``
+        carries ``d``/``wall_s``): slope = median of ``(wall_ms -
+        dispatch_ms - fence_ms) / tokens``, floored at 0 — one robust
+        point per event, no regression machinery.  The draft slope is
+        the spec-round residual AFTER the (possibly just-fitted)
+        decode slope prices the d+1 verify steps.  Returns a NEW
+        model; self is untouched."""
+        pf, dc, sp = [], [], []
         overhead = self.dispatch_ms + self.fence_ms
         for ev in events:
             kind = ev.get("ev")
@@ -110,6 +140,8 @@ class ServingLatencyModel:
                           / float(ev["bucket"]))
             elif kind == "decode_superstep" and ev.get("k"):
                 dc.append(max(wall_ms - overhead, 0.0) / float(ev["k"]))
+            elif kind == "spec_verify" and ev.get("d"):
+                sp.append((float(ev["d"]), max(wall_ms - overhead, 0.0)))
 
         def med(xs, default):
             if not xs:
@@ -117,12 +149,18 @@ class ServingLatencyModel:
             xs = sorted(xs)
             return xs[len(xs) // 2]
 
+        decode_slope = med(dc, self.decode_token_ms)
+        draft = med(
+            [max(w - (d + 1) * decode_slope, 0.0) / (d + 1) for d, w in sp],
+            self.draft_token_ms,
+        )
         return ServingLatencyModel(
             dispatch_ms=self.dispatch_ms,
             fence_ms=self.fence_ms,
             prefill_token_ms=med(pf, self.prefill_token_ms),
-            decode_token_ms=med(dc, self.decode_token_ms),
-            calibrated=self.calibrated or bool(pf or dc),
+            decode_token_ms=decode_slope,
+            draft_token_ms=draft,
+            calibrated=self.calibrated or bool(pf or dc or sp),
             source=source or self.source,
         )
 

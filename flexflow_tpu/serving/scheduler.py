@@ -37,12 +37,25 @@ scheduler (SERVING.md "Scheduler policy"):
   (largest tier, latest deadline) are refused with a ``request_shed``
   event — the overload valve, deterministic across replays.
 
+- **Speculation.**  ``speculate=d`` switches the decode phase to the
+  executor's fused speculative round (``build_spec_step``): one
+  dispatch drafts d tokens on the truncated/draft model and verifies
+  d+1 against the full model, the virtual clock advances by
+  ``spec_ms(d)``, and each slot consumes ``accepted + 1`` tokens.
+  Admission pays one extra draft-prefill dispatch
+  (``draft_prefill_ms``).  Adaptive-k is a plain-decode concept and is
+  bypassed — d is fixed per run (a ``--serve-auto`` knob, not a
+  per-superstep choice).
+
 A compute-free **simulate** mode runs the same loop against fabricated
 tokens (no jax, no device): the serving-config search prices
 candidates with the exact decision logic that will run them, and the
 dispatch-count accounting (prefills, supersteps) of a simulated run
 matches the real run's telemetry counters exactly (EOS disabled —
-token VALUES are the only thing simulation cannot know).
+token VALUES are the only thing simulation cannot know; in spec mode
+the simulated draft accepts fully, so exactness additionally requires
+a fully-accepting draft — acceptance VALUES are the other
+unknowable).
 """
 
 from __future__ import annotations
@@ -232,12 +245,17 @@ class _RealEngine:
     simulated = False
 
     def __init__(self, ex: ServingExecutor, params, op_state,
-                 sample=None):
+                 sample=None, speculate: int = 0, draft_params=None):
         self.ex = ex
         self.params = params
         self.op_state = op_state
         self.sample = sample
+        self.speculate = speculate
         self.caches = ex.init_cache()
+        if speculate:
+            self.draft_params = (draft_params if draft_params is not None
+                                 else params)
+            self.dcaches = ex.init_draft_cache()
 
     def prefill(self, prompt: np.ndarray, bucket: int, slot_i: int,
                 row: Optional[np.ndarray] = None,
@@ -289,6 +307,47 @@ class _RealEngine:
         host_toks, host_oks = tel.fence((toks, oks), "decode_superstep")
         return host_toks, host_oks, time.perf_counter() - t0
 
+    def draft_prefill(self, prompt: np.ndarray, bucket: int,
+                      slot_i: int):
+        """Populate the draft model's own cache rows for ``slot_i`` —
+        the spec-mode admission's second dispatch.  No fence (nothing
+        to read back; the next spec round synchronizes)."""
+        tel = _telemetry.current()
+        ex = self.ex
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = np.asarray(prompt, np.int32)
+        t0 = time.perf_counter()
+        dpf = ex.build_draft_prefill(bucket)
+        dargs = (self.draft_params, self.op_state, padded)
+        tel.program_cost("draft_prefill", dpf, dargs, bucket=bucket)
+        drows = dpf(*dargs)
+        self.dcaches = ex.install(self.dcaches, drows, slot_i)
+        return time.perf_counter() - t0
+
+    def spec(self, pos_vec: np.ndarray, tok_vec: np.ndarray, d: int,
+             block_table: Optional[np.ndarray] = None,
+             req_ids: Optional[np.ndarray] = None):
+        """One fused speculative round (draft d + verify d+1) over the
+        whole slot batch: ``(tokens (d+1, B), finite (d+1, B),
+        accepted (B,), wall_s)`` after one fence."""
+        tel = _telemetry.current()
+        fn = self.ex.build_spec_step(d, sample=self.sample)
+        args = (self.params, self.draft_params, self.op_state,
+                self.caches, self.dcaches)
+        if block_table is not None:
+            args += (block_table,)
+        args += (pos_vec, tok_vec)
+        if self.sample is not None:
+            args += (np.asarray(req_ids, np.int32),)
+        t0 = time.perf_counter()
+        tel.program_cost("spec_verify", fn, args, d=d)
+        self.caches, self.dcaches, _pos, _tok, (toks, oks, acc) = \
+            fn(*args)
+        host_toks, host_oks, host_acc = tel.fence(
+            (toks, oks, acc), "spec_verify"
+        )
+        return host_toks, host_oks, host_acc, time.perf_counter() - t0
+
 
 class _SimEngine:
     """Compute-free engine: fabricated (finite) tokens, zero wall.
@@ -311,6 +370,20 @@ class _SimEngine:
         toks = np.ones((k, B), np.int32)
         oks = np.ones((k, B), bool)
         return toks, oks, 0.0
+
+    def draft_prefill(self, prompt, bucket, slot_i):
+        return 0.0
+
+    def spec(self, pos_vec, tok_vec, d, block_table=None, req_ids=None):
+        # Fabricated FULL acceptance: token values (and hence the
+        # accept/reject pattern) are what simulation cannot know, so
+        # the exactness contract is stated against a fully-accepting
+        # draft (see the module docstring).
+        B = len(pos_vec)
+        toks = np.ones((d + 1, B), np.int32)
+        oks = np.ones((d + 1, B), bool)
+        acc = np.full(B, d, np.int64)
+        return toks, oks, acc, 0.0
 
 
 @dataclasses.dataclass
@@ -355,6 +428,8 @@ class ScheduledServer:
         resilience: Optional[ServingResilience] = None,
         journal=None,
         fault_injector=None,
+        speculate: int = 0,
+        draft_params=None,
         _engine=None,
     ):
         from flexflow_tpu.runtime.trainer import relay_safe_steps
@@ -365,6 +440,13 @@ class ScheduledServer:
         self.decode_steps = relay_safe_steps(
             decode_steps, what="decode_steps", log=_log
         )
+        #: Speculative draft depth (0 = plain fused decode).  The
+        #: clamp site stays relay_safe_steps — the draft chain counts
+        #: against it like every other fused chain.
+        self.speculate = relay_safe_steps(
+            speculate, what="speculate", log=_log
+        ) if speculate else 0
+        self._draft_params = draft_params
         self.eos_id = eos_id
         # In-program sampling (replayable: draws are keyed by
         # (seed, request id, position), so preemption/resume and any
@@ -404,16 +486,19 @@ class ScheduledServer:
         resilience: Optional[ServingResilience] = None,
         journal=None,
         fault_injector=None,
+        speculate: int = 0,
     ) -> "ScheduledServer":
         """The compute-free pricing loop (no jax touched): identical
         decisions and dispatch counts to a real run of the same
         (workload, config, policy) with EOS off — INCLUDING through
         retries and engine restarts when the same ``fault_injector``
-        plan drives both (the ``--serve-auto`` exactness contract)."""
+        plan drives both (the ``--serve-auto`` exactness contract).
+        With ``speculate=d`` the simulated draft accepts fully, so
+        exactness additionally requires a fully-accepting draft."""
         return cls(shape, None, None, decode_steps=decode_steps,
                    eos_id=None, policy=policy, latency_model=latency_model,
                    resilience=resilience, journal=journal,
-                   fault_injector=fault_injector,
+                   fault_injector=fault_injector, speculate=speculate,
                    _engine=_SimEngine(shape))
 
     # -- engine (re)build + the degraded-mode ladder ------------------------
@@ -437,7 +522,9 @@ class ScheduledServer:
         while True:
             try:
                 return _RealEngine(ex, self._params, self._op_state,
-                                   sample=self.sample)
+                                   sample=self.sample,
+                                   speculate=self.speculate,
+                                   draft_params=self._draft_params)
             except DeviceMemoryError:
                 if ex.paged:
                     nb = ex.kv_blocks // 2
@@ -525,7 +612,8 @@ class ScheduledServer:
         e2es: Dict[int, float] = {}
         slo_oks: Dict[int, bool] = {}
         sheds = preempts = prefills = supersteps = 0
-        total_tokens = 0
+        draft_prefills = spec_accept_total = spec_draft_total = 0
+        total_tokens = decode_tokens = 0
         decode_s = 0.0
         t_wall0 = time.perf_counter()
         # -- the failure model (SERVING.md "Failure model") --
@@ -655,6 +743,10 @@ class ScheduledServer:
                     if sl is not None]
             if not rems:
                 return 0.0
+            if self.speculate:
+                d = self.speculate
+                return model.spec_ms(d) * math.ceil(
+                    max(min(rems), 1) / (d + 1))
             k = self._choose_k(slots, len(waiting))
             return model.decode_ms(k) * math.ceil(max(min(rems), 1) / k)
 
@@ -668,10 +760,17 @@ class ScheduledServer:
                 return None
             slack = cand.deadline_ms - vclock
             bucket = ex.bucket_for(len(cand.prompt))
-            need = model.prefill_ms(bucket) + model.decode_ms(
-                self._k_candidates[0]
-            ) * math.ceil(max(cand.max_new_tokens, 1)
-                          / self._k_candidates[0])
+            if self.speculate:
+                d = self.speculate
+                need = model.prefill_ms(bucket) + \
+                    model.draft_prefill_ms(bucket) + \
+                    model.spec_ms(d) * math.ceil(
+                        max(cand.max_new_tokens, 1) / (d + 1))
+            else:
+                need = model.prefill_ms(bucket) + model.decode_ms(
+                    self._k_candidates[0]
+                ) * math.ceil(max(cand.max_new_tokens, 1)
+                              / self._k_candidates[0])
             if slack >= projected_free_ms() + need or slack < need:
                 # Feasible by waiting, or already lost: don't evict.
                 return None
@@ -727,7 +826,7 @@ class ScheduledServer:
             return True
 
         def admit(r: Request, slot_i: int):
-            nonlocal vclock, prefills, total_tokens
+            nonlocal vclock, prefills, draft_prefills, total_tokens
             waiting.remove(r)
             admit_v0, prior, n_pre = carried.pop(r.id, (vclock, [], 0))
             if prior and resume_done(r, prior, admit_v0):
@@ -756,6 +855,8 @@ class ScheduledServer:
                     (w.priority for w in others), default=None),
             )
             vclock += model.prefill_ms(bucket)
+            if self.speculate:
+                vclock += model.draft_prefill_ms(bucket)
             row = None
             if ledger is not None:
                 row = ledger.alloc(slot_i, ledger.blocks_for(
@@ -766,6 +867,12 @@ class ScheduledServer:
                     full, bucket, slot_i, row=row,
                     plen=len(r.prompt), rid=r.id,
                 )
+                if self.speculate and ok:
+                    # The draft cache's own prefill — spec mode's
+                    # second admission dispatch (no fence).
+                    pf_s += self.engine.draft_prefill(
+                        full, bucket, slot_i
+                    )
             except (RuntimeError, OSError) as e:
                 if res is None or isinstance(e, ServingFault):
                     raise
@@ -778,6 +885,8 @@ class ScheduledServer:
                 waiting.append(r)
                 raise ServingEngineFault(str(e)) from e
             prefills += 1
+            if self.speculate and ok:
+                draft_prefills += 1
             tel.emit("prefill", id=r.id, bucket=bucket,
                      wall_s=round(pf_s, 6))
             if jr is not None:
@@ -1027,13 +1136,27 @@ class ScheduledServer:
                 else:
                     sim_nan = None
 
-                # -- one fused decode superstep over the whole batch --
-                k = self._choose_k(slots, len(waiting))
-                tel.emit("sched_decision", k=k, active=len(active),
-                         waiting=len(waiting), policy=pol.name,
-                         vclock_ms=round(vclock, 3))
-                log("decode", k=k, active=len(active),
-                    waiting=len(waiting))
+                # -- one fused decode superstep (or speculative
+                # round) over the whole batch --
+                spec_d = self.speculate
+                if spec_d:
+                    # d is a per-run knob (serve-auto searches it);
+                    # adaptive-k is a plain-decode concept.
+                    k_eff = spec_d + 1
+                    tel.emit("sched_decision", d=spec_d,
+                             active=len(active), waiting=len(waiting),
+                             policy=pol.name,
+                             vclock_ms=round(vclock, 3))
+                    log("spec", depth=spec_d, active=len(active),
+                        waiting=len(waiting))
+                else:
+                    k = self._choose_k(slots, len(waiting))
+                    k_eff = k
+                    tel.emit("sched_decision", k=k, active=len(active),
+                             waiting=len(waiting), policy=pol.name,
+                             vclock_ms=round(vclock, 3))
+                    log("decode", k=k, active=len(active),
+                        waiting=len(waiting))
                 pos_vec = np.array(
                     [sl.pos if sl else 0 for sl in slots], np.int32
                 )
@@ -1044,14 +1167,26 @@ class ScheduledServer:
                     [sl.request.id if sl else 0 for sl in slots],
                     np.int32
                 )
-                vclock += model.decode_ms(k)
+                vclock += (model.spec_ms(spec_d) if spec_d
+                           else model.decode_ms(k))
                 try:
-                    toks, oks, wall = self.engine.decode(
-                        pos_vec, tok_vec, k,
-                        block_table=(block_table.copy()
-                                     if ledger is not None else None),
-                        req_ids=req_vec,
-                    )
+                    if spec_d:
+                        toks, oks, accs, wall = self.engine.spec(
+                            pos_vec, tok_vec, spec_d,
+                            block_table=(block_table.copy()
+                                         if ledger is not None
+                                         else None),
+                            req_ids=req_vec,
+                        )
+                    else:
+                        toks, oks, wall = self.engine.decode(
+                            pos_vec, tok_vec, k,
+                            block_table=(block_table.copy()
+                                         if ledger is not None
+                                         else None),
+                            req_ids=req_vec,
+                        )
+                        accs = None
                 except (RuntimeError, OSError) as e:
                     if res is None:
                         raise
@@ -1069,21 +1204,28 @@ class ScheduledServer:
                 supersteps += 1
                 superstep_idx += 1
                 # Training-superstep accounting: one host program +
-                # one fence covered k decode steps
-                # (programs/step == 1/k).
-                tel.add_programs(1, steps=k)
-                tel.emit("decode_superstep", k=k, active=len(active),
-                         wall_s=round(wall, 6))
-                for j in range(k):
-                    tel.record_step((supersteps - 1) * k + j,
-                                    wall_s=wall / k)
+                # one fence covered k_eff decode steps
+                # (programs/step == 1/k_eff).
+                tel.add_programs(1, steps=k_eff)
+                if not spec_d:
+                    tel.emit("decode_superstep", k=k,
+                             active=len(active), wall_s=round(wall, 6))
+                for j in range(k_eff):
+                    tel.record_step((supersteps - 1) * k_eff + j,
+                                    wall_s=wall / k_eff)
+                emitted_round = 0
                 for i in active:
                     sl = slots[i]
                     if sl is None:
                         continue
                     err = None
                     appended: List[int] = []
-                    for j in range(k):
+                    if spec_d:
+                        n_take = int(accs[i]) + 1
+                        spec_accept_total += int(accs[i])
+                    else:
+                        n_take = k
+                    for j in range(n_take):
                         if not bool(oks[j, i]):
                             err = "non-finite logits in decode"
                             break
@@ -1095,14 +1237,26 @@ class ScheduledServer:
                         if slot_done(sl):
                             break
                     sl.last_tok = sl.tokens[-1] if sl.tokens else 0
+                    decode_tokens += len(appended)
+                    emitted_round += len(appended)
                     # Journal the fence-validated token delta BEFORE
-                    # any completion record (replay folds in order).
+                    # any completion record (replay folds in order) —
+                    # under speculation ``appended`` holds ACCEPTED
+                    # tokens only, so resume semantics are unchanged.
                     if jr is not None and appended:
                         jr.tokens(sl.request.id, appended)
                     if err is not None:
                         slot_fault(i, err)
                     elif slot_done(sl):
                         finish_slot(i)
+                if spec_d:
+                    acc_round = int(sum(int(accs[i]) for i in active))
+                    spec_draft_total += spec_d * len(active)
+                    tel.emit("spec_verify", d=spec_d,
+                             active=len(active), accepted=acc_round,
+                             draft=spec_d * len(active),
+                             emitted=emitted_round,
+                             wall_s=round(wall, 6))
         finally:
             preempt.__exit__(None, None, None)
             if jr is not None:
@@ -1118,6 +1272,16 @@ class ScheduledServer:
         stats = self._stats(results, qwaits, e2es, slo_oks, sheds,
                             preempts, prefills, supersteps,
                             total_tokens, decode_s, elapsed)
+        if self.speculate:
+            stats["speculate"] = self.speculate
+            stats["draft_layers"] = getattr(self.ex, "draft_layers", 0)
+            stats["draft_prefills"] = draft_prefills
+            stats["spec_acceptance_rate"] = round(
+                spec_accept_total / max(spec_draft_total, 1), 4
+            )
+            stats["spec_tokens_per_dispatch"] = round(
+                decode_tokens / max(supersteps, 1), 3
+            )
         stats["request_retries"] = retries
         stats["request_expiries"] = expiries
         stats["engine_restarts"] = restarts
@@ -1133,6 +1297,7 @@ class ScheduledServer:
                 "queue_wait_ms_p99", "request_sheds",
                 "request_preempts", "request_retries",
                 "request_expiries", "engine_restarts",
+                "spec_acceptance_rate", "spec_tokens_per_dispatch",
             ) if kk in stats
         }, **({"slo_attainment": stats["slo_attainment"]}
               if "slo_attainment" in stats else {}))

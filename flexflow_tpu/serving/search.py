@@ -1,7 +1,8 @@
 """`--serve-auto`: the serving-config search (SEARCH.md mold).
 
 Searches (bucket boundaries x decode K x max_batch x scheduler policy
-knobs) against the calibrated serving latency model, pricing every
+knobs, plus speculative draft depth d when the baseline speculates)
+against the calibrated serving latency model, pricing every
 candidate by SIMULATING the real scheduler loop over the real workload
 (``ScheduledServer.simulated`` — the same decision code that will run
 the winner, so predicted dispatch counts are the executed dispatch
@@ -59,6 +60,11 @@ class ServingConfig:
     #: Mesh shard (n, c) — carried through to the executor, not
     #: searched (the device count is a deployment fact, not a knob).
     shard: Optional[Tuple[int, int]] = None
+    #: Speculative draft depth (SERVING.md "Speculative decoding"):
+    #: 0 = plain fused decode.  Searched only when the baseline
+    #: speculates — the draft SOURCE (checkpoint / truncation) is a
+    #: deployment fact like the shard; d is the knob.
+    speculate: int = 0
 
     def __post_init__(self):
         from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
@@ -73,6 +79,11 @@ class ServingConfig:
                 f"decode_steps must be in [1, "
                 f"{MAX_DECODE_STEPS_PER_CALL}]: {self.decode_steps}"
             )
+        if not (0 <= self.speculate <= MAX_DECODE_STEPS_PER_CALL):
+            raise ValueError(
+                f"speculate must be in [0, "
+                f"{MAX_DECODE_STEPS_PER_CALL}]: {self.speculate}"
+            )
 
     def shape(self) -> SlotShape:
         return SlotShape(max_batch=self.max_batch, max_seq=self.max_seq,
@@ -86,6 +97,8 @@ class ServingConfig:
             bits += f" kv={self.kv_blocks}x{self.kv_block}"
         if self.shard is not None:
             bits += f" shard={self.shard[0]}x{self.shard[1]}"
+        if self.speculate > 0:
+            bits += f" spec={self.speculate}"
         return bits + f" policy={self.policy.describe()}"
 
     def to_json(self) -> Dict[str, Any]:
@@ -101,6 +114,7 @@ class ServingConfig:
             "kv_block": self.kv_block,
             "kv_blocks": self.kv_blocks,
             "shard": list(self.shard) if self.shard else None,
+            "speculate": self.speculate,
         }
 
 
@@ -178,6 +192,7 @@ def _score(config: ServingConfig, requests: Sequence[Request],
     srv = ScheduledServer.simulated(
         config.shape(), decode_steps=config.decode_steps,
         policy=config.policy, latency_model=model,
+        speculate=config.speculate,
     )
     _results, stats = srv.run(list(requests))
     return ScoredConfig(
@@ -217,28 +232,49 @@ def search_serving_config(
     )
     base_pol = baseline.policy
     kv_layouts = candidate_kv_layouts(baseline)
+    # Draft depth joins the knobs only when the baseline SPECULATES —
+    # speculation needs a deployment-provided draft source (a plain
+    # baseline has none to turn on).  0 always competes: the search
+    # may conclude speculation doesn't pay on this workload.
+    if baseline.speculate > 0:
+        specs = tuple(sorted({
+            0, baseline.speculate,
+            max(baseline.speculate // 2, 1),
+            min(baseline.speculate * 2, MAX_DECODE_STEPS_PER_CALL),
+        }))
+    else:
+        specs = (0,)
     configs: List[ServingConfig] = []
     seen = set()
     for bks in bucket_sets:
         for k in ks:
             for b in batches:
                 for kvb, kvn in kv_layouts:
-                    for adaptive in (
-                        (True, False) if base_pol.name == "slo"
-                        else (False,)
-                    ):
-                        pol = dataclasses.replace(base_pol,
-                                                  adaptive_k=adaptive)
-                        key = (bks, k, b, kvb, kvn, adaptive)
-                        if key in seen:
-                            continue
-                        seen.add(key)
-                        configs.append(ServingConfig(
-                            buckets=bks, decode_steps=k, max_batch=b,
-                            max_seq=baseline.max_seq, policy=pol,
-                            kv_block=kvb, kv_blocks=kvn,
-                            shard=baseline.shard,
-                        ))
+                    for sp in specs:
+                        # d replaces k in spec mode (the round is
+                        # d+1 draft + d+1 verify; adaptive-k is
+                        # bypassed): vary neither alongside d.
+                        k_eff = baseline.decode_steps if sp > 0 else k
+                        adaptives = (
+                            (True, False)
+                            if base_pol.name == "slo" and sp == 0
+                            else (base_pol.adaptive_k,)
+                        )
+                        for adaptive in adaptives:
+                            pol = dataclasses.replace(
+                                base_pol, adaptive_k=adaptive)
+                            key = (bks, k_eff, b, kvb, kvn, sp,
+                                   adaptive)
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            configs.append(ServingConfig(
+                                buckets=bks, decode_steps=k_eff,
+                                max_batch=b,
+                                max_seq=baseline.max_seq, policy=pol,
+                                kv_block=kvb, kv_blocks=kvn,
+                                shard=baseline.shard, speculate=sp,
+                            ))
     if not any(c.to_json() == baseline.to_json() for c in configs):
         configs.append(baseline)
 
@@ -258,6 +294,7 @@ def search_serving_config(
             len(s.config.buckets),
             s.config.buckets,
             s.config.kv_block,
+            s.config.speculate,
             not s.config.policy.adaptive_k,
         )
 

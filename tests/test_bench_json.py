@@ -106,6 +106,13 @@ def stubbed_bench(monkeypatch):
             "sharded_mesh": [2, 1],
             "sharded_tokens_per_s": 600.0,
             "sharded_vs_single_mesh_tokens_per_s": 1.5,
+            "speculate": 12,
+            "spec_tokens_per_s": 700.0,
+            "spec_acceptance_rate": 1.0,
+            "spec_tokens_per_dispatch": 9.0,
+            "plain_tokens_per_dispatch": 6.0,
+            "spec_vs_plain_tokens_per_dispatch": 1.5,
+            "spec_match": True,
         }),
     )
     monkeypatch.setattr(
@@ -211,6 +218,15 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert serving["sharded_mesh"] == [2, 1]
     assert serving["sharded_tokens_per_s"] == 600.0
     assert serving["sharded_vs_single_mesh_tokens_per_s"] == 1.5
+    # The speculation columns (ISSUE 16, SERVING.md "Speculative
+    # decoding"): tokens per decode dispatch under a d=12 self-draft
+    # vs the plain fused k=8 run, with the byte-parity match bit.
+    assert serving["speculate"] == 12
+    assert serving["spec_acceptance_rate"] == 1.0
+    assert serving["spec_tokens_per_dispatch"] == 9.0
+    assert serving["plain_tokens_per_dispatch"] == 6.0
+    assert serving["spec_vs_plain_tokens_per_dispatch"] == 1.5
+    assert serving["spec_match"] is True
     # The execution-autotuner leg (ISSUE 6): auto-chosen config with
     # its predicted-vs-measured ms/step + the search wall time.
     search = record["extra"]["search"]

@@ -21,6 +21,12 @@ Pins the subsystem's correctness contracts:
   sequences under any batch composition.
 - **Train->serve handoff**: params restored from a training checkpoint
   through the strategy-portable CheckpointManager drive serving.
+- **Speculative decoding parity matrix**: greedy spec decode is
+  byte-identical to plain fused decode for every draft depth, draft
+  source (full/truncated self-draft, independent params) and cache
+  layout; sampled verification replays the keyed draws; crash
+  recovery resumes over the accepted prefix (SERVING.md
+  "Speculative decoding").
 
 Heavy end-to-end cases are ``@pytest.mark.slow`` (tier-1 keeps the
 fast numerics/protocol cases; CLAUDE.md "Tests").
@@ -559,15 +565,31 @@ def test_sharded_falls_back_without_devices(lm, caplog):
     assert any("falling back" in r.message for r in caplog.records)
 
 
-def test_paged_wins_over_shard(lm, caplog):
-    """Paged + sharded do not compose yet: paged wins, loudly."""
-    import logging
+@pytest.mark.parametrize("shard", [(2, 1), (2, 2)])
+def test_paged_sharded_greedy_parity(lm, sex, weights, shard):
+    """Paged + sharded COMPOSE (SERVING.md "Cache layout"): the block
+    pool shards its head axis on 'c' (no batch axis — 'n' only sizes
+    the mesh), block tables stay host-side, and greedy sequences are
+    byte-identical to the single-mesh padded engine's."""
+    psx = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                          decode_kernel=False, kv_block=4, shard=shard)
+    assert psx.paged and psx.shard == shard
+    w2 = (psx._place(weights[0]), psx._place(weights[1]))
 
-    with caplog.at_level(logging.WARNING, logger="ff.serving"):
-        shx = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8,),
-                              kv_block=4, shard=(2, 1))
-    assert shx.paged and shx.shard is None
-    assert any("do not compose" in r.message for r in caplog.records)
+    def reqs():
+        return [_req(0, [5, 9, 2], max_new=6),
+                _req(1, [3, 1, 4, 1, 5], max_new=5)]
+
+    base, _ = _serve(sex, weights, reqs(), decode_steps=4)
+    ps, pstats = _serve(psx, w2, reqs(), decode_steps=4)
+    assert pstats["kv_layout"] == "paged"
+    assert pstats["shard"] == list(shard)
+    for rid in (0, 1):
+        assert ps[rid].error is None
+        assert ps[rid].tokens == base[rid].tokens
+    alone, _ = _serve(psx, w2, [_req(1, [3, 1, 4, 1, 5], max_new=5)],
+                      decode_steps=4)
+    assert alone[1].tokens == ps[1].tokens
 
 
 # -- in-program sampling -------------------------------------------------
@@ -607,6 +629,107 @@ def test_sampling_greedy_default_is_oracle(sex, weights):
     g2, _ = _serve(sex, weights, reqs(), decode_steps=4)
     assert gstats["sampled"] is False
     assert g1[0].tokens == g2[0].tokens
+
+
+# -- speculative decoding (SERVING.md "Speculative decoding") -------------
+
+
+def _spec_reqs():
+    return [_req(0, [5, 9, 2], max_new=7),
+            _req(1, [3, 1, 4, 1, 5], max_new=6),
+            _req(2, [31, 3, 3, 7], max_new=5)]
+
+
+@pytest.mark.parametrize("layout", ["padded", "paged"])
+@pytest.mark.parametrize("d", [1, 3, 8])
+def test_spec_greedy_parity_matrix(lm, sex, paged_sex, weights, layout, d):
+    """The speculative acceptance bar: greedy spec decode is
+    BYTE-IDENTICAL to plain fused decode for every draft depth and
+    cache layout — the verify scan IS the decode superstep body, so
+    output never depends on the acceptance pattern.  Full-graph
+    self-draft is the all-accepted boundary: every draft token equals
+    the verify token, so acceptance is exactly 1.0 and each round
+    emits d+1 tokens."""
+    ex = sex if layout == "padded" else paged_sex
+    base, bstats = _serve(ex, weights, _spec_reqs(), decode_steps=4)
+    sp, sstats = _serve(ex, weights, _spec_reqs(), decode_steps=4,
+                        speculate=d)
+    assert sstats["speculate"] == d
+    assert sstats["draft_prefills"] == sstats["prefills"]
+    assert sstats["spec_acceptance_rate"] == 1.0
+    for rid in (0, 1, 2):
+        assert sp[rid].error is None
+        assert sp[rid].tokens == base[rid].tokens
+    # Fully-accepting speculation multiplies tokens per dispatch:
+    # never fewer decode dispatches than plain k=4 needs... strictly
+    # fewer once d+1 > k.
+    if d + 1 > bstats["decode_steps_per_call"]:
+        assert sstats["decode_supersteps"] < bstats["decode_supersteps"]
+
+
+@pytest.mark.slow  # extra draft-model program set (~5s compile)
+def test_spec_rejecting_draft_still_exact(sex, weights):
+    """A BAD draft (independently initialized params) costs only
+    acceptance — the emitted sequence stays byte-identical to plain
+    decode (rejected tokens never reach the host; the verify token at
+    the first mismatch is the sequential-decode token)."""
+    bad_draft, _ = sex.init(seed=99)
+    base, _ = _serve(sex, weights, _spec_reqs(), decode_steps=4)
+    sp, sstats = _serve(sex, weights, _spec_reqs(), decode_steps=4,
+                        speculate=4, draft_params=bad_draft)
+    assert sstats["spec_acceptance_rate"] < 1.0
+    for rid in (0, 1, 2):
+        assert sp[rid].error is None
+        assert sp[rid].tokens == base[rid].tokens
+
+
+def test_spec_truncated_draft_parity(lm, sex, weights):
+    """Self-drafting through the first ``draft_layers`` transformer
+    blocks (the checkpoint-free draft source): parity holds whatever
+    the truncated model proposes, and the draft cache covers only the
+    kept layers."""
+    tex = ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                          decode_kernel=False, draft_layers=1)
+    assert tex.draft_layers == 1
+    assert len(tex._draft_cache_specs) == 1  # blk1_attn skipped
+    base, _ = _serve(sex, weights, _spec_reqs(), decode_steps=4)
+    sp, sstats = _serve(tex, weights, _spec_reqs(), decode_steps=4,
+                        speculate=4)
+    assert sstats["draft_layers"] == 1
+    assert 0.0 <= sstats["spec_acceptance_rate"] <= 1.0
+    for rid in (0, 1, 2):
+        assert sp[rid].error is None
+        assert sp[rid].tokens == base[rid].tokens
+
+
+@pytest.mark.slow  # sampled spec + sampled plain program sets
+def test_spec_sampled_replayable(sex, weights):
+    """Sampled speculative verification reuses the keyed
+    fold_in(seed, req_id, pos) draws, so a speculating sampled run
+    emits exactly the plain sampled run's tokens — across draft
+    depths and batch compositions."""
+    kw = dict(temperature=0.8, top_k=8, sample_seed=3)
+    base, _ = _serve(sex, weights, _spec_reqs(), decode_steps=4, **kw)
+    for d in (2, 4):
+        sp, sstats = _serve(sex, weights, _spec_reqs(), decode_steps=4,
+                            speculate=d, **kw)
+        assert sstats["sampled"] is True
+        for rid in (0, 1, 2):
+            assert sp[rid].error is None
+            assert sp[rid].tokens == base[rid].tokens
+    alone, _ = _serve(sex, weights, [_req(1, [3, 1, 4, 1, 5], max_new=6)],
+                      decode_steps=4, speculate=4, **kw)
+    assert alone[1].tokens == base[1].tokens
+
+
+def test_spec_relay_clamp(sex, weights):
+    """The draft chain counts against the relay-safe fence cap: d
+    clamps at 20 exactly like decode_steps and training supersteps."""
+    params, state = weights
+    srv = Server(sex, params, state, speculate=64)
+    assert srv.speculate == 20
+    with pytest.raises(ValueError):
+        sex.build_spec_step(0)
 
 
 # -- failure model: journal & crash resume (SERVING.md "Failure model") -------
@@ -742,3 +865,21 @@ def test_server_crash_resume_torn_tail(sex, weights, tmp_path):
     for rid in range(4):
         assert res[rid].error is None
         assert res[rid].tokens == base[rid].tokens
+
+
+def test_spec_crash_resume_mid_generation(sex, weights, tmp_path):
+    """Crash recovery composes with speculation: the journal carries
+    ACCEPTED tokens only, so a crash between speculative rounds
+    resumes via re-prefill over (prompt ‖ accepted prefix) — final
+    sequences byte-identical to the speculating uncrashed run AND to
+    the plain unspeculated run (greedy parity holds through the
+    resume's re-prefill, draft-cache re-prime included)."""
+    plain, _ = _serve(sex, weights, _crash_resume_reqs(),
+                      decode_steps=2)
+    base, res, stats = _crash_then_resume(tmp_path, sex, weights,
+                                          speculate=3)
+    assert stats["speculate"] == 3
+    for rid in range(4):
+        assert res[rid].error is None
+        assert res[rid].tokens == base[rid].tokens
+        assert res[rid].tokens == plain[rid].tokens

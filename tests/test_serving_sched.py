@@ -668,6 +668,117 @@ def test_production_workload_live_source():
         assert r.prompt.max() < V
 
 
+# -- speculative decoding on the scheduled path (SERVING.md) ------------------
+
+
+def test_sim_matches_real_dispatch_spec(tmp_path, sex, weights):
+    """The sim==real contract EXTENDS to spec mode: the simulated
+    engine fabricates FULL acceptance, and a full self-draft (the
+    degenerate case) accepts everything, so with draft == serving
+    params the decision log, prefill/draft-prefill and superstep
+    counts all agree — and exactly one ``spec_verify`` event lands
+    per superstep, reconstructing the folded spec stats
+    bit-identically."""
+    from flexflow_tpu.obs.reader import RunLog
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    params, state = weights
+    spec = WorkloadSpec(n_requests=8, vocab=V, prompt_len=(3, 6),
+                        max_new=(2, 8), mean_gap_ms=1.0, burst=4,
+                        priorities=2, slo_ms=60.0, seed=7)
+    pol = SchedulerPolicy(name="slo")
+    real = ScheduledServer(sex, params, state, decode_steps=8,
+                           policy=pol, speculate=3)
+    tel = Telemetry(str(tmp_path))
+    path = tel.path
+    with tel:
+        _, real_st = real.run(make_workload(spec))
+    assert real_st["speculate"] == 3
+    assert real_st["spec_acceptance_rate"] == 1.0
+    assert real_st["draft_prefills"] == real_st["prefills"]
+    sim = ScheduledServer.simulated(
+        SlotShape(max_batch=2, max_seq=S, buckets=(8, S)),
+        decode_steps=8, policy=pol, speculate=3)
+    _, sim_st = sim.run(make_workload(spec))
+    assert sim.decisions == real.decisions
+    assert sim_st["prefills"] == real_st["prefills"]
+    assert sim_st["draft_prefills"] == real_st["draft_prefills"]
+    assert sim_st["decode_supersteps"] == real_st["decode_supersteps"]
+    assert sim_st["spec_acceptance_rate"] == \
+        real_st["spec_acceptance_rate"]
+    assert sim_st["spec_tokens_per_dispatch"] == \
+        real_st["spec_tokens_per_dispatch"]
+    assert _virt(sim_st) == _virt(real_st)
+    run = RunLog.load(path)
+    assert not run.unknown_events
+    assert len(run.select("spec_verify")) == real_st["decode_supersteps"]
+    rec = run.reconstruct_summary()
+    summ = run.summary()
+    for k in ("spec_acceptance_rate", "spec_tokens_per_dispatch"):
+        assert rec.get(k) == summ.get(k) == real_st[k], k
+
+
+@pytest.mark.slow  # extra draft-model program set under the scheduler
+def test_sched_spec_output_parity_rejecting_draft(sex, weights):
+    """Speculation changes dispatch count, never content — even when
+    the draft REJECTS: an unrelated-weights draft under the scheduler
+    produces byte-identical per-request sequences to plain decode.
+    (Sim==real is NOT asserted here: the simulated draft accepts
+    fully, so exactness requires a fully-accepting draft — the
+    documented contract.)"""
+    params, state = weights
+    bad_draft, _ = sex.init(seed=99)
+
+    def reqs():
+        return [_req(0, 4, 10, 0.0), _req(1, 5, 8, 1.0),
+                _req(2, 3, 6, 2.0)]
+
+    pol = SchedulerPolicy(name="slo")
+    base, _ = ScheduledServer(sex, params, state, decode_steps=4,
+                              policy=pol).run(reqs())
+    spec_res, spec_st = ScheduledServer(
+        sex, params, state, decode_steps=4, policy=pol,
+        speculate=4, draft_params=bad_draft,
+    ).run(reqs())
+    assert spec_st["spec_acceptance_rate"] < 1.0
+    for rid in (0, 1, 2):
+        assert spec_res[rid].error is None
+        assert spec_res[rid].tokens == base[rid].tokens
+
+
+def test_serve_auto_speculate_knob():
+    """Draft depth d joins the serve-auto knobs ONLY when the baseline
+    speculates (the draft source is a deployment fact); candidates are
+    {0, d/2, d, 2d} clamped, spec candidates pin k (adaptive-k is
+    bypassed in spec mode), and the search stays deterministic."""
+    from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
+
+    pol = SchedulerPolicy(name="slo")
+    with pytest.raises(ValueError, match="speculate"):
+        ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                      max_seq=32, policy=pol,
+                      speculate=MAX_DECODE_STEPS_PER_CALL + 1)
+    reqs = make_workload(BURSTY)
+    plain = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                          max_seq=32, policy=pol)
+    assert all(c.config.speculate == 0
+               for c in search_serving_config(reqs, plain).candidates)
+    base = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                         max_seq=32, policy=pol, speculate=4)
+    res = search_serving_config(reqs, base)
+    depths = {c.config.speculate for c in res.candidates}
+    assert {0, 2, 4, 8} <= depths
+    for c in res.candidates:
+        assert c.config.to_json()["speculate"] == c.config.speculate
+        if c.config.speculate:
+            assert c.config.decode_steps == base.decode_steps
+            assert c.config.policy.adaptive_k == pol.adaptive_k
+    assert res.chosen.predicted_p99_ms <= res.baseline.predicted_p99_ms
+    res2 = search_serving_config(reqs, base)
+    assert [c.config.to_json() for c in res.candidates] == \
+        [c.config.to_json() for c in res2.candidates]
+
+
 # -- failure model (SERVING.md "Failure model") -------------------------------
 
 

@@ -13,7 +13,10 @@ surviving slots' sequences byte-identical), overload shedding,
 fault kills / in-process-restarts the scheduled server, journal
 replay resumes byte-identically, padded AND paged) and
 ``serving_sigterm_drain`` (drain-on-SIGTERM: in-flight work journaled
-at the fence, clean exit, resume byte-identical) — and the multi-host world
+at the fence, clean exit, resume byte-identical) and
+``serving_spec_fault`` (faults inside the speculative draft+verify
+round: faulted slots error at the verify fence, survivors
+byte-identical to the UNSPECULATED run, padded AND paged) — and the multi-host world
 failures, ``host_loss`` and ``coordinator_loss``, on the live
 2-process ``jax.distributed`` rig (RESILIENCE.md "Host loss & elastic
 resize": launcher-classified kill, elastic resize / same-world

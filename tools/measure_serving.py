@@ -15,6 +15,11 @@ Four measurements, each against its acceptance bar:
   oracle) must predict the real run's dispatch counts EXACTLY — same
   decision log, same prefill count, same decode-superstep count, and
   the telemetry program counter must equal prefills + supersteps.
+- ``spec tokens/dispatch``: decode tokens per decode dispatch under a
+  d=12 full self-draft (the degenerate fully-accepting case) vs plain
+  fused k=8 on the SAME requests, outputs byte-identical every rep
+  (acceptance decides dispatch count, never content — SERVING.md
+  "Speculative decoding").  Bar: >= 1.5x.
 - ``paged capacity``: under ``FF_DEVICE_MEM_BYTES`` = half the padded
   cache budget, the padded executor must refuse with
   ``DeviceMemoryError``, the budget-sized paged pool must serve
@@ -191,6 +196,47 @@ def child(argv):
               + f" {'PASS' if ok else 'FAIL'}")
         if not ok:
             failures += 1
+
+    # -- speculation tokens/dispatch (bar >= 1.5x) ----------------------------
+    # SERVING.md "Speculative decoding": d=12 full self-draft vs plain
+    # fused k=8, same requests (the tiny model is 1 layer, so the
+    # self-draft IS the only draft source — fully accepting, so every
+    # round emits d+1 = 13 tokens per slot where plain decode caps at
+    # k=8).  Tokens per decode dispatch is a deterministic count, so
+    # the A/A control reads exactly 1.000x; every rep additionally
+    # pins byte-identical outputs across the two engines.
+    from flexflow_tpu.runtime.serving import Server, synthetic_requests
+
+    def spec_reqs(seed):
+        return synthetic_requests(4, 32, prompt_len=(3, 6),
+                                  max_new_tokens=14, seed=21 + seed)
+
+    plain_toks, spec_toks = {}, {}
+
+    def tokens_per_dispatch(speculate, seed, keep=None):
+        srv = Server(sex, params, state, decode_steps=8,
+                     speculate=speculate)
+        results, stats = srv.run(spec_reqs(seed))
+        if keep is not None:
+            keep[seed] = {r: results[r].tokens for r in results}
+        return (stats["tokens"] - stats["prefills"]) / max(
+            stats["decode_supersteps"], 1)
+
+    res = paired_measure(
+        make_a=lambda r: tokens_per_dispatch(12, r, spec_toks),
+        make_b=lambda r: tokens_per_dispatch(0, r, plain_toks),
+        reps=reps,
+        control=lambda r: tokens_per_dispatch(12, r),
+    )
+    med, ctl = res.median_ratio, res.median_aa_ratio
+    parity = all(spec_toks[s] == plain_toks[s] for s in plain_toks)
+    ok = med >= 1.5 and parity
+    print(f"{'spec tokens/dispatch':<22} {med:>7.3f}x  (bar >= 1.5x, "
+          f"a_a {ctl:.3f}x) outputs "
+          f"{'byte-identical' if parity else 'DIVERGED'} "
+          f"{'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
 
     # -- paged capacity under a fixed HBM budget (bar >= 2x) ------------------
     # SERVING.md "Cache layout": half the padded cache budget via
