@@ -914,6 +914,46 @@ def bench_serving(n_chips: int, on_tpu: bool):
         spec_stats["spec_tokens_per_dispatch"] / max(plain_tpd, 1e-9), 3)
     out["spec_match"] = all(
         spec_res[r].tokens == plain_res[r].tokens for r in plain_res)
+
+    # Fleet columns (SERVING.md "Fleet"): the same bursty workload on
+    # a 2-replica fleet behind the least-loaded router vs the
+    # single-replica slo run (attainment is the headline — two chip
+    # groups absorb the burst), plus a replica-loss sub-leg: an
+    # engine-class fault kills replica 0 mid-run and the router
+    # redistributes its journaled in-flight requests to the survivor
+    # (the counters prove the loss path ran; all virtual-clock values).
+    from flexflow_tpu.serving import FleetRouter, MemoryJournal
+
+    sexf = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                           buckets=(max_seq // 2, max_seq))
+    pf, sf = sexf.init(0)
+
+    def make_fleet(injected):
+        stacks = ((sex, params, state), (sexf, pf, sf))
+        reps = []
+        for i, (ex_i, p_i, s_i) in enumerate(stacks):
+            reps.append(ScheduledServer(
+                ex_i, p_i, s_i, decode_steps=8,
+                policy=SchedulerPolicy(name="slo"),
+                resilience=ServingResilience(max_restarts=0),
+                journal=MemoryJournal(),
+                fault_injector=ServingFaultInjector(
+                    engine_raise_at={1: "injected replica death"})
+                if injected and i == 0 else None,
+            ))
+        return FleetRouter(reps, router="least-loaded")
+
+    _, fstats = make_fleet(injected=False).run(workload())
+    out["fleet_replicas"] = fstats["replicas"]
+    out["fleet_router"] = fstats["router"]
+    out["fleet_queue_wait_ms_p99"] = fstats["queue_wait_ms_p99"]
+    out["fleet_slo_attainment"] = fstats["slo_attainment"]
+    out["fleet_vs_single_attainment"] = round(
+        fstats["slo_attainment"] / max(slo["slo_attainment"], 1e-9), 3)
+    _, lstats = make_fleet(injected=True).run(workload())
+    out["fleet_dead_replicas"] = lstats["dead_replicas"]
+    out["fleet_redistributed"] = lstats["redistributed"]
+    out["fleet_loss_slo_attainment"] = lstats["slo_attainment"]
     return out
 
 

@@ -1,7 +1,7 @@
 """fflint HLO rule family: the post-SPMD collective audit.
 
-Relocated from ``runtime/audit.py`` (which remains as a deprecation
-shim) so the repo has ONE audit surface — ``flexflow_tpu.analysis`` —
+Relocated from ``runtime/audit.py`` (now retired — importing the old
+name raises) so the repo has ONE audit surface — ``flexflow_tpu.analysis`` —
 spanning AST rules (``lint.py``), traced-program properties
 (``program_audit.py``), and these compiled-HLO collective checks
 (rule id FFH001, ``full_activation_allgathers``).

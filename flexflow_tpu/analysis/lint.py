@@ -368,6 +368,7 @@ FF008_EVENT_NAMES = frozenset({
     "sched_decision", "request_preempt", "request_shed",
     "request_retry", "request_expire", "serving_drain",
     "engine_restart", "degraded_mode",
+    "replica_route", "replica_loss", "fleet_state",
     "distributed_init", "elastic_resize",
 })
 

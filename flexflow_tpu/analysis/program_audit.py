@@ -927,6 +927,13 @@ def audit_repo(fast: bool = True) -> List[ProgramViolation]:
                              buckets=(8, 16), kv_block=4, shard=(2, 2))
     out += audit_serving(sex_ps, decode_steps=4,
                          prefix="serving_paged_sharded")
+    # Fleet family (SERVING.md "Fleet"): routing and redistribution are
+    # pure host arithmetic — a fleet adds NO new program shapes, it
+    # replicates the single-replica family.  Audit a second
+    # independently-built replica executor to pin exactly that.
+    sex_fleet = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
+                                buckets=(8, 16))
+    out += audit_serving(sex_fleet, decode_steps=4, prefix="serving_fleet")
 
     if not fast:
         out += _donation_serving(sex, decode_steps=4)

@@ -74,9 +74,24 @@ Scheduler flags (each enables the scheduled path):
   --shed-depth N     shed waiting requests past this queue depth (0 =
                      off)
   --serve-auto       search (buckets x K x max_batch x kv layout x
-                     policy knobs, + draft depth d when --speculate)
+                     policy knobs, + draft depth d when --speculate,
+                     + replica count x router when --replicas > 1)
                      against the calibrated serving latency model and
                      run the winner (--calibration feeds constants)
+
+Fleet flags (SERVING.md "Fleet"; each enables the scheduled path):
+  --replicas N       run N ScheduledServer replicas behind the
+                     failure-aware FleetRouter: deterministic routing
+                     on the shared virtual clock, each replica with
+                     its own executor and journal (--journal PATH
+                     becomes PATH.rI).  A replica that exhausts its
+                     --serve-max-restarts budget is marked dead and
+                     its journaled in-flight work is redistributed to
+                     peers (byte-identical resume); ALL replicas dead
+                     exits 78 (EXIT_FLEET_FAILURE — 76/77 keep their
+                     meanings)
+  --router POLICY    least-loaded | tier-aware | affinity (default
+                     least-loaded)
 
 Failure-model flags (SERVING.md "Failure model"):
   --journal PATH     append-only request journal (JSONL), written at
@@ -153,7 +168,8 @@ def _pop_opt_str(argv, flag):
     return ""
 
 
-def _dry_run(sex, decode_ks, speculate=0) -> int:
+def _dry_run(sex, decode_ks, speculate=0, replicas=1,
+             router="least-loaded") -> int:
     """Compute-free serving validation: eval_shape every prefill
     bucket and every decode-superstep width the scheduler may
     dispatch (plus the draft-prefill and fused spec programs when
@@ -187,6 +203,11 @@ def _dry_run(sex, decode_ks, speculate=0) -> int:
     from flexflow_tpu import analysis
     from flexflow_tpu.runtime import telemetry as _telemetry
 
+    if replicas > 1:
+        # Routing is host-side: every replica builds this SAME program
+        # family, so auditing one executor covers the fleet.
+        print(f"fleet: {replicas} replicas (router={router}) x the "
+              f"program family above; no extra programs")
     violations = []
     for k in decode_ks:
         violations += analysis.audit_serving(sex, decode_steps=k,
@@ -263,6 +284,10 @@ def main(argv=None) -> int:
     priorities = pop_int(argv, "--priorities", 0)
     shed_depth = pop_int(argv, "--shed-depth", 0)
     serve_auto = _pop_flag(argv, "--serve-auto")
+    # Fleet flags (SERVING.md "Fleet").
+    router_given = "--router" in argv
+    replicas = pop_int(argv, "--replicas", 1)
+    router = _pop_str(argv, "--router", "least-loaded")
     # Failure-model flags (SERVING.md "Failure model").
     journal_path = _pop_str(argv, "--journal", "")
     serve_retries = pop_int(argv, "--serve-retries", 0)
@@ -284,6 +309,13 @@ def main(argv=None) -> int:
         )
     if speculate < 0:
         raise SystemExit(f"--speculate expects d >= 0, got {speculate}")
+    if replicas < 1:
+        raise SystemExit(f"--replicas expects N >= 1, got {replicas}")
+    if router not in ("least-loaded", "tier-aware", "affinity"):
+        raise SystemExit(
+            f"--router expects least-loaded|tier-aware|affinity, "
+            f"got {router!r}"
+        )
     if (draft_ckpt or draft_layers) and not speculate:
         raise SystemExit(
             "--draft-ckpt/--draft-layers configure the DRAFT source "
@@ -309,7 +341,7 @@ def main(argv=None) -> int:
         sched_s or workload_trace is not None or slo_ms > 0
         or priorities > 0 or shed_depth > 0 or serve_auto
         or serve_retries > 0 or serve_max_restarts >= 0
-        or expire_waiting
+        or expire_waiting or replicas > 1 or router_given
     )
     if not use_sched:
         return _run_legacy(
@@ -339,6 +371,7 @@ def main(argv=None) -> int:
         serve_max_restarts=serve_max_restarts,
         expire_waiting=expire_waiting, speculate=speculate,
         draft_ckpt=draft_ckpt, draft_layers=draft_layers,
+        replicas=replicas, router=router,
     )
 
 
@@ -425,7 +458,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                    journal_path="", serve_retries=0,
                    retry_backoff_ms=8.0, serve_max_restarts=-1,
                    expire_waiting=False, speculate=0, draft_ckpt="",
-                   draft_layers=0) -> int:
+                   draft_layers=0, replicas=1,
+                   router="least-loaded") -> int:
     from flexflow_tpu.runtime import telemetry as _telemetry
     from flexflow_tpu.runtime.serving import (
         EXIT_SERVING_FAILURE,
@@ -434,6 +468,9 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
     )
     from flexflow_tpu.runtime.trainer import relay_safe_steps
     from flexflow_tpu.serving import (
+        EXIT_FLEET_FAILURE,
+        FleetCrashLoop,
+        FleetRouter,
         RequestJournal,
         ScheduledServer,
         SchedulerPolicy,
@@ -500,6 +537,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 max_batch=max_batch, max_seq=max_seq, policy=policy,
                 kv_block=kv_block, kv_blocks=kv_blocks or None,
                 shard=shard, speculate=speculate,
+                replicas=replicas, router=router,
             )
             res = search_serving_config(requests, baseline, model)
             choice = res.chosen
@@ -515,6 +553,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             kv_block = choice.config.kv_block
             kv_blocks = choice.config.kv_blocks or 0
             speculate = choice.config.speculate
+            replicas = choice.config.replicas
+            router = choice.config.router
             _telemetry.current().emit(
                 "search", kind="serving",
                 chosen=choice.config.to_json(),
@@ -533,13 +573,17 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             d_model=d_model, num_heads=heads, num_layers=layers,
             config=cfg,
         )
-        sex = ServingExecutor(
-            ff, cfg, max_batch=max_batch, max_seq=max_seq,
-            buckets=buckets,
-            decode_kernel=False if no_kernel else None,
-            kv_block=kv_block, kv_blocks=kv_blocks or None, shard=shard,
-            draft_layers=draft_layers,
-        )
+
+        def make_executor():
+            return ServingExecutor(
+                ff, cfg, max_batch=max_batch, max_seq=max_seq,
+                buckets=buckets,
+                decode_kernel=False if no_kernel else None,
+                kv_block=kv_block, kv_blocks=kv_blocks or None,
+                shard=shard, draft_layers=draft_layers,
+            )
+
+        sex = make_executor()
         srv_proto = ScheduledServer.simulated(
             SlotShape(max_batch=max_batch, max_seq=max_seq,
                       buckets=buckets, kv_block=kv_block,
@@ -549,7 +593,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         )
         if cfg.dry_run:
             return _dry_run(sex, srv_proto._k_candidates,
-                            speculate=speculate)
+                            speculate=speculate, replicas=replicas,
+                            router=router)
 
         if cfg.ckpt_dir:
             step, params, state = sex.restore(cfg.ckpt_dir)
@@ -562,27 +607,60 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             dstep, draft_params, _ds = sex.restore(draft_ckpt)
             print(f"restored draft checkpoint step {dstep} "
                   f"from {draft_ckpt}")
-        srv = ScheduledServer(
-            sex, params, state, decode_steps=decode_steps,
-            eos_id=None if eos < 0 else eos, policy=policy,
-            latency_model=model, temperature=temperature, top_k=top_k,
-            sample_seed=sample_seed, resilience=resilience,
-            journal=(RequestJournal(journal_path)
-                     if journal_path else None),
-            speculate=speculate, draft_params=draft_params,
-        )
+
+        def make_server(sex_i, journal_i):
+            return ScheduledServer(
+                sex_i, params, state, decode_steps=decode_steps,
+                eos_id=None if eos < 0 else eos, policy=policy,
+                latency_model=model, temperature=temperature,
+                top_k=top_k, sample_seed=sample_seed,
+                resilience=resilience, journal=journal_i,
+                speculate=speculate, draft_params=draft_params,
+            )
+
         t0 = time.perf_counter()
-        try:
-            results, stats = srv.run(requests)
-        except ServingCrashLoop as e:
-            print(f"serving crash loop: {e}", file=sys.stderr)
-            print(f"exiting {EXIT_SERVING_FAILURE} for the external "
-                  f"supervisor (engine restart budget exhausted; the "
-                  f"journal carries completed + in-flight state)")
-            return EXIT_SERVING_FAILURE
+        if replicas > 1:
+            # The fleet: replica 0 reuses the executor built above,
+            # peers get their own (each owns programs + caches;
+            # params/state are shared).  --journal PATH fans out to
+            # per-replica PATH.rI files — the redistribution medium.
+            servers = []
+            for i in range(replicas):
+                sex_i = sex if i == 0 else make_executor()
+                jr = RequestJournal(f"{journal_path}.r{i}") \
+                    if journal_path else None
+                servers.append(make_server(sex_i, jr))
+            fleet = FleetRouter(servers, router=router)
+            try:
+                results, stats = fleet.run(requests)
+            except FleetCrashLoop as e:
+                print(f"fleet crash: {e}", file=sys.stderr)
+                print(f"exiting {EXIT_FLEET_FAILURE} for the external "
+                      f"supervisor (every replica's restart budget "
+                      f"exhausted; the per-replica journals carry "
+                      f"completed + in-flight state)")
+                return EXIT_FLEET_FAILURE
+        else:
+            srv = make_server(sex, RequestJournal(journal_path)
+                              if journal_path else None)
+            try:
+                results, stats = srv.run(requests)
+            except ServingCrashLoop as e:
+                print(f"serving crash loop: {e}", file=sys.stderr)
+                print(f"exiting {EXIT_SERVING_FAILURE} for the external "
+                      f"supervisor (engine restart budget exhausted; "
+                      f"the journal carries completed + in-flight "
+                      f"state)")
+                return EXIT_SERVING_FAILURE
         elapsed = time.perf_counter() - t0
 
     print(f"policy = {policy.describe()}")
+    if replicas > 1:
+        print(f"fleet = {stats['replicas']} replicas "
+              f"router={stats['router']} "
+              f"live={stats['live_replicas']} "
+              f"dead={stats['dead_replicas']} "
+              f"redistributed={stats['redistributed']}")
     print(f"requests = {stats['requests']} "
           f"completed = {stats['completed']} failed = {stats['failed']} "
           f"shed = {stats['request_sheds']} "

@@ -60,6 +60,10 @@ EVENT_CATALOG = frozenset({
     "serving_drain",
     "engine_restart",
     "degraded_mode",
+    # serving fleet (SERVING.md "Fleet")
+    "replica_route",
+    "replica_loss",
+    "fleet_state",
     # multi-host / elastic (RESILIENCE.md "Host loss & elastic resize")
     "distributed_init",
     "elastic_resize",

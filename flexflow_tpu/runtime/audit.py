@@ -1,29 +1,14 @@
-"""Deprecation shim: the post-SPMD HLO collective audit moved to
-``flexflow_tpu.analysis.hlo`` (the fflint HLO rule family), giving the
-repo ONE audit surface.  Import from ``flexflow_tpu.analysis`` (or
-``flexflow_tpu.analysis.hlo``) going forward."""
+"""RETIRED: the post-SPMD HLO collective audit lives in
+``flexflow_tpu.analysis.hlo`` (the fflint FFH rule family) — the repo
+has ONE audit surface.  This module spent a deprecation cycle as a
+warning re-export shim; the grace period is over and importing it is
+now a loud error so stale imports surface immediately instead of
+silently dragging a second name for the same code."""
 
 from __future__ import annotations
 
-import warnings
-
-from flexflow_tpu.analysis.hlo import (  # noqa: F401
-    COLLECTIVE_OPS,
-    Collective,
-    _attribute,
-    collective_bytes_by_op,
-    collective_stats,
-    count_collectives,
-    format_bytes_report,
-    full_activation_allgathers,
-    pipeline_collective_bytes,
-    sharded_activation_sizes,
-    spatial_halo_optimal_bytes,
-)
-
-warnings.warn(
-    "flexflow_tpu.runtime.audit moved to flexflow_tpu.analysis.hlo "
-    "(the unified fflint audit surface); update the import",
-    DeprecationWarning,
-    stacklevel=2,
+raise ImportError(
+    "flexflow_tpu.runtime.audit was retired — the HLO collective audit "
+    "moved to flexflow_tpu.analysis.hlo (import from "
+    "flexflow_tpu.analysis or flexflow_tpu.analysis.hlo)"
 )

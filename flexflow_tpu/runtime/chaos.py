@@ -770,6 +770,105 @@ def scenario_serving_spec_fault(root: str) -> Tuple[bool, str]:
                   "unspeculated run (padded AND paged layouts)")
 
 
+def scenario_replica_loss(root: str) -> Tuple[bool, str]:
+    """Fleet replica loss (SERVING.md "Fleet"): a 2-replica
+    ``FleetRouter`` over REAL scheduled servers, each journaling to its
+    own file.  An engine-class fault with the crash-loop budget at 0
+    kills replica 0 mid-decode — the router marks it dead, replays its
+    journal, and REDISTRIBUTES the in-flight requests to replica 1,
+    which resumes them through the ordinary journal-replay prelude
+    (re-prefill over prompt ‖ carried).  Replicas share params, so the
+    merged fleet output must be byte-identical to an unfaulted
+    SINGLE-replica run — regardless of which replica finished each
+    request.  Paged sub-check against the same padded baseline."""
+    from flexflow_tpu.runtime.serving import ServingFaultInjector
+    from flexflow_tpu.serving import (
+        FleetRouter,
+        RequestJournal,
+        ScheduledServer,
+        ServingResilience,
+    )
+
+    buckets = (8, 16, 32)  # re-prefill over prompt ‖ carried must bucket
+
+    def make_fleet(tag: str, stacks):
+        # One stack per replica (identical params from the shared
+        # seed) — the degraded ladder mutates executors in place, so
+        # real replicas never share one.  The injector rides replica 0.
+        inj = ServingFaultInjector(
+            engine_raise_at={1: "injected replica death"}
+        )
+        reps = []
+        for i, (sex_i, params_i, state_i) in enumerate(stacks):
+            reps.append(ScheduledServer(
+                sex_i, params_i, state_i, decode_steps=4,
+                resilience=ServingResilience(max_restarts=0),
+                journal=RequestJournal(os.path.join(
+                    root, "replica_loss", f"journal_{tag}.r{i}.jsonl")),
+                fault_injector=inj if i == 0 else None,
+            ))
+        return FleetRouter(reps, router="least-loaded"), inj
+
+    sex, params, state = _serving_setup(buckets=buckets)
+    base, _ = ScheduledServer(sex, params, state, decode_steps=4).run(
+        _serving_requests()
+    )
+    if any(r.error for r in base.values()):
+        return False, "replica_loss: unfaulted single-replica baseline had errors"
+
+    # Replica 1 (the survivor) reuses the baseline's stack — shared
+    # compiled programs, and the executor only ever serves (no fault
+    # ladder mutation).  Replica 0 (the victim) gets its own.
+    fleet, inj = make_fleet(
+        "padded",
+        [_serving_setup(buckets=buckets), (sex, params, state)],
+    )
+    results, stats = fleet.run(_serving_requests())
+    if not any(m == "engine" for m, _, _ in inj.fired):
+        return False, f"replica_loss: injector fired {inj.fired}"
+    if stats.get("dead_replicas") != 1 or fleet.dead != [0]:
+        return False, (f"replica_loss: expected replica 0 dead, got "
+                       f"dead={fleet.dead}")
+    if not stats.get("redistributed"):
+        return False, ("replica_loss: replica died with nothing "
+                       "redistributed (fault landed too late)")
+    if any(r.error for r in results.values()):
+        errs = {rid: r.error for rid, r in results.items() if r.error}
+        return False, f"replica_loss: fleet run had errors {errs}"
+    if _merge_tokens(results) != _merge_tokens(base):
+        return False, ("replica_loss: redistributed outputs DIVERGED "
+                       "from the unfaulted single-replica run")
+    carried = [d for d in fleet.decisions
+               if d["d"] == "redistribute" and d["carried"]]
+    if not carried:
+        return False, ("replica_loss: no redistributed request carried "
+                       "a journaled prefix (resume path never exercised)")
+    # Paged sub-check: the same loss on the paged-KV fleet — params are
+    # identical across layouts, so the merged output must match the
+    # PADDED single-replica baseline byte for byte.
+    pfleet, pinj = make_fleet(
+        "paged",
+        [_serving_setup(kv_block=8, buckets=buckets) for _ in range(2)],
+    )
+    presults, pstats = pfleet.run(_serving_requests())
+    if pstats.get("kv_layout") != "paged":
+        return False, "replica_loss: paged sub-check did not run paged"
+    if pstats.get("dead_replicas") != 1 or not pstats.get("redistributed"):
+        return False, (f"replica_loss[paged]: expected a dead replica "
+                       f"with redistribution, got dead="
+                       f"{pstats.get('dead_replicas')} redistributed="
+                       f"{pstats.get('redistributed')}")
+    if any(r.error for r in presults.values()) \
+            or _merge_tokens(presults) != _merge_tokens(base):
+        return False, ("replica_loss[paged]: redistributed outputs "
+                       "DIVERGED from the padded single-replica run")
+    return True, (f"replica_loss: replica 0 died mid-decode; "
+                  f"{stats['redistributed']} journaled request(s) "
+                  f"({len(carried)} with carried prefixes) finished on "
+                  f"the survivor byte-identical to the single-replica "
+                  f"run (padded AND paged layouts)")
+
+
 # -- multi-host elastic scenarios (RESILIENCE.md "Host loss & elastic
 # resize") -----------------------------------------------------------------
 #
@@ -971,6 +1070,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "serving_engine_crash": scenario_serving_engine_crash,
     "serving_sigterm_drain": scenario_serving_sigterm_drain,
     "serving_spec_fault": scenario_serving_spec_fault,
+    "replica_loss": scenario_replica_loss,
     "host_loss": scenario_host_loss,
     "coordinator_loss": scenario_coordinator_loss,
 }

@@ -8,10 +8,23 @@ calibrated serving cost model (``latency_model``), the
 ``--serve-auto`` config search (``search``), and the failure model
 (SERVING.md "Failure model"): the crash-recovery request journal
 (``journal``) plus the retry / restart / drain / degraded-mode knobs
-(``ServingResilience``).
+(``ServingResilience``), plus the replica fleet (SERVING.md "Fleet"):
+N replicas behind the failure-aware ``FleetRouter`` (``fleet``),
+elastic through replica loss via per-replica journals.
 """
 
-from flexflow_tpu.serving.journal import JournalState, RequestJournal
+from flexflow_tpu.serving.fleet import (
+    EXIT_FLEET_FAILURE,
+    FleetCrashLoop,
+    FleetRouter,
+    ROUTER_POLICIES,
+)
+from flexflow_tpu.serving.journal import (
+    JournalState,
+    MemoryJournal,
+    RequestJournal,
+    fold_journal_events,
+)
 from flexflow_tpu.serving.latency_model import ServingLatencyModel
 from flexflow_tpu.serving.scheduler import (
     ScheduledServer,
@@ -32,8 +45,14 @@ from flexflow_tpu.serving.workload import (
 )
 
 __all__ = [
+    "EXIT_FLEET_FAILURE",
+    "FleetCrashLoop",
+    "FleetRouter",
+    "ROUTER_POLICIES",
     "JournalState",
+    "MemoryJournal",
     "RequestJournal",
+    "fold_journal_events",
     "ServingLatencyModel",
     "ScheduledServer",
     "SchedulerPolicy",

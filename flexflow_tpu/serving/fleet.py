@@ -1,0 +1,474 @@
+"""Fleet-scale serving: N replicas behind a failure-aware router
+(SERVING.md "Fleet").
+
+One :class:`~flexflow_tpu.serving.scheduler.ScheduledServer` is one
+chip group; heavy traffic takes N of them.  The :class:`FleetRouter`
+fronts the replicas on the SAME global deterministic virtual clock the
+single-replica scheduler runs on: arrivals are absolute
+(``Request.arrival_ms``), every routing decision is made AT the
+request's arrival instant against modeled replica load, and the
+per-replica decision logs merge into one fleet-wide event queue
+(:meth:`FleetRouter.merged_decisions`) ordered by virtual time — so a
+fleet run is replayable on any box exactly like a single-replica run.
+
+**Routing policies** (the scheduler's idiom — deterministic keys,
+lowest index breaks ties):
+
+- ``least-loaded`` — argmin modeled outstanding ms, where each routed
+  request adds ``est_cost / advertised_slots`` to its replica's load:
+  a degraded-ladder replica advertises REDUCED capacity
+  (``ScheduledServer.advertised_capacity``) and its load grows
+  faster, so the router weighs it down without a special case.
+- ``tier-aware`` — tier-0 traffic orders replicas by (degraded rungs,
+  outstanding): the latency-critical class prefers the
+  least-degraded replica; other tiers fall back to least-loaded.
+- ``affinity`` — sticky keyed placement: a fold_in-style seeded draw
+  over the live replicas (``default_rng([affinity_seed, request.id])``
+  — the workload generator's keyed-stream idiom), so a request id
+  lands on the same replica across replays and re-runs while the live
+  set is unchanged.  This is the hook the future prefix-sharing cache
+  will route warm requests through.
+
+**Replica loss.**  Each replica journals to its OWN request journal.
+When an engine-class fault exhausts a replica's restart budget its
+``run`` raises ``ServingCrashLoop``; the router marks the replica
+dead, REPLAYS its journal (completed requests keep their recorded
+results — never re-run), and REDISTRIBUTES the unfinished remainder
+to surviving peers: journaled in-flight prefixes are TRANSPLANTED
+into the target survivor's journal (an ``sv_admit`` + ``sv_tokens``
+pair), so the survivor's ordinary journal-replay prelude resumes them
+through the existing re-prefill-over-(prompt ‖ carried) path.
+Per-request output is byte-identical REGARDLESS of which replica
+finishes it — replicas share params and decode logits match the
+full-seq forward (the slot-independence invariant), greedy AND
+sampled (draws are keyed by (seed, id, position)), padded AND paged.
+When the LAST replica dies the fleet raises :class:`FleetCrashLoop`
+and the driver exits ``EXIT_FLEET_FAILURE`` (78) for an external
+supervisor — 76 (world) and 77 (single-engine serving) keep their
+meanings.
+
+**Sim exactness.**  :meth:`FleetRouter.simulated` builds the fleet
+from ``ScheduledServer.simulated`` replicas, each journaling to an
+in-memory :class:`~flexflow_tpu.serving.journal.MemoryJournal` —
+routing, redistribution and the journal fold thread IDENTICALLY to
+the real fleet, so a simulated fleet is dispatch-exact AND
+decision-exact through replica loss (same ``fault_injector`` plan,
+EOS off, fully-accepting draft under speculation — the single-replica
+exactness contract, unchanged).  That makes replica count × router
+policy searchable: both are ``--serve-auto`` knobs
+(``serving/search.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flexflow_tpu.runtime import telemetry as _telemetry
+from flexflow_tpu.runtime.serving import (
+    Request,
+    RequestResult,
+    ServingCrashLoop,
+)
+from flexflow_tpu.serving.journal import JournalState, MemoryJournal
+from flexflow_tpu.serving.scheduler import ScheduledServer
+
+_log = logging.getLogger("ff.serving.fleet")
+
+#: Router admission policies (deterministic; SERVING.md "Fleet").
+ROUTER_POLICIES = ("least-loaded", "tier-aware", "affinity")
+
+#: Exit code for a fleet-wide crash (every replica dead) — the
+#: supervisor contract next to 76 (EXIT_WORLD_FAILURE) and 77
+#: (EXIT_SERVING_FAILURE), which keep their single-world /
+#: single-engine meanings.
+EXIT_FLEET_FAILURE = 78
+
+
+class FleetCrashLoop(RuntimeError):
+    """Every replica in the fleet is dead — unserved work remains and
+    no peer can absorb it.  The driver exits ``EXIT_FLEET_FAILURE``
+    (78) so an external supervisor can reschedule the whole fleet."""
+
+
+#: Per-run scheduler counters summed across replica runs into the
+#: fleet stats (a crashed run contributes nothing — identically in
+#: real and simulated fleets, so exactness pins still hold).
+_AGG_KEYS = (
+    "prefills", "decode_supersteps", "request_sheds",
+    "request_preempts", "request_retries", "request_expiries",
+    "engine_restarts",
+)
+
+
+class FleetRouter:
+    """N ``ScheduledServer`` replicas behind deterministic routing +
+    journal-backed redistribution (module docstring has the story)."""
+
+    def __init__(self, replicas: Sequence[ScheduledServer],
+                 router: str = "least-loaded", affinity_seed: int = 0):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        if router not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router policy {router!r} "
+                f"(have: {', '.join(ROUTER_POLICIES)})"
+            )
+        self.replicas: List[ScheduledServer] = list(replicas)
+        self.router = router
+        self.affinity_seed = int(affinity_seed)
+        #: The fleet-level replayable decision log (route /
+        #: redistribute / replica_loss), virtual-clock stamped like the
+        #: per-replica ``ScheduledServer.decisions``.
+        self.decisions: List[Dict[str, Any]] = []
+        #: Indices of replicas marked dead, in death order.
+        self.dead: List[int] = []
+        self.redistributed = 0
+        self.replica_stats: List[Optional[Dict[str, Any]]] = \
+            [None] * len(self.replicas)
+        self._load = [0.0] * len(self.replicas)
+        self._owned: List[Dict[int, Request]] = \
+            [{} for _ in self.replicas]
+
+    @classmethod
+    def simulated(
+        cls,
+        shape,
+        n_replicas: int,
+        router: str = "least-loaded",
+        decode_steps: int = 8,
+        policy=None,
+        latency_model=None,
+        resilience=None,
+        fault_injectors: Optional[Dict[int, Any]] = None,
+        speculate: int = 0,
+        journals: Optional[Sequence[Any]] = None,
+        affinity_seed: int = 0,
+    ) -> "FleetRouter":
+        """The compute-free fleet: ``n_replicas`` simulated servers
+        (shared frozen ``SlotShape``), each journaling to a
+        ``MemoryJournal`` (or a caller-supplied journal) so
+        redistribution after a simulated replica loss threads the
+        identical fold as the real fleet.  ``fault_injectors`` maps
+        replica index -> ``ServingFaultInjector`` plan."""
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        reps = []
+        for i in range(int(n_replicas)):
+            jr = journals[i] if journals is not None else MemoryJournal()
+            reps.append(ScheduledServer.simulated(
+                shape, decode_steps=decode_steps, policy=policy,
+                latency_model=latency_model, resilience=resilience,
+                journal=jr,
+                fault_injector=(fault_injectors or {}).get(i),
+                speculate=speculate,
+            ))
+        return cls(reps, router=router, affinity_seed=affinity_seed)
+
+    # -- routing ------------------------------------------------------------
+
+    def _est_cost_ms(self, srv: ScheduledServer, r: Request) -> float:
+        """Modeled serial cost of one request on one replica — the
+        load-accounting unit (prefill + decode rounds at the replica's
+        fusion width, ``spec_ms`` rounds when it speculates)."""
+        model = srv.model
+        try:
+            bucket = srv.ex.bucket_for(len(r.prompt))
+        except ValueError:
+            bucket = max(srv.ex.buckets)
+        new = max(int(r.max_new_tokens), 1)
+        if srv.speculate:
+            rounds = -(-new // (srv.speculate + 1))
+            return (model.prefill_ms(bucket) + model.draft_prefill_ms(bucket)
+                    + model.spec_ms(srv.speculate) * rounds)
+        k = max(srv.decode_steps, 1)
+        return model.prefill_ms(bucket) + model.decode_ms(k) * (-(-new // k))
+
+    def _route(self, r: Request, live: List[int]) -> int:
+        """Pick the replica for ``r`` at its arrival instant.  Pure
+        host arithmetic over modeled load + advertised capacity —
+        identical in real and simulated fleets."""
+        t = float(r.arrival_ms)
+        cand = sorted(live)
+        if self.router == "affinity":
+            rng = np.random.default_rng(
+                [self.affinity_seed, int(r.id)]
+            )
+            i = cand[int(rng.integers(0, len(cand)))]
+        else:
+            best_i, best_key = None, None
+            for i in cand:
+                cap = self.replicas[i].advertised_capacity()
+                out = max(self._load[i] - t, 0.0)
+                if self.router == "tier-aware" and r.priority == 0:
+                    key = (cap["degraded"], out, i)
+                else:
+                    key = (out, i)
+                if best_key is None or key < best_key:
+                    best_i, best_key = i, key
+            i = best_i
+        slots = max(
+            self.replicas[i].advertised_capacity()["slots"], 1
+        )
+        self._load[i] = max(self._load[i], t) + \
+            self._est_cost_ms(self.replicas[i], r) / slots
+        return i
+
+    # -- replica loss + redistribution --------------------------------------
+
+    def _on_replica_loss(self, i: int, why: str, live: List[int],
+                         queue: Dict[int, List[Request]],
+                         results: Dict[int, RequestResult],
+                         qwaits, e2es, slo_oks, tel) -> None:
+        live.remove(i)
+        self.dead.append(i)
+        srv = self.replicas[i]
+        st = srv.journal.replay() if srv.journal is not None \
+            else JournalState(completed={}, in_flight={})
+        # Completed requests keep their journaled results — never
+        # re-run, metrics restored exactly like a single-replica
+        # journal resume.
+        for rid, rec in st.completed.items():
+            if rid in results:
+                continue
+            results[rid] = RequestResult(
+                id=rid, prompt_len=int(rec.get("plen") or 0),
+                tokens=list(rec.get("tokens", [])),
+                error=rec.get("error"),
+                latency_s=float(rec.get("latency_s") or 0.0),
+            )
+            if rec.get("qw") is not None:
+                qwaits[rid] = float(rec["qw"])
+            if rec.get("e2e") is not None:
+                e2es[rid] = float(rec["e2e"])
+            if rec.get("slo_ok") is not None:
+                slo_oks[rid] = bool(rec["slo_ok"])
+        remaining = [r for rid, r in sorted(self._owned[i].items())
+                     if rid not in results]
+        v = round(float(srv.decisions[-1]["v"]), 3) \
+            if srv.decisions else 0.0
+        self.decisions.append({
+            "d": "replica_loss", "v": v, "replica": i,
+            "in_flight": len(st.in_flight),
+            "redistributed": len(remaining), "survivors": len(live),
+        })
+        tel.emit("replica_loss", replica=i, error=str(why)[:200],
+                 completed=len(st.completed),
+                 in_flight=len(st.in_flight),
+                 redistributed=len(remaining), survivors=len(live),
+                 vclock_ms=v)
+        _log.warning(
+            "replica %d dead (%s): %d journaled complete, %d in "
+            "flight; redistributing %d request(s) across %d "
+            "survivor(s)", i, why, len(st.completed),
+            len(st.in_flight), len(remaining), len(live),
+        )
+        if not live:
+            return  # the caller raises FleetCrashLoop
+        for r in remaining:
+            toks = st.in_flight.get(r.id)
+            j = self._route(r, live)
+            if toks:
+                try:
+                    # The resume path re-prefills over prompt ‖ carried
+                    # — the whole prefix must fit a survivor bucket.
+                    self.replicas[j].ex.bucket_for(
+                        len(r.prompt) + len(toks))
+                except ValueError:
+                    _log.warning(
+                        "request %d's carried prefix (%d prompt + %d "
+                        "generated) exceeds replica %d's largest pad "
+                        "bucket: dropping the prefix — the request "
+                        "restarts from its prompt and regenerates the "
+                        "SAME tokens (keyed decode)", r.id,
+                        len(r.prompt), len(toks), j,
+                    )
+                    toks = None
+            if toks:
+                jr = self.replicas[j].journal
+                if jr is not None:
+                    # Transplant the dead replica's fence-validated
+                    # prefix: the survivor's ordinary replay prelude
+                    # then resumes via re-prefill over prompt‖carried.
+                    jr.admit(r.id, len(r.prompt), None,
+                             resumed=len(toks))
+                    jr.tokens(r.id, list(toks))
+                else:
+                    _log.warning(
+                        "replica %d has no journal: request %d "
+                        "restarts from its prompt on redistribution "
+                        "(output unchanged, carried prefix re-"
+                        "generated)", j, r.id,
+                    )
+            queue[j].append(r)
+            self._owned[j][r.id] = r
+            del self._owned[i][r.id]
+            self.redistributed += 1
+            self.decisions.append({
+                "d": "redistribute", "v": round(float(r.arrival_ms), 3),
+                "id": r.id, "from": i, "to": j,
+                "carried": len(toks or ()),
+            })
+            tel.emit("replica_route", id=r.id, replica=j,
+                     policy=self.router, redistributed=True,
+                     vclock_ms=round(float(r.arrival_ms), 3))
+
+    # -- the fleet loop -----------------------------------------------------
+
+    def run(self, requests: Sequence[Request]):
+        """Route, run every replica on the shared virtual timeline,
+        absorb replica losses, return ``(results, stats)`` merged
+        across the fleet.  Raises :class:`FleetCrashLoop` when the
+        last replica dies with work remaining."""
+        tel = _telemetry.current()
+        t0 = time.perf_counter()
+        n = len(self.replicas)
+        live = [i for i in range(n) if i not in self.dead]
+        queue: Dict[int, List[Request]] = {i: [] for i in range(n)}
+        for r in sorted(requests, key=lambda r: (r.arrival_ms, r.id)):
+            i = self._route(r, live)
+            queue[i].append(r)
+            self._owned[i][r.id] = r
+            self.decisions.append({
+                "d": "route", "v": round(float(r.arrival_ms), 3),
+                "id": r.id, "replica": i,
+            })
+            tel.emit("replica_route", id=r.id, replica=i,
+                     policy=self.router,
+                     vclock_ms=round(float(r.arrival_ms), 3))
+        results: Dict[int, RequestResult] = {}
+        qwaits: Dict[int, float] = {}
+        e2es: Dict[int, float] = {}
+        slo_oks: Dict[int, bool] = {}
+        agg = {k: 0 for k in _AGG_KEYS}
+        rounds = 0
+        while True:
+            rounds += 1
+            crashed = []
+            for i in list(live):
+                if rounds > 1 and not queue[i]:
+                    continue
+                batch, queue[i] = queue[i], []
+                try:
+                    res_i, st_i = self.replicas[i].run(batch)
+                except ServingCrashLoop as e:
+                    crashed.append((i, str(e)))
+                    continue
+                results.update(res_i)
+                srv = self.replicas[i]
+                qwaits.update(srv.last_queue_waits)
+                e2es.update(srv.last_e2es)
+                slo_oks.update(srv.last_slo_oks)
+                self.replica_stats[i] = st_i
+                for k in _AGG_KEYS:
+                    agg[k] += int(st_i.get(k) or 0)
+            if not crashed:
+                break
+            for i, why in crashed:
+                self._on_replica_loss(i, why, live, queue, results,
+                                      qwaits, e2es, slo_oks, tel)
+            if not live:
+                tel.emit("fleet_state", replicas=n, live=0,
+                         dead=len(self.dead), router=self.router,
+                         redistributed=self.redistributed,
+                         requests=len(results), rounds=rounds)
+                raise FleetCrashLoop(
+                    f"all {n} replicas dead (last: {crashed[-1][1]}) "
+                    "— unserved work remains, no peer can absorb it"
+                )
+        elapsed = time.perf_counter() - t0
+        self.last_queue_waits = dict(qwaits)
+        self.last_e2es = dict(e2es)
+        self.last_slo_oks = dict(slo_oks)
+        stats = self._stats(results, qwaits, e2es, slo_oks, agg,
+                            live, rounds, elapsed)
+        tel.emit("fleet_state", replicas=n, live=len(live),
+                 dead=len(self.dead), router=self.router,
+                 redistributed=self.redistributed,
+                 requests=len(results), rounds=rounds)
+        tel.note_summary(fleet_replicas=n,
+                         fleet_dead_replicas=len(self.dead),
+                         fleet_redistributed=self.redistributed)
+        return results, stats
+
+    # -- stats + the merged event queue -------------------------------------
+
+    def _stats(self, results, qwaits, e2es, slo_oks, agg, live,
+               rounds, elapsed) -> Dict[str, Any]:
+        def pct(vals: List[float], p: float) -> float:
+            if not vals:
+                return 0.0
+            return vals[min(len(vals) - 1,
+                            int(round(p * (len(vals) - 1))))]
+
+        qs = sorted(qwaits.values())
+        es = sorted(e2es.values())
+        tokens = sum(len(r.tokens) for r in results.values())
+        r0 = self.replicas[0]
+        stats: Dict[str, Any] = {
+            "requests": len(results),
+            "completed": sum(
+                1 for r in results.values() if r.error is None),
+            "failed": sum(1 for r in results.values() if r.error),
+            "tokens": tokens,
+            "elapsed_s": elapsed,
+            "tokens_per_s": tokens / max(elapsed, 1e-9),
+            "decode_steps_per_call": r0.decode_steps,
+            "policy": r0.policy.name,
+            "router": self.router,
+            "replicas": len(self.replicas),
+            "live_replicas": len(live),
+            "dead_replicas": len(self.dead),
+            "redistributed": self.redistributed,
+            "rounds": rounds,
+            "replica_capacity": [
+                0 if i in self.dead
+                else self.replicas[i].advertised_capacity()["slots"]
+                for i in range(len(self.replicas))
+            ],
+            "queue_wait_ms_p50": round(pct(qs, 0.50), 3),
+            "queue_wait_ms_p95": round(pct(qs, 0.95), 3),
+            "queue_wait_ms_p99": round(pct(qs, 0.99), 3),
+            "e2e_ms_p50": round(pct(es, 0.50), 3),
+            "e2e_ms_p99": round(pct(es, 0.99), 3),
+            "programs_per_decode_superstep": 1,
+            "kv_layout": ("paged" if getattr(r0.ex, "paged", False)
+                          else "padded"),
+            "shard": (list(r0.ex.shard)
+                      if getattr(r0.ex, "shard", None) else None),
+            "sampled": r0.sample is not None,
+        }
+        if getattr(r0.ex, "paged", False):
+            stats["kv_block"] = r0.ex.kv_block
+            stats["kv_blocks"] = r0.ex.kv_blocks
+        stats.update(agg)
+        if slo_oks:
+            stats["slo_attainment"] = round(
+                sum(slo_oks.values()) / len(slo_oks), 4
+            )
+        if any(st and st.get("drained") for st in self.replica_stats):
+            stats["drained"] = True
+        return stats
+
+    def merged_decisions(self) -> List[Dict[str, Any]]:
+        """The single merged fleet event queue: router + per-replica
+        decisions, ordered by virtual-clock stamp (router entries
+        first at equal instants, then replica index, then source
+        order — a total, replayable order)."""
+        merged = []
+        for seq, d in enumerate(self.decisions):
+            merged.append(
+                (float(d.get("v", 0.0)), -1, seq,
+                 dict(d, src="router"))
+            )
+        for i, srv in enumerate(self.replicas):
+            for seq, d in enumerate(srv.decisions):
+                merged.append(
+                    (float(d.get("v", 0.0)), i, seq,
+                     dict(d, src=f"replica{i}"))
+                )
+        merged.sort(key=lambda t: (t[0], t[1], t[2]))
+        return [d for _, _, _, d in merged]

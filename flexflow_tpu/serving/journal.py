@@ -38,7 +38,17 @@ Replay folds the line stream into :class:`JournalState`: requests with
 an ``sv_done`` are COMPLETED (never re-run), requests admitted but not
 done are IN-FLIGHT (resume with carried tokens), everything else is
 simply still queued.  A resumed server appends to the same file, so a
-second crash replays the union.
+second crash replays the union.  Records of UNKNOWN kind are skipped
+with one collected warning (forward compat: a fleet of replicas on
+mixed code revisions can exchange journals — a newer replica's extra
+record types degrade to a warning, never a wedge; SERVING.md "Fleet").
+
+The fold itself is :func:`fold_journal_events` — a module function
+over any record stream (``RunLog`` events or plain dicts), shared by
+the file-backed :class:`RequestJournal` and the file-free
+:class:`MemoryJournal` that the compute-free fleet sim journals
+through, so ``FleetRouter.simulated`` threads the IDENTICAL
+redistribution fold as the real fleet without touching disk.
 """
 
 from __future__ import annotations
@@ -46,12 +56,17 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, List, Optional
+import warnings
+from typing import Any, Dict, Iterable, List, Optional
 
 EV_ADMIT = "sv_admit"
 EV_TOKENS = "sv_tokens"
 EV_DONE = "sv_done"
 EV_DRAIN = "sv_drain"
+
+#: Every record kind this revision writes; anything else in a replayed
+#: journal is a future revision's record and is skipped with a warning.
+KNOWN_KINDS = frozenset({EV_ADMIT, EV_TOKENS, EV_DONE, EV_DRAIN})
 
 
 @dataclasses.dataclass
@@ -71,10 +86,66 @@ class JournalState:
     torn_tail: bool = False
     #: Mid-file garbage lines dropped by the tolerant parser.
     malformed: int = 0
+    #: kind -> count of records SKIPPED because this revision does not
+    #: know them (mixed-revision journal exchange, warned once).
+    unknown_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def empty(self) -> bool:
         return not self.completed and not self.in_flight
+
+
+def fold_journal_events(events: Iterable[Any]) -> JournalState:
+    """Fold a journal record stream into a :class:`JournalState`.
+
+    ``events`` may be ``RunLog`` events or plain record dicts (the
+    in-memory journal); each record needs an ``ev`` kind plus the
+    per-kind fields.  Unknown kinds are collected into
+    ``state.unknown_kinds`` and warned ONCE for the whole stream —
+    never raised — so a journal written by a newer revision still
+    replays everything this revision understands.
+    """
+    state = JournalState(completed={}, in_flight={})
+    acc: Dict[int, List[int]] = {}
+    unknown: Dict[str, int] = {}
+    for e in events:
+        kind = e.ev if hasattr(e, "ev") else e.get("ev")
+        if kind == EV_ADMIT:
+            rid = int(e["id"])
+            toks = acc.setdefault(rid, [])
+            if e.get("tok") is not None:
+                toks.append(int(e["tok"]))
+        elif kind == EV_TOKENS:
+            acc.setdefault(int(e["id"]), []).extend(
+                int(t) for t in e.get("toks", ())
+            )
+        elif kind == EV_DONE:
+            rid = int(e["id"])
+            data = e.data if hasattr(e, "data") else e
+            rec = {k: v for k, v in data.items()
+                   if k not in ("ev", "id", "n", "ts", "seq")}
+            rec["tokens"] = acc.pop(rid, [])
+            rec.setdefault("error", None)
+            rec.setdefault("plen", 0)
+            state.completed[rid] = rec
+        elif kind == EV_DRAIN:
+            state.drained = True
+        else:
+            unknown[str(kind)] = unknown.get(str(kind), 0) + 1
+    state.in_flight = {
+        rid: toks for rid, toks in acc.items()
+        if rid not in state.completed
+    }
+    if unknown:
+        state.unknown_kinds = dict(sorted(unknown.items()))
+        total = sum(unknown.values())
+        warnings.warn(
+            f"journal replay skipped {total} record(s) of unknown "
+            f"kind(s) {sorted(unknown)} — written by a newer revision? "
+            "Known work replayed normally (forward-compat skip).",
+            stacklevel=2,
+        )
+    return state
 
 
 class RequestJournal:
@@ -139,38 +210,36 @@ class RequestJournal:
         """Fold the journal into a :class:`JournalState`.  A missing
         file is an empty (fresh) journal; a torn tail or mid-file
         garbage is tolerated exactly like a telemetry log
-        (``obs/reader.py::RunLog.load``)."""
-        state = JournalState(completed={}, in_flight={})
+        (``obs/reader.py::RunLog.load``); unknown record kinds are
+        skipped with one collected warning."""
         if not os.path.exists(self.path):
-            return state
+            return JournalState(completed={}, in_flight={})
         from flexflow_tpu.obs.reader import RunLog
 
         log = RunLog.load(self.path)
+        state = fold_journal_events(log.events)
         state.torn_tail = bool(log.torn_tail)
         state.malformed = int(log.malformed)
-        acc: Dict[int, List[int]] = {}
-        for e in log.events:
-            if e.ev == EV_ADMIT:
-                rid = int(e["id"])
-                toks = acc.setdefault(rid, [])
-                if e.get("tok") is not None:
-                    toks.append(int(e["tok"]))
-            elif e.ev == EV_TOKENS:
-                acc.setdefault(int(e["id"]), []).extend(
-                    int(t) for t in e.get("toks", ())
-                )
-            elif e.ev == EV_DONE:
-                rid = int(e["id"])
-                rec = {k: v for k, v in e.data.items()
-                       if k not in ("ev", "id", "n", "ts", "seq")}
-                rec["tokens"] = acc.pop(rid, [])
-                rec.setdefault("error", None)
-                rec.setdefault("plen", 0)
-                state.completed[rid] = rec
-            elif e.ev == EV_DRAIN:
-                state.drained = True
-        state.in_flight = {
-            rid: toks for rid, toks in acc.items()
-            if rid not in state.completed
-        }
         return state
+
+
+class MemoryJournal(RequestJournal):
+    """A :class:`RequestJournal` that keeps its record stream in a
+    list instead of a file.  Same write API, same :func:`replay` fold
+    — the fleet sim gives every ``_SimEngine`` replica one of these so
+    redistribution after a simulated replica loss threads the exact
+    fold the real fleet threads through on-disk journals, while the
+    sim stays file-free and compute-free."""
+
+    def __init__(self):
+        super().__init__(path="<memory>")
+        self.records: List[Dict[str, Any]] = []
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        self.records.append(dict(rec))
+
+    def close(self) -> None:
+        pass
+
+    def replay(self) -> JournalState:
+        return fold_journal_events(self.records)

@@ -583,6 +583,22 @@ class ScheduledServer:
     def _max_seq(self) -> int:
         return self.ex.max_seq
 
+    def advertised_capacity(self) -> Dict[str, Any]:
+        """The router-facing capacity advertisement (SERVING.md
+        "Fleet").  ``slots`` already reflects any degraded-ladder rungs
+        taken (the rungs mutate ``max_batch`` / the block pool in
+        place), so a degraded replica advertises its REDUCED capacity
+        and the router weighs it down; ``degraded`` counts the rungs so
+        tier-aware routing can steer tier-0 traffic to the
+        least-degraded replica.  Identical in real and simulated mode
+        (the sim's executor IS the :class:`SlotShape`)."""
+        return {
+            "slots": int(self.ex.max_batch),
+            "degraded": len(self.degraded_rungs)
+            + (1 if self._degraded_oracle else 0),
+            "paged": bool(getattr(self.ex, "paged", False)),
+        }
+
     # -- the loop -----------------------------------------------------------
 
     def run(self, requests: Sequence[Request]):

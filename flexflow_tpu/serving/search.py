@@ -1,12 +1,14 @@
 """`--serve-auto`: the serving-config search (SEARCH.md mold).
 
 Searches (bucket boundaries x decode K x max_batch x scheduler policy
-knobs, plus speculative draft depth d when the baseline speculates)
+knobs, plus speculative draft depth d when the baseline speculates,
+plus replica count x router policy when the baseline runs a fleet)
 against the calibrated serving latency model, pricing every
 candidate by SIMULATING the real scheduler loop over the real workload
-(``ScheduledServer.simulated`` — the same decision code that will run
-the winner, so predicted dispatch counts are the executed dispatch
-counts, not a parallel formula that can drift).
+(``ScheduledServer.simulated`` — or ``FleetRouter.simulated`` for
+fleet candidates — the same decision code that will run the winner,
+so predicted dispatch counts are the executed dispatch counts, not a
+parallel formula that can drift).
 
 Legality is enforced at candidate-construction time through
 :class:`~flexflow_tpu.serving.scheduler.SlotShape`, which mirrors
@@ -26,6 +28,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.runtime.serving import Request
+from flexflow_tpu.serving.fleet import FleetRouter, ROUTER_POLICIES
 from flexflow_tpu.serving.latency_model import ServingLatencyModel
 from flexflow_tpu.serving.scheduler import (
     ADAPTIVE_K_CANDIDATES,
@@ -65,6 +68,12 @@ class ServingConfig:
     #: speculates — the draft SOURCE (checkpoint / truncation) is a
     #: deployment fact like the shard; d is the knob.
     speculate: int = 0
+    #: Fleet shape (SERVING.md "Fleet"): replica count + router
+    #: policy.  Searched only when the baseline RUNS a fleet — the
+    #: deployed engine count is the ceiling (more chips is an operator
+    #: decision, fewer is a knob); the router policy is free.
+    replicas: int = 1
+    router: str = "least-loaded"
 
     def __post_init__(self):
         from flexflow_tpu.runtime.serving import MAX_DECODE_STEPS_PER_CALL
@@ -84,6 +93,13 @@ class ServingConfig:
                 f"speculate must be in [0, "
                 f"{MAX_DECODE_STEPS_PER_CALL}]: {self.speculate}"
             )
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1: {self.replicas}")
+        if self.router not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router policy {self.router!r} "
+                f"(have: {', '.join(ROUTER_POLICIES)})"
+            )
 
     def shape(self) -> SlotShape:
         return SlotShape(max_batch=self.max_batch, max_seq=self.max_seq,
@@ -99,6 +115,8 @@ class ServingConfig:
             bits += f" shard={self.shard[0]}x{self.shard[1]}"
         if self.speculate > 0:
             bits += f" spec={self.speculate}"
+        if self.replicas > 1:
+            bits += f" replicas={self.replicas} router={self.router}"
         return bits + f" policy={self.policy.describe()}"
 
     def to_json(self) -> Dict[str, Any]:
@@ -115,6 +133,8 @@ class ServingConfig:
             "kv_blocks": self.kv_blocks,
             "shard": list(self.shard) if self.shard else None,
             "speculate": self.speculate,
+            "replicas": self.replicas,
+            "router": self.router,
         }
 
 
@@ -189,12 +209,20 @@ def candidate_kv_layouts(
 
 def _score(config: ServingConfig, requests: Sequence[Request],
            model: ServingLatencyModel) -> ScoredConfig:
-    srv = ScheduledServer.simulated(
-        config.shape(), decode_steps=config.decode_steps,
-        policy=config.policy, latency_model=model,
-        speculate=config.speculate,
-    )
-    _results, stats = srv.run(list(requests))
+    if config.replicas > 1:
+        fleet = FleetRouter.simulated(
+            config.shape(), config.replicas, router=config.router,
+            decode_steps=config.decode_steps, policy=config.policy,
+            latency_model=model, speculate=config.speculate,
+        )
+        _results, stats = fleet.run(list(requests))
+    else:
+        srv = ScheduledServer.simulated(
+            config.shape(), decode_steps=config.decode_steps,
+            policy=config.policy, latency_model=model,
+            speculate=config.speculate,
+        )
+        _results, stats = srv.run(list(requests))
     return ScoredConfig(
         config=config,
         predicted_p99_ms=stats["e2e_ms_p99"],
@@ -244,6 +272,15 @@ def search_serving_config(
         }))
     else:
         specs = (0,)
+    # Fleet knobs join only when the baseline RUNS a fleet: the
+    # deployed replica count is the ceiling (the search may conclude
+    # fewer replicas suffice — more chips is an operator decision);
+    # the router policy is free across ROUTER_POLICIES.
+    if baseline.replicas > 1:
+        reps = tuple(sorted({1, baseline.replicas,
+                             max(baseline.replicas // 2, 1)}))
+    else:
+        reps = (1,)
     configs: List[ServingConfig] = []
     seen = set()
     for bks in bucket_sets:
@@ -263,18 +300,26 @@ def search_serving_config(
                         for adaptive in adaptives:
                             pol = dataclasses.replace(
                                 base_pol, adaptive_k=adaptive)
-                            key = (bks, k_eff, b, kvb, kvn, sp,
-                                   adaptive)
-                            if key in seen:
-                                continue
-                            seen.add(key)
-                            configs.append(ServingConfig(
-                                buckets=bks, decode_steps=k_eff,
-                                max_batch=b,
-                                max_seq=baseline.max_seq, policy=pol,
-                                kv_block=kvb, kv_blocks=kvn,
-                                shard=baseline.shard, speculate=sp,
-                            ))
+                            for rep in reps:
+                                routers = ROUTER_POLICIES if rep > 1 \
+                                    else (baseline.router,)
+                                for rt in routers:
+                                    key = (bks, k_eff, b, kvb, kvn,
+                                           sp, adaptive, rep, rt)
+                                    if key in seen:
+                                        continue
+                                    seen.add(key)
+                                    configs.append(ServingConfig(
+                                        buckets=bks,
+                                        decode_steps=k_eff,
+                                        max_batch=b,
+                                        max_seq=baseline.max_seq,
+                                        policy=pol,
+                                        kv_block=kvb, kv_blocks=kvn,
+                                        shard=baseline.shard,
+                                        speculate=sp,
+                                        replicas=rep, router=rt,
+                                    ))
     if not any(c.to_json() == baseline.to_json() for c in configs):
         configs.append(baseline)
 
@@ -296,6 +341,8 @@ def search_serving_config(
             s.config.kv_block,
             s.config.speculate,
             not s.config.policy.adaptive_k,
+            s.config.replicas,
+            s.config.router,
         )
 
     chosen = min(scored, key=order)

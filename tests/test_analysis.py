@@ -478,14 +478,13 @@ class TestAuditRepoClean:
 
 
 class TestAuditMigration:
-    def test_runtime_audit_shim_warns_and_reexports(self):
+    def test_runtime_audit_retired_with_pointer(self):
+        # The deprecation shim served its cycle; a stale import must
+        # now fail LOUDLY, naming the relocated surface.
         sys.modules.pop("flexflow_tpu.runtime.audit", None)
-        with pytest.warns(DeprecationWarning, match="analysis.hlo"):
-            mod = importlib.import_module("flexflow_tpu.runtime.audit")
-        from flexflow_tpu.analysis import hlo
-
-        assert mod.collective_stats is hlo.collective_stats
-        assert mod.full_activation_allgathers is hlo.full_activation_allgathers
+        with pytest.raises(ImportError, match="analysis.hlo"):
+            importlib.import_module("flexflow_tpu.runtime.audit")
+        sys.modules.pop("flexflow_tpu.runtime.audit", None)
 
     def test_hlo_family_reachable_from_analysis(self):
         from flexflow_tpu.analysis.hlo import collective_stats

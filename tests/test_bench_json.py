@@ -113,6 +113,14 @@ def stubbed_bench(monkeypatch):
             "plain_tokens_per_dispatch": 6.0,
             "spec_vs_plain_tokens_per_dispatch": 1.5,
             "spec_match": True,
+            "fleet_replicas": 2,
+            "fleet_router": "least-loaded",
+            "fleet_queue_wait_ms_p99": 18.0,
+            "fleet_slo_attainment": 0.99,
+            "fleet_vs_single_attainment": 1.042,
+            "fleet_dead_replicas": 1,
+            "fleet_redistributed": 3,
+            "fleet_loss_slo_attainment": 0.9,
         }),
     )
     monkeypatch.setattr(
@@ -227,6 +235,17 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert serving["plain_tokens_per_dispatch"] == 6.0
     assert serving["spec_vs_plain_tokens_per_dispatch"] == 1.5
     assert serving["spec_match"] is True
+    # The fleet columns (SERVING.md "Fleet"): 2-replica attainment vs
+    # the single-replica slo run, plus the replica-loss sub-leg's
+    # dead/redistributed counters (the loss path provably ran).
+    assert serving["fleet_replicas"] == 2
+    assert serving["fleet_router"] == "least-loaded"
+    assert serving["fleet_queue_wait_ms_p99"] == 18.0
+    assert serving["fleet_slo_attainment"] == 0.99
+    assert serving["fleet_vs_single_attainment"] == 1.042
+    assert serving["fleet_dead_replicas"] == 1
+    assert serving["fleet_redistributed"] == 3
+    assert serving["fleet_loss_slo_attainment"] == 0.9
     # The execution-autotuner leg (ISSUE 6): auto-chosen config with
     # its predicted-vs-measured ms/step + the search wall time.
     search = record["extra"]["search"]

@@ -22,12 +22,14 @@ def chaos_root(tmp_path_factory):
 
 
 # The multi-host rig scenarios spawn real 2-process jax.distributed
-# worlds (generations are jit-compile dominated, ~2 min together), and
-# the speculation scenario compiles spec + plain decode programs for
-# padded AND paged layouts — slow-marked so the tier-1 `-m 'not slow'`
-# budget holds; the targeted `pytest tests/test_chaos.py` run and
+# worlds (generations are jit-compile dominated, ~2 min together), the
+# speculation scenario compiles spec + plain decode programs for
+# padded AND paged layouts, and the fleet scenario compiles three
+# replica engines — slow-marked so the tier-1 `-m 'not slow'` budget
+# holds; the targeted `pytest tests/test_chaos.py` run and
 # `tools/chaos_smoke.py` exercise them.
-_SLOW_SCENARIOS = {"host_loss", "coordinator_loss", "serving_spec_fault"}
+_SLOW_SCENARIOS = {"host_loss", "coordinator_loss", "serving_spec_fault",
+                   "replica_loss"}
 
 
 @pytest.mark.parametrize("name", [

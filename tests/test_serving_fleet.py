@@ -1,0 +1,428 @@
+"""Fleet router over replicated scheduled servers (SERVING.md "Fleet").
+
+Pinned invariants:
+
+- **Routing determinism**: every router policy is a pure function of
+  (workload, fleet state) on the shared virtual clock — two runs of
+  the same fleet produce identical decision logs and stats.
+- **Affinity stickiness**: a request id lands on the same replica
+  across independent fleets while the live set is unchanged (the
+  future prefix-sharing hook).
+- **Tier-aware capacity weighting**: tier-0 traffic prefers the
+  least-degraded replica; degraded-ladder replicas advertise reduced
+  capacity the router weighs.
+- **Sim == real through replica loss**: a simulated fleet threads the
+  IDENTICAL routing, redistribution and journal-fold decisions as the
+  real fleet under the same fault plan — decision-for-decision and
+  dispatch-for-dispatch (the serve-auto exactness contract, extended).
+- **Journal transplant**: a dead replica's in-flight prefixes are
+  re-admitted into the survivor's journal (``sv_admit`` with
+  ``resumed`` + ``sv_tokens``), so the ordinary replay prelude resumes
+  them; unknown record kinds in a replayed journal are skipped with
+  one warning (mixed-revision fleets exchange journals safely).
+- **Exit-code contract**: all replicas dead raises ``FleetCrashLoop``
+  → 78; 76 (world) and 77 (single-engine serving) keep their values.
+- **serve-auto fleet knobs**: replica count × router policy join the
+  search; every emitted candidate is legal and the fleet-scored stats
+  feed the same ScoredConfig surface.
+
+Fast cases run the compute-free simulated fleet; the one real-engine
+case (sim == real) is slow-marked — run this file WITHOUT the
+``-m 'not slow'`` filter to exercise it.  The byte-parity matrix
+(greedy / sampled / paged redistribution) lives in
+``test_serving_sched.py``.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.transformer import build_transformer_lm
+from flexflow_tpu.runtime.elastic import EXIT_WORLD_FAILURE
+from flexflow_tpu.runtime.serving import (
+    EXIT_SERVING_FAILURE,
+    Request,
+    ServingExecutor,
+    ServingFaultInjector,
+)
+from flexflow_tpu.serving import (
+    EXIT_FLEET_FAILURE,
+    FleetCrashLoop,
+    FleetRouter,
+    MemoryJournal,
+    RequestJournal,
+    ROUTER_POLICIES,
+    ScheduledServer,
+    SchedulerPolicy,
+    ServingConfig,
+    ServingResilience,
+    SlotShape,
+    WorkloadSpec,
+    fold_journal_events,
+    make_workload,
+    search_serving_config,
+)
+
+V, S = 64, 32
+
+SHAPE = SlotShape(max_batch=2, max_seq=S, buckets=(8, S))
+
+BURSTY = WorkloadSpec(n_requests=12, vocab=V, prompt_len=(3, 6),
+                      max_new=(2, 10), mean_gap_ms=1.0, burst=6,
+                      priorities=3, slo_ms=60.0, seed=5)
+
+
+def _req(rid, plen, max_new, arrival_ms=0.0, priority=0,
+         slo_ms=float("inf")):
+    return Request(id=rid,
+                   prompt=(np.arange(1, plen + 1, dtype=np.int32)
+                           * 3 % V),
+                   max_new_tokens=max_new, arrival_ms=arrival_ms,
+                   priority=priority, slo_ms=slo_ms)
+
+
+def _fleet(n=2, router="least-loaded", fault_injectors=None,
+           resilience=None, affinity_seed=0):
+    return FleetRouter.simulated(
+        SHAPE, n, router=router, decode_steps=4,
+        policy=SchedulerPolicy(name="slo"),
+        resilience=resilience, fault_injectors=fault_injectors,
+        affinity_seed=affinity_seed,
+    )
+
+
+# -- routing ------------------------------------------------------------------
+
+
+def test_router_validation():
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter([])
+    with pytest.raises(ValueError, match="unknown router"):
+        _fleet(router="round-robin")
+    with pytest.raises(ValueError):
+        FleetRouter.simulated(SHAPE, 0)
+
+
+@pytest.mark.parametrize("router", ROUTER_POLICIES)
+def test_routing_deterministic_per_policy(router):
+    outs = []
+    for _ in range(2):
+        fleet = _fleet(3, router=router)
+        results, stats = fleet.run(make_workload(BURSTY))
+        outs.append((fleet.decisions, fleet.merged_decisions(),
+                     {i: results[i].tokens for i in results}, stats))
+    (d_a, m_a, t_a, s_a), (d_b, m_b, t_b, s_b) = outs
+    assert d_a == d_b
+    assert m_a == m_b
+    assert t_a == t_b
+    assert {k: v for k, v in s_a.items() if k != "elapsed_s"
+            and k != "tokens_per_s"} \
+        == {k: v for k, v in s_b.items() if k != "elapsed_s"
+            and k != "tokens_per_s"}
+    assert s_a["router"] == router
+    assert s_a["completed"] == BURSTY.n_requests
+
+
+def test_least_loaded_spreads():
+    fleet = _fleet(2)
+    fleet.run(make_workload(BURSTY))
+    routed = [d["replica"] for d in fleet.decisions if d["d"] == "route"]
+    assert set(routed) == {0, 1}
+
+
+def test_affinity_sticky_across_fleets():
+    """The same request id lands on the same replica in two
+    independent fleets (keyed draw, not arrival order), and the key
+    actually spreads ids across replicas."""
+    homes = []
+    for _ in range(2):
+        fleet = _fleet(3, router="affinity")
+        fleet.run(make_workload(BURSTY))
+        homes.append({d["id"]: d["replica"] for d in fleet.decisions
+                      if d["d"] == "route"})
+    assert homes[0] == homes[1]
+    assert len(set(homes[0].values())) > 1
+    # A different affinity seed re-keys the placement.
+    other = _fleet(3, router="affinity", affinity_seed=7)
+    other.run(make_workload(BURSTY))
+    rehomed = {d["id"]: d["replica"] for d in other.decisions
+               if d["d"] == "route"}
+    assert rehomed != homes[0]
+
+
+def test_tier_aware_steers_tier0_off_degraded():
+    """Tier-0 requests prefer the least-degraded replica even when it
+    carries more outstanding load; other tiers stay least-loaded."""
+    fleet = _fleet(2, router="tier-aware")
+    # Replica 0 took a degraded-ladder rung (advertised, not modeled).
+    fleet.replicas[0].degraded_rungs.append(
+        {"rung": "decode_oracle"})
+    reqs = [_req(i, 4, 4, arrival_ms=0.0, priority=i % 2, slo_ms=60.0)
+            for i in range(6)]
+    fleet.run(reqs)
+    routed = {d["id"]: d["replica"] for d in fleet.decisions
+              if d["d"] == "route"}
+    tier0 = [routed[r.id] for r in reqs if r.priority == 0]
+    assert all(i == 1 for i in tier0)
+    # The non-critical tier still uses replica 0 (least-loaded wins).
+    assert any(routed[r.id] == 0 for r in reqs if r.priority == 1)
+
+
+def test_degraded_capacity_weighs_least_loaded():
+    """A replica advertising fewer slots accumulates modeled load
+    faster, so least-loaded shifts traffic toward the healthy one."""
+    fleet = _fleet(2)
+    fleet.replicas[0].ex = SlotShape(max_batch=1, max_seq=S,
+                                     buckets=(8, S))
+    reqs = [_req(i, 4, 8, arrival_ms=0.0) for i in range(8)]
+    fleet.run(reqs)
+    routed = [d["replica"] for d in fleet.decisions if d["d"] == "route"]
+    assert routed.count(1) > routed.count(0)
+
+
+# -- replica loss + redistribution (simulated) --------------------------------
+
+
+def test_replica_loss_redistributes_and_completes():
+    inj = {0: ServingFaultInjector(engine_raise_at={1: "sim death"})}
+    fleet = _fleet(2, fault_injectors=inj,
+                   resilience=ServingResilience(max_restarts=0))
+    results, stats = fleet.run(make_workload(BURSTY))
+    assert fleet.dead == [0]
+    assert stats["dead_replicas"] == 1
+    assert stats["live_replicas"] == 1
+    assert stats["redistributed"] > 0
+    assert stats["replica_capacity"][0] == 0
+    assert all(r.error is None for r in results.values())
+    assert len(results) == BURSTY.n_requests
+    kinds = [d["d"] for d in fleet.decisions]
+    assert "replica_loss" in kinds and "redistribute" in kinds
+    # Redistributed requests carry the dead replica's journaled prefix.
+    assert any(d["carried"] for d in fleet.decisions
+               if d["d"] == "redistribute")
+
+
+def test_all_replicas_dead_raises_fleet_crashloop():
+    inj = {i: ServingFaultInjector(engine_raise_at={1: "sim death"})
+           for i in range(2)}
+    fleet = _fleet(2, fault_injectors=inj,
+                   resilience=ServingResilience(max_restarts=0))
+    with pytest.raises(FleetCrashLoop, match="all 2 replicas dead"):
+        fleet.run(make_workload(BURSTY))
+    assert sorted(fleet.dead) == [0, 1]
+
+
+def test_exit_code_contract():
+    assert EXIT_FLEET_FAILURE == 78
+    assert EXIT_SERVING_FAILURE == 77
+    assert EXIT_WORLD_FAILURE == 76
+
+
+def test_journal_transplant_records():
+    """Redistribution writes the carried prefix into the survivor's
+    journal as a resumed admit + a tokens delta — the survivor's
+    ordinary replay prelude is the resume mechanism."""
+    inj = {0: ServingFaultInjector(engine_raise_at={1: "sim death"})}
+    fleet = _fleet(2, fault_injectors=inj,
+                   resilience=ServingResilience(max_restarts=0))
+    results, _ = fleet.run(make_workload(BURSTY))
+    moved = {d["id"]: d for d in fleet.decisions
+             if d["d"] == "redistribute"}
+    assert moved
+    jr = fleet.replicas[1].journal
+    assert isinstance(jr, MemoryJournal)
+    transplants = [r for r in jr.records
+                   if r["ev"] == "sv_admit" and r.get("resumed")]
+    carried_ids = {rid for rid, d in moved.items() if d["carried"]}
+    assert {r["id"] for r in transplants} == carried_ids
+    # Every redistributed request finished on the survivor.
+    state = jr.replay()
+    for rid in moved:
+        assert rid in state.completed
+        assert state.completed[rid]["tokens"] == results[rid].tokens
+
+
+def test_unbucketable_carried_prefix_dropped_not_failed(caplog):
+    """A survivor whose pad buckets cannot hold prompt ‖ carried gets
+    the request WITHOUT its prefix — it restarts from the prompt and
+    regenerates the SAME tokens (keyed decode) instead of erroring at
+    the re-prefill fence."""
+    import logging
+
+    inj = {0: ServingFaultInjector(engine_raise_at={1: "sim death"})}
+    fleet = _fleet(2, fault_injectors=inj,
+                   resilience=ServingResilience(max_restarts=0))
+    # The survivor only buckets up to 8: prompt (6) + carried prefix
+    # (>= 5 by the fault point) never fits, so every transplant drops.
+    fleet.replicas[1].ex = SlotShape(max_batch=2, max_seq=S,
+                                     buckets=(8,))
+    reqs = [_req(i, 6, 8, arrival_ms=float(i)) for i in range(6)]
+    with caplog.at_level(logging.WARNING, "ff.serving.fleet"):
+        results, stats = fleet.run(reqs)
+    dead_state = fleet.replicas[0].journal.replay()
+    dropped = [d["id"] for d in fleet.decisions
+               if d["d"] == "redistribute" and not d["carried"]
+               and dead_state.in_flight.get(d["id"])]
+    assert dropped
+    assert any("dropping the prefix" in r.getMessage()
+               for r in caplog.records)
+    assert stats["dead_replicas"] == 1
+    assert all(r.error is None for r in results.values())
+    assert len(results) == len(reqs)
+
+
+def test_journal_skips_unknown_kinds_with_one_warning():
+    """Forward compat (mixed-revision fleets exchange journals): a
+    record kind this revision does not know is skipped with ONE
+    collected warning; known work replays normally."""
+    jr = MemoryJournal()
+    jr.admit(0, 4, 11)
+    jr.records.append({"ev": "sv_prefix_share", "id": 0, "hash": "ab"})
+    jr.tokens(0, [12, 13])
+    jr.records.append({"ev": "sv_prefix_share", "id": 1, "hash": "cd"})
+    jr.admit(1, 3, 21)
+    jr.done(0, 4, 3)
+    with pytest.warns(UserWarning, match="unknown kind"):
+        state = jr.replay()
+    assert state.unknown_kinds == {"sv_prefix_share": 2}
+    assert state.completed[0]["tokens"] == [11, 12, 13]
+    assert state.in_flight == {1: [21]}
+
+
+def test_request_journal_unknown_kind_on_disk(tmp_path):
+    import json
+
+    path = tmp_path / "journal.jsonl"
+    recs = [
+        {"ev": "sv_admit", "id": 0, "plen": 4, "resumed": 0, "tok": 9},
+        {"ev": "sv_future_record", "id": 0, "payload": [1, 2]},
+        {"ev": "sv_tokens", "id": 0, "toks": [10, 11]},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    with pytest.warns(UserWarning, match="unknown kind"):
+        state = RequestJournal(str(path)).replay()
+    assert state.unknown_kinds == {"sv_future_record": 1}
+    assert state.in_flight == {0: [9, 10, 11]}
+
+
+def test_fold_journal_events_known_kinds_silent(recwarn):
+    state = fold_journal_events([
+        {"ev": "sv_admit", "id": 0, "plen": 4, "resumed": 0, "tok": 5},
+        {"ev": "sv_done", "id": 0, "plen": 4, "n": 1, "error": None},
+        {"ev": "sv_drain", "in_flight": 0, "queued": 0},
+    ])
+    assert not state.unknown_kinds and state.drained
+    assert [w for w in recwarn.list
+            if issubclass(w.category, UserWarning)] == []
+
+
+# -- sim == real through replica loss -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return build_transformer_lm(
+        batch_size=2, seq_len=S, vocab_size=V, d_model=32, num_heads=2,
+        num_layers=2, config=FFConfig(batch_size=2),
+    )
+
+
+@pytest.mark.slow
+def test_sim_matches_real_through_replica_loss(lm):
+    """The fleet exactness contract: under the same per-replica fault
+    plan, the simulated fleet's router decisions, per-replica decision
+    logs, and dispatch counters equal the real fleet's — through the
+    replica loss and the redistribution."""
+
+    def reqs():
+        return [_req(i, 4 + i % 3, 8, arrival_ms=float(i),
+                     priority=i % 2, slo_ms=60.0) for i in range(6)]
+
+    def plan():
+        return {0: ServingFaultInjector(
+            engine_raise_at={1: "replica down"})}
+
+    real_reps = []
+    inj_real = plan()
+    for i in range(2):
+        sex_i = ServingExecutor(lm, max_batch=2, max_seq=S,
+                                buckets=(8, S), decode_kernel=False)
+        params_i, state_i = sex_i.init(seed=0)
+        real_reps.append(ScheduledServer(
+            sex_i, params_i, state_i, decode_steps=4,
+            policy=SchedulerPolicy(name="slo"),
+            resilience=ServingResilience(max_restarts=0),
+            journal=MemoryJournal(),
+            fault_injector=inj_real.get(i)))
+    real = FleetRouter(real_reps)
+    real_res, real_st = real.run(reqs())
+
+    sim = _fleet(2, fault_injectors=plan(),
+                 resilience=ServingResilience(max_restarts=0))
+    sim_res, sim_st = sim.run(reqs())
+
+    assert sim.dead == real.dead == [0]
+    assert sim.decisions == real.decisions
+    for i in range(2):
+        assert sim.replicas[i].decisions == real.replicas[i].decisions
+    assert sim.merged_decisions() == real.merged_decisions()
+    for k in ("prefills", "decode_supersteps", "requests", "completed",
+              "failed", "redistributed", "rounds", "dead_replicas",
+              "queue_wait_ms_p50", "queue_wait_ms_p99", "e2e_ms_p50",
+              "e2e_ms_p99", "slo_attainment"):
+        assert sim_st[k] == real_st[k], k
+    # Token COUNTS match (sim fabricates token values, never counts).
+    assert {i: len(r.tokens) for i, r in sim_res.items()} \
+        == {i: len(r.tokens) for i, r in real_res.items()}
+
+
+# -- serve-auto fleet knobs ---------------------------------------------------
+
+
+def test_serving_config_fleet_validation():
+    pol = SchedulerPolicy(name="slo")
+    with pytest.raises(ValueError):
+        ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                      max_seq=32, policy=pol, replicas=0)
+    with pytest.raises(ValueError):
+        ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                      max_seq=32, policy=pol, replicas=2,
+                      router="round-robin")
+    cfg = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
+                        max_seq=32, policy=pol, replicas=2,
+                        router="tier-aware")
+    assert "replicas=2" in cfg.describe()
+    assert cfg.to_json()["replicas"] == 2
+    assert cfg.to_json()["router"] == "tier-aware"
+
+
+def test_serve_auto_searches_fleet_knobs():
+    """A fleet baseline searches replica count x router policy; every
+    candidate stays legal and single-replica candidates keep the
+    baseline router (no meaningless fan-out)."""
+    pol = SchedulerPolicy(name="slo")
+    base = ServingConfig(buckets=(8, S), decode_steps=8, max_batch=2,
+                         max_seq=S, policy=pol, replicas=2)
+    res = search_serving_config(make_workload(BURSTY), base,
+                                max_batch_cap=2)
+    reps = {c.config.replicas for c in res.candidates}
+    assert reps == {1, 2}
+    for c in res.candidates:
+        assert c.config.replicas >= 1
+        assert c.config.router in ROUTER_POLICIES
+        if c.config.replicas == 1:
+            assert c.config.router == base.router
+        assert c.predicted_dispatches > 0
+    routers = {c.config.router for c in res.candidates
+               if c.config.replicas == 2}
+    assert routers == set(ROUTER_POLICIES)
+    assert res.chosen.predicted_p99_ms <= res.baseline.predicted_p99_ms
+
+
+def test_serve_auto_single_replica_baseline_stays_single():
+    pol = SchedulerPolicy(name="slo")
+    base = ServingConfig(buckets=(8, S), decode_steps=8, max_batch=2,
+                         max_seq=S, policy=pol)
+    res = search_serving_config(make_workload(BURSTY), base,
+                                max_batch_cap=2)
+    assert {c.config.replicas for c in res.candidates} == {1}

@@ -952,3 +952,66 @@ def test_degraded_shrink_batch_rung(lm, weights, monkeypatch):
     with pytest.raises(DeviceMemoryError):
         ScheduledServer(ex1, params, state, decode_steps=4,
                         policy=SchedulerPolicy(name="slo"))
+
+
+# -- fleet redistribution parity (SERVING.md "Fleet") -------------------------
+
+
+@pytest.mark.parametrize("variant", [
+    "greedy",
+    pytest.param("sampled", marks=pytest.mark.slow),
+    pytest.param("paged", marks=pytest.mark.slow),
+])
+def test_fleet_redistribution_parity(lm, weights, variant):
+    """A request STARTED on replica A and FINISHED on replica B (after
+    A's engine fault exhausts its restart budget and the router
+    transplants A's journaled prefix into B's journal) generates a
+    byte-identical sequence to a single-replica run — greedy because
+    decode logits match the full-seq forward, sampled because draws
+    are keyed (seed, id, position), paged because cache layout changes
+    capacity, never content."""
+    from flexflow_tpu.serving import FleetRouter, MemoryJournal
+
+    params, state = weights
+    kw = {}
+    if variant == "sampled":
+        kw = dict(temperature=0.8, top_k=8, sample_seed=3)
+
+    def make_ex():
+        paged = dict(kv_block=8) if variant == "paged" else {}
+        return ServingExecutor(lm, max_batch=2, max_seq=S,
+                               buckets=(8, S), decode_kernel=False,
+                               **paged)
+
+    def reqs():
+        return [_req(i, 4 + i % 3, 10) for i in range(4)]
+
+    sex_a, sex_b = make_ex(), make_ex()
+    # The survivor shares its executor with the baseline run — shared
+    # compiled programs, and parity must hold through that reuse too.
+    base, _ = ScheduledServer(sex_b, params, state, decode_steps=4,
+                              **kw).run(reqs())
+    assert all(r.error is None for r in base.values())
+    inj = ServingFaultInjector(engine_raise_at={1: "replica A down"})
+    rep_a = ScheduledServer(
+        sex_a, params, state, decode_steps=4,
+        resilience=ServingResilience(max_restarts=0),
+        journal=MemoryJournal(), fault_injector=inj, **kw)
+    rep_b = ScheduledServer(
+        sex_b, params, state, decode_steps=4,
+        resilience=ServingResilience(max_restarts=0),
+        journal=MemoryJournal(), **kw)
+    fleet = FleetRouter([rep_a, rep_b])
+    results, stats = fleet.run(reqs())
+    assert stats["dead_replicas"] == 1 and fleet.dead == [0]
+    moved = [d for d in fleet.decisions if d["d"] == "redistribute"]
+    assert moved and any(d["carried"] for d in moved)
+    assert stats["redistributed"] == len(moved)
+    assert all(r.error is None for r in results.values())
+    # Byte parity regardless of which replica finished each request.
+    assert ({i: results[i].tokens for i in results}
+            == {i: base[i].tokens for i in base})
+    if variant == "paged":
+        assert stats["kv_layout"] == "paged"
+    if variant == "sampled":
+        assert stats["sampled"]

@@ -16,7 +16,11 @@ replay resumes byte-identically, padded AND paged) and
 at the fence, clean exit, resume byte-identical) and
 ``serving_spec_fault`` (faults inside the speculative draft+verify
 round: faulted slots error at the verify fence, survivors
-byte-identical to the UNSPECULATED run, padded AND paged) — and the multi-host world
+byte-identical to the UNSPECULATED run, padded AND paged) and
+``replica_loss`` (fleet: a replica engine-fault exhausts its restart
+budget, the router redistributes its journaled in-flight requests to
+the survivor, merged output byte-identical to the single-replica run,
+padded AND paged; SERVING.md "Fleet") — and the multi-host world
 failures, ``host_loss`` and ``coordinator_loss``, on the live
 2-process ``jax.distributed`` rig (RESILIENCE.md "Host loss & elastic
 resize": launcher-classified kill, elastic resize / same-world
@@ -69,13 +73,20 @@ def child(argv):
     import time
 
     t0 = time.perf_counter()
+    failures = n = 0
     with tempfile.TemporaryDirectory(prefix="chaos_smoke_") as root:
-        results = run_matrix(root, names)
-    failures = 0
-    for ok, name, detail in results:
-        print(f"{'PASS' if ok else 'FAIL'}  {name:<20} {detail}")
-        failures += 0 if ok else 1
-    n = len(results)
+        # One run_matrix call per scenario so each row carries its own
+        # wall time (the rig baseline cache in chaos.py persists across
+        # calls, so the split costs nothing).
+        for name in (names or list(SCENARIOS)):
+            ts = time.perf_counter()
+            results = run_matrix(root, [name])
+            dt = time.perf_counter() - ts
+            for ok, rname, detail in results:
+                print(f"{'PASS' if ok else 'FAIL'}  {rname:<22} "
+                      f"{dt:6.1f}s  {detail}")
+                failures += 0 if ok else 1
+                n += 1
     print(f"chaos matrix: {n - failures}/{n} passed "
           f"in {time.perf_counter() - t0:.1f}s")
     return 1 if failures else 0

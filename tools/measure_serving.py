@@ -2,7 +2,7 @@
 """Serving-scheduler A/B: the SERVING.md "Scheduler policy" acceptance
 run on the 8-dev virtual CPU mesh.
 
-Four measurements, each against its acceptance bar:
+The measurements, each against its acceptance bar:
 
 - ``slo_vs_fifo p99``: queue-wait p99 of the SLO-CARRYING class (tier
   0 — the class the policy exists to protect; the global p99 is
@@ -25,6 +25,15 @@ Four measurements, each against its acceptance bar:
   ``DeviceMemoryError``, the budget-sized paged pool must serve
   requests end-to-end, and at a short prompt it must admit >= 2x the
   padded concurrent batch (SERVING.md "Cache layout").
+- ``fleet t0 p99``: tier-0 queue-wait p99 of a 2-replica fleet behind
+  the least-loaded router vs the single engine, same bursty overload
+  (SERVING.md "Fleet"; attainment saturates at 1.0 here and cannot
+  differentiate).  Bar: >= 1.3x.
+- ``fleet replica loss``: replica 0 dies mid-run with a zero restart
+  budget — the fleet must journal-transplant its in-flight requests to
+  the survivor with ZERO failed requests, and its SLO attainment must
+  be >= the restarting single engine's (max_restarts=1, same fault)
+  every rep.
 
 All compared metrics are VIRTUAL-clock values (the latency model's
 deterministic ms), so the paired protocol's A/A control reads exactly
@@ -280,6 +289,100 @@ def child(argv):
             failures += 1
     finally:
         os.environ.pop("FF_DEVICE_MEM_BYTES", None)
+
+    # -- fleet: 2 replicas vs 1 under the same burst (bar >= 1.3x) ------------
+    # SERVING.md "Fleet": the least-loaded router spreads the burst
+    # across two replicas, so the tier-0 queue-wait p99 must drop
+    # >= 1.3x vs the single engine (slo_attainment saturates at 1.0 on
+    # this workload and cannot differentiate).  The faulted sub-leg
+    # kills replica 0 mid-run with a ZERO restart budget: the fleet
+    # must journal-transplant its in-flight requests to the survivor
+    # with no failed requests, and its attainment must be >= the
+    # restarting single engine's (max_restarts=1, same fault) every
+    # rep.  Fleet executors bucket up to max_seq: redistribution
+    # resumes by re-prefilling over prompt ‖ carried, and that whole
+    # prefix must fit a pad bucket.
+    from flexflow_tpu.runtime.serving import ServingFaultInjector
+    from flexflow_tpu.serving import (
+        FleetRouter,
+        MemoryJournal,
+        ServingResilience,
+    )
+
+    fl_stacks = []
+    for _ in range(2):
+        ex_i = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                               buckets=(8, max_seq))
+        p_i, s_i = ex_i.init(seed=0)
+        fl_stacks.append((ex_i, p_i, s_i))
+
+    def make_fleet(kill):
+        reps_ = []
+        for i, (ex_i, p_i, s_i) in enumerate(fl_stacks):
+            inj = (ServingFaultInjector(
+                engine_raise_at={1: "injected replica death"})
+                if kill and i == 0 else None)
+            reps_.append(ScheduledServer(
+                ex_i, p_i, s_i, decode_steps=8, policy=slo_pol,
+                resilience=ServingResilience(max_restarts=0),
+                journal=MemoryJournal(), fault_injector=inj))
+        return FleetRouter(reps_, router="least-loaded")
+
+    def t0_p99(waits, reqs):
+        tier0 = {r.id for r in reqs if r.priority == 0}
+        return pct([waits[i] for i in tier0 if i in waits], 0.99)
+
+    def fleet_run(seed, kill=False):
+        fleet = make_fleet(kill)
+        reqs = workload(seed)
+        _, stats = fleet.run(reqs)
+        return t0_p99(fleet.last_queue_waits, reqs), stats
+
+    def single_run(seed, kill=False):
+        ex0, p0, s0 = fl_stacks[0]
+        srv = ScheduledServer(
+            ex0, p0, s0, decode_steps=8, policy=slo_pol,
+            resilience=ServingResilience(max_restarts=1 if kill else 0),
+            journal=MemoryJournal(),
+            fault_injector=(ServingFaultInjector(
+                engine_raise_at={1: "injected replica death"})
+                if kill else None))
+        reqs = workload(seed)
+        _, stats = srv.run(reqs)
+        return t0_p99(srv.last_queue_waits, reqs), stats
+
+    res = paired_measure(
+        make_a=lambda r: single_run(r)[0],
+        make_b=lambda r: fleet_run(r)[0],
+        reps=reps,
+        control=lambda r: single_run(r)[0],
+    )
+    med, ctl = res.median_ratio, res.median_aa_ratio
+    ok = med >= 1.3
+    print(f"{'fleet t0 p99':<22} {med:>7.3f}x  (2 replicas vs 1, bar "
+          f">= 1.3x, a_a {ctl:.3f}x) {'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
+
+    worst_gap, clean, moved = None, True, 0
+    first = None
+    for r in range(reps):
+        _, fl = fleet_run(r, kill=True)
+        _, sg = single_run(r, kill=True)
+        gap = fl["slo_attainment"] - sg["slo_attainment"]
+        worst_gap = gap if worst_gap is None else min(worst_gap, gap)
+        clean = clean and fl["failed"] == 0 and fl["dead_replicas"] == 1
+        moved += fl["redistributed"]
+        if first is None:
+            first = (fl["slo_attainment"], sg["slo_attainment"])
+    ok = worst_gap is not None and worst_gap >= 0 and clean and moved > 0
+    print(f"{'fleet replica loss':<22} attainment fleet-loss "
+          f"{first[0]:.3f} vs single-restart {first[1]:.3f} (worst gap "
+          f"{worst_gap:+.3f}, bar >= 0; {moved} redistributed, "
+          f"{'0 failed' if clean else 'FAILED/NOT-DEAD'}) "
+          f"{'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
 
     return 1 if failures else 0
 

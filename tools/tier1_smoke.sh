@@ -37,5 +37,6 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_data_stream.py \
     tests/test_serving.py \
     tests/test_serving_sched.py \
+    tests/test_serving_fleet.py \
     tests/test_search.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly "$@"
