@@ -954,6 +954,39 @@ def bench_serving(n_chips: int, on_tpu: bool):
     out["fleet_dead_replicas"] = lstats["dead_replicas"]
     out["fleet_redistributed"] = lstats["redistributed"]
     out["fleet_loss_slo_attainment"] = lstats["slo_attainment"]
+
+    # Prefix-cache columns (SERVING.md "Prefix sharing"): the bursty
+    # workload with a shared system-prompt span on the paged pool with
+    # the content-hash index armed vs the SAME pool without it — hit
+    # rate, prefill dispatches saved, and the byte-parity bit (shared
+    # decode must match the unshared run token-for-token).
+    def pfx_workload():
+        return make_workload(WorkloadSpec(
+            n_requests=2 * n_req, vocab=vocab,
+            prompt_len=(4, max_seq // 4), max_new=(2, max_new),
+            mean_gap_ms=2.0, burst=n_req, priorities=2, slo_ms=60.0,
+            shared_prefix=kv_block, seed=13,
+        ))
+
+    def run_pfx(engine):
+        p, s = engine.init(0)  # same seed = identical weights
+        srv = ScheduledServer(engine, p, s, decode_steps=8,
+                              policy=SchedulerPolicy(name="slo"))
+        return srv.run(pfx_workload())
+
+    sexpc = ServingExecutor(ff, max_batch=max_batch, max_seq=max_seq,
+                            buckets=(max_seq // 2, max_seq),
+                            kv_block=kv_block, prefix_cache=True)
+    off_res, off_stats = run_pfx(sexp)
+    on_res, on_stats = run_pfx(sexpc)
+    out["prefix_hits"] = on_stats["prefix_hits"]
+    out["prefix_hit_rate"] = on_stats["prefix_hit_rate"]
+    out["prefill_tokens_saved"] = on_stats["prefill_tokens_saved"]
+    out["prefix_kv_cows"] = on_stats["kv_cows"]
+    out["prefix_prefills"] = on_stats["prefills"]
+    out["prefix_off_prefills"] = off_stats["prefills"]
+    out["prefix_match"] = all(
+        on_res[r].tokens == off_res[r].tokens for r in off_res)
     return out
 
 

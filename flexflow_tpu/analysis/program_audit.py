@@ -716,6 +716,28 @@ def audit_serving(sex, decode_steps: int = 8, prefix: str = "serving",
             continue
         out += purity_violations(jaxpr, name)
     caches = _serving_cache_avals(sex)
+    if getattr(sex, "prefix_cache", False):
+        # Prefix sharing (SERVING.md "Prefix sharing"): the offset
+        # prefill reads shared pool blocks and computes only the tail.
+        o = sex.kv_block
+        ids = jax.ShapeDtypeStruct((1,), jnp.int32)
+        for bucket in sex.buckets:
+            if bucket <= o:
+                continue
+            toks = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+            ln = jax.ShapeDtypeStruct((), jnp.int32)
+            name = f"{prefix}/prefill_from_L{bucket}_o{o}"
+            try:
+                jaxpr = jax.make_jaxpr(sex.build_prefill_from(bucket, o))(
+                    params, op_state, caches, ids, toks, ln
+                )
+            except Exception as e:
+                out.append(ProgramViolation(
+                    "FFP002", name,
+                    f"offset prefill failed to trace: "
+                    f"{type(e).__name__}: {e}"))
+                continue
+            out += purity_violations(jaxpr, name)
     k = decode_steps
     name = f"{prefix}/decode_k{k}"
     decode = sex.build_decode_superstep(k, sample=sample)
@@ -927,6 +949,13 @@ def audit_repo(fast: bool = True) -> List[ProgramViolation]:
                              buckets=(8, 16), kv_block=4, shard=(2, 2))
     out += audit_serving(sex_ps, decode_steps=4,
                          prefix="serving_paged_sharded")
+    # Prefix-sharing family (SERVING.md "Prefix sharing"): the paged
+    # pool with the content-hash index armed — audits the offset
+    # prefill (build_prefill_from) alongside the usual programs.
+    sex_pfx = ServingExecutor(_serving_graph(), max_batch=2, max_seq=16,
+                              buckets=(8, 16), kv_block=4,
+                              prefix_cache=True)
+    out += audit_serving(sex_pfx, decode_steps=4, prefix="serving_prefix")
     # Fleet family (SERVING.md "Fleet"): routing and redistribution are
     # pure host arithmetic — a fleet adds NO new program shapes, it
     # replicates the single-replica family.  Audit a second

@@ -37,6 +37,14 @@ Capacity flags (SERVING.md "Cache layout"):
   --shard N,C        shard the decode batch over mesh axis n and the
                      KV heads over c (build_mesh_plan over N*C
                      devices); falls back loudly below N*C devices
+  --prefix-cache     prefix sharing on the paged pool (needs
+                     --kv-block; SERVING.md "Prefix sharing"):
+                     ref-counted blocks + a content-hash index share
+                     resident full-block prompt prefixes at admission
+                     — the shared span's prefill compute is SKIPPED
+                     (offset prefill; zero dispatches on a memoized
+                     full hit), decode stays byte-identical to the
+                     unshared run
 
 Speculation flags (SERVING.md "Speculative decoding"):
   --speculate d      speculative decoding: draft d tokens + verify
@@ -62,9 +70,12 @@ Scheduler flags (each enables the scheduled path):
                      flag is present)
   --workload-trace [SRC]  open-loop workload instead of the uniform
                      stream: bare = zipf/bursty lengths (data/trace.py
-                     shape); ``prod[:alpha=A]`` = prompt tokens read
-                     LIVE from data/trace.py ProductionTraceSource
-                     (the shared power-law id source)
+                     shape); ``prod[:alpha=A,prefix=P]`` = prompt
+                     tokens read LIVE from data/trace.py
+                     ProductionTraceSource (the shared power-law id
+                     source); ``prefix=P`` arms the WorkloadSpec
+                     shared_prefix knob — a P-token system-prompt span
+                     most requests share (the prefix-cache workload)
   --trace-alpha A    zipf skew for prompt/output lengths (1.5)
   --mean-gap-ms X    mean inter-arrival gap, virtual ms (8.0)
   --burst N          requests arriving back-to-back per burst (4)
@@ -125,6 +136,7 @@ Example::
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 import time
@@ -186,6 +198,11 @@ def _dry_run(sex, decode_ks, speculate=0, replicas=1,
         print(f"{'prefill L=' + str(bucket):<18} "
               f"{'(1, ' + str(bucket) + ') -> token':<28} "
               f"1 dispatch + 1 fence per admission")
+    for bucket in sorted(table.get("prefill_from", {})):
+        o = sex.kv_block
+        print(f"{'prefill L=' + str(bucket) + ' o=' + str(o):<18} "
+              f"{'(1, ' + str(bucket) + ') from row ' + str(o):<28} "
+              f"offset prefill (shared prefix skipped)")
     for k in decode_ks:
         shape = (k,) + tuple(table["decode"].shape[1:])
         print(f"{'decode k=' + str(k):<18} "
@@ -266,6 +283,7 @@ def main(argv=None) -> int:
     no_kernel = _pop_flag(argv, "--no-decode-kernel")
     kv_block = pop_int(argv, "--kv-block", 0)
     kv_blocks = pop_int(argv, "--kv-blocks", 0)
+    prefix_cache = _pop_flag(argv, "--prefix-cache")
     shard_s = _pop_str(argv, "--shard", "")
     temperature = pop_float(argv, "--temperature", 0.0)
     top_k = pop_int(argv, "--top-k", 0)
@@ -305,7 +323,12 @@ def main(argv=None) -> int:
             and not workload_trace.startswith("prod"):
         raise SystemExit(
             f"--workload-trace expects nothing, 'zipf' or "
-            f"'prod[:alpha=A]', got {workload_trace!r}"
+            f"'prod[:alpha=A,prefix=P]', got {workload_trace!r}"
+        )
+    if prefix_cache and kv_block <= 0:
+        raise SystemExit(
+            "--prefix-cache shares blocks of the PAGED pool and needs "
+            "--kv-block N (SERVING.md \"Prefix sharing\")"
         )
     if speculate < 0:
         raise SystemExit(f"--speculate expects d >= 0, got {speculate}")
@@ -350,6 +373,7 @@ def main(argv=None) -> int:
             max_new=max_new, eos=eos, vocab=vocab, d_model=d_model,
             heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
             no_kernel=no_kernel, kv_block=kv_block, kv_blocks=kv_blocks,
+            prefix_cache=prefix_cache,
             shard=shard, temperature=temperature, top_k=top_k,
             sample_seed=sample_seed, journal_path=journal_path,
             speculate=speculate, draft_ckpt=draft_ckpt,
@@ -361,6 +385,7 @@ def main(argv=None) -> int:
         max_new=max_new, eos=eos, vocab=vocab, d_model=d_model,
         heads=heads, layers=layers, lo=lo, hi=hi, buckets=buckets,
         no_kernel=no_kernel, kv_block=kv_block, kv_blocks=kv_blocks,
+        prefix_cache=prefix_cache,
         shard=shard, temperature=temperature, top_k=top_k,
         sample_seed=sample_seed, policy_name=sched_s or "slo",
         workload_trace=workload_trace, trace_alpha=trace_alpha,
@@ -378,7 +403,7 @@ def main(argv=None) -> int:
 def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 max_new, eos, vocab, d_model, heads, layers, lo, hi,
                 buckets, no_kernel, kv_block, kv_blocks, shard,
-                temperature, top_k, sample_seed,
+                temperature, top_k, sample_seed, prefix_cache=False,
                 journal_path="", speculate=0, draft_ckpt="",
                 draft_layers=0) -> int:
     """The closed-loop FIFO path — still the chaos decode-fault
@@ -399,7 +424,7 @@ def _run_legacy(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         ff, cfg, max_batch=max_batch, max_seq=max_seq, buckets=buckets,
         decode_kernel=False if no_kernel else None,
         kv_block=kv_block, kv_blocks=kv_blocks or None, shard=shard,
-        draft_layers=draft_layers,
+        prefix_cache=prefix_cache, draft_layers=draft_layers,
     )
     if cfg.dry_run:
         # Inside maybe_run so the dry run's `analysis` audit event
@@ -453,6 +478,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                    max_new, eos, vocab, d_model, heads, layers, lo, hi,
                    buckets, no_kernel, kv_block, kv_blocks, shard,
                    temperature, top_k, sample_seed, policy_name,
+                   prefix_cache=False,
                    workload_trace, trace_alpha, mean_gap_ms, burst,
                    slo_ms, priorities, shed_depth, serve_auto,
                    journal_path="", serve_retries=0,
@@ -516,10 +542,15 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                     if workload_trace.startswith("prod:") else ""
                 kv = dict(p.split("=", 1) for p in args.split(",") if p)
                 id_alpha = float(kv.pop("alpha", 1.2))
+                shared_prefix = int(kv.pop("prefix", 0))
                 if kv:
                     raise SystemExit(
                         f"--workload-trace prod: unknown args "
-                        f"{sorted(kv)} (supported: alpha=A)"
+                        f"{sorted(kv)} (supported: alpha=A, prefix=P)"
+                    )
+                if shared_prefix:
+                    spec = dataclasses.replace(
+                        spec, shared_prefix=shared_prefix
                     )
                 requests = production_workload(spec, id_alpha=id_alpha)
             else:
@@ -536,6 +567,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 buckets=buckets, decode_steps=decode_steps,
                 max_batch=max_batch, max_seq=max_seq, policy=policy,
                 kv_block=kv_block, kv_blocks=kv_blocks or None,
+                prefix_cache=prefix_cache,
                 shard=shard, speculate=speculate,
                 replicas=replicas, router=router,
             )
@@ -552,6 +584,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
             policy = choice.config.policy
             kv_block = choice.config.kv_block
             kv_blocks = choice.config.kv_blocks or 0
+            prefix_cache = choice.config.prefix_cache
             speculate = choice.config.speculate
             replicas = choice.config.replicas
             router = choice.config.router
@@ -580,6 +613,7 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
                 buckets=buckets,
                 decode_kernel=False if no_kernel else None,
                 kv_block=kv_block, kv_blocks=kv_blocks or None,
+                prefix_cache=prefix_cache,
                 shard=shard, draft_layers=draft_layers,
             )
 
@@ -587,7 +621,8 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
         srv_proto = ScheduledServer.simulated(
             SlotShape(max_batch=max_batch, max_seq=max_seq,
                       buckets=buckets, kv_block=kv_block,
-                      kv_blocks=kv_blocks or None),
+                      kv_blocks=kv_blocks or None,
+                      prefix_cache=prefix_cache),
             decode_steps=decode_steps, policy=policy,
             latency_model=model,
         )
@@ -703,6 +738,11 @@ def _print_layout(stats) -> None:
     if stats.get("kv_layout") == "paged":
         print(f"kv layout = paged ({stats['kv_blocks']} x "
               f"{stats['kv_block']}-token blocks incl. scratch)")
+    if stats.get("prefix_cache"):
+        print(f"prefix cache = {stats['prefix_hits']} hits "
+              f"(rate {stats['prefix_hit_rate'] * 100:.1f}%), "
+              f"{stats['prefill_tokens_saved']} prefill tokens saved, "
+              f"{stats['kv_cows']} CoW blocks")
     if stats.get("shard"):
         n, c = stats["shard"]
         print(f"mesh shard = batch n={n} x heads c={c}")

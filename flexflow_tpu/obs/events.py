@@ -46,6 +46,8 @@ EVENT_CATALOG = frozenset({
     # serving (SERVING.md)
     "request_start",
     "prefill",
+    "prefix_hit",
+    "kv_cow",
     "decode_superstep",
     "spec_verify",
     "request_end",

@@ -242,6 +242,7 @@ class RunLog:
         steps = fences = sheds = preempts = 0
         retries = expiries = restarts = 0
         spec_rounds = spec_accepted = spec_draft = spec_emitted = 0
+        prefill_evs = prefix_hits = full_hits = tokens_saved = 0
         for e in self.events:
             if e.ev == "step":
                 steps += 1
@@ -271,6 +272,17 @@ class RunLog:
                 expiries += 1
             elif e.ev == "engine_restart":
                 restarts += 1
+            elif e.ev == "prefill":
+                # One event per executed prefill dispatch — full
+                # prefix hits execute none and emit none, so the
+                # counts reproduce the serving loops' hit-rate
+                # denominator (prefills + full hits) exactly.
+                prefill_evs += 1
+            elif e.ev == "prefix_hit":
+                prefix_hits += 1
+                if e.get("full"):
+                    full_hits += 1
+                tokens_saved += int(e.get("tokens_saved", 0))
             elif e.ev == "spec_verify":
                 # One event per speculative round (= per decode
                 # dispatch in spec mode), so the counts reproduce the
@@ -313,6 +325,13 @@ class RunLog:
             out["engine_restarts"] = restarts
         if slo_oks:
             out["slo_attainment"] = round(sum(slo_oks) / len(slo_oks), 4)
+        if prefix_hits:
+            # Same formula, gating and rounding as the serving loops'
+            # note_summary (runtime/serving.py / serving/scheduler.py).
+            out["prefix_hit_rate"] = round(
+                prefix_hits / max(prefill_evs + full_hits, 1), 4
+            )
+            out["prefill_tokens_saved"] = tokens_saved
         if spec_rounds:
             # Same formulas and rounding as the serving stats block
             # (runtime/serving.py / serving/scheduler.py).

@@ -126,11 +126,15 @@ class PositionEmbedding(Op):
             # the per-slot position of this call's FIRST token.  Decode
             # (t == 1) gathers one table row per slot; prefill starts
             # every slot at position 0 and may be shorter than the
-            # declared sequence (pad-to-bucket), so slice.
+            # declared sequence (pad-to-bucket), so slice.  The
+            # offset-prefill chunk sub-mode (prefix sharing) starts the
+            # call at absolute row ``chunk`` — a static int, so the
+            # slice stays static.
             if x.shape[1] == 1:
                 rows = jnp.take(table, state["pos"], axis=0)[:, None]
                 return [x + rows], state
-            return [x + table[None, : x.shape[1]]], state
+            start = int(state.get("chunk", 0))
+            return [x + table[None, start:start + x.shape[1]]], state
         return [x + table[None]], state
 
 
@@ -351,6 +355,26 @@ class MultiHeadAttention(Op):
             cv = cv.at[rows, pos].set(vh[:, :, 0].astype(cv.dtype))
             out = self._decode_attend(qh[:, :, 0], ck, cv, pos)
             y = self._merge_heads(out[:, :, None], x.dtype)
+        elif "chunk" in state:
+            # Offset-prefill chunk sub-mode (SERVING.md "Prefix
+            # sharing"): the t tokens sit at ABSOLUTE rows
+            # [o, o + t) of a cache whose rows [0, o) already hold the
+            # shared prefix's K/V (gathered from the paged pool).
+            # Queries attend the full [0, o + t) key span under the
+            # offset-causal mask — key j visible to query i iff
+            # j <= o + i — so row o + i sees exactly the history the
+            # unshared full prefill gives it, which is what keeps the
+            # tail KV and logits bit-identical to the unshared run
+            # (the masked-out _NEG_INF scores underflow to exact
+            # zeros, same as the dense path's causal tril).
+            o = int(state["chunk"])
+            ck = ck.at[:, o:o + t].set(
+                kh.transpose(0, 2, 1, 3).astype(ck.dtype)
+            )
+            cv = cv.at[:, o:o + t].set(
+                vh.transpose(0, 2, 1, 3).astype(cv.dtype)
+            )
+            y = self._attend_chunk(qh, ck, cv, o, t, x.dtype)
         else:
             ck = ck.at[:, :t].set(kh.transpose(0, 2, 1, 3).astype(ck.dtype))
             cv = cv.at[:, :t].set(vh.transpose(0, 2, 1, 3).astype(cv.dtype))
@@ -362,6 +386,29 @@ class MultiHeadAttention(Op):
         new_state["cache_k"] = ck
         new_state["cache_v"] = cv
         return [out_y], new_state
+
+    def _attend_chunk(self, qh, ck, cv, offset, t, dtype):
+        """Offset-prefill attention: ``t`` queries at absolute
+        positions ``offset .. offset+t-1`` against cache rows
+        ``[0, offset + t)`` — the shared prefix rows plus this call's
+        own writes.  Pure-jnp einsum formulation (the offset-causal
+        mask has no flash kernel shape; the span is one prefill
+        bucket, so the dense score matrix is small)."""
+        span = offset + t
+        kh = ck[:, :span].transpose(0, 2, 1, 3)      # (B, h, span, hd)
+        vh = cv[:, :span].transpose(0, 2, 1, 3)
+        q, k, v = (x.astype(jnp.float32) for x in (qh, kh, vh))
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if self.attrs["causal"]:
+            mask = (
+                jnp.arange(span)[None, :]
+                <= (offset + jnp.arange(t))[:, None]
+            )
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        return self._merge_heads(out, dtype)
 
     def _decode_attend(self, q1, ck, cv, pos):
         """Padded-layout decode attention dispatch: the Pallas

@@ -352,15 +352,18 @@ def scenario_loader_fault(root: str) -> Tuple[bool, str]:
                     trajectory(out["losses"], ITERS), out)
 
 
-def _serving_setup(kv_block: int = 0, buckets: Tuple[int, ...] = (8,)):
+def _serving_setup(kv_block: int = 0, buckets: Tuple[int, ...] = (8,),
+                   prefix_cache: bool = False):
     """Tiny transformer LM serving stack shared by the baseline and
     faulted runs of the serving chaos scenario (one instance = shared
     compiled programs; params deterministic from the seed).
     ``kv_block > 0`` builds the paged-KV variant of the same stack —
     params are identical across layouts, so paged survivor sequences
-    must stay byte-identical to the padded baseline.  The recovery
-    scenarios pass wider ``buckets`` so the re-prefill resume path
-    (prompt ‖ carried tokens) stays bucketable."""
+    must stay byte-identical to the padded baseline; ``prefix_cache``
+    arms the content-hash block-sharing index on it (SERVING.md
+    "Prefix sharing").  The recovery scenarios pass wider ``buckets``
+    so the re-prefill resume path (prompt ‖ carried tokens) stays
+    bucketable."""
     from flexflow_tpu.models.transformer import build_transformer_lm
     from flexflow_tpu.runtime.serving import ServingExecutor
 
@@ -369,7 +372,7 @@ def _serving_setup(kv_block: int = 0, buckets: Tuple[int, ...] = (8,)):
         num_heads=2, num_layers=1, config=FFConfig(batch_size=2),
     )
     sex = ServingExecutor(ff, max_batch=2, max_seq=32, buckets=buckets,
-                          kv_block=kv_block)
+                          kv_block=kv_block, prefix_cache=prefix_cache)
     params, state = sex.init(seed=0)
     return sex, params, state
 
@@ -1056,6 +1059,98 @@ def scenario_coordinator_loss(root: str) -> Tuple[bool, str]:
                   "clean world=2 run (reconstructed from telemetry)")
 
 
+def scenario_prefix_donor_eviction(root: str) -> Tuple[bool, str]:
+    """Prefix sharing under donor loss (SERVING.md "Prefix sharing"):
+    requests 0-2 share an 8-token (one full kv_block) system prompt;
+    the DONOR (r0, the first admission that installed the shared
+    block) crashes mid-decode while a sharer (r1) still points at it.
+    Refcounts must keep the donor's shared block alive — it must NOT
+    return to the (lowest-first) free list where r2's admission would
+    immediately recycle and overwrite it under r1 — and the
+    content-hash index must survive the donor's death so r2 still
+    prefix-hits.  Sharers' sequences stay byte-identical to the
+    UNSHARED padded oracle (and the paged cache-off run matches it
+    too, pinning that sharing, not paging, is the variable).
+
+    Timeline (2 slots, k=4): r0 (donor, max_new=8) + r1 (sharer,
+    max_new=16) admitted; the injected raise before superstep 1 fails
+    r0 at that fence; r2 (sharer) takes slot 0 — prefix hit against
+    the still-refcounted block; r3 (unrelated prompt) follows.
+    """
+    from flexflow_tpu.runtime.serving import (
+        Request,
+        Server,
+        ServingFaultInjector,
+    )
+
+    def reqs():
+        rng = np.random.default_rng(11)
+        span = rng.integers(0, 32, size=8).astype(np.int32)
+        tails = [rng.integers(0, 32, size=n).astype(np.int32)
+                 for n in (3, 4, 3)]
+        other = rng.integers(0, 32, size=5).astype(np.int32)
+        prompts = [np.concatenate([span, t]).astype(np.int32)
+                   for t in tails] + [other]
+        budgets = (8, 16, 8, 8)
+        return [Request(id=i, prompt=p, max_new_tokens=budgets[i])
+                for i, p in enumerate(prompts)]
+
+    # The unshared padded oracle (no pool, no sharing machinery).
+    sex, params, state = _serving_setup(buckets=(16,))
+    base_results, _ = Server(sex, params, state, decode_steps=4).run(
+        reqs())
+    if any(r.error for r in base_results.values()):
+        return False, "prefix_donor: unfaulted padded oracle had errors"
+    # Paged cache-off sub-check: paging alone changes nothing.
+    sexu, uparams, ustate = _serving_setup(kv_block=8, buckets=(16,))
+    uresults, _ = Server(sexu, uparams, ustate, decode_steps=4).run(
+        reqs())
+    for rid, r in base_results.items():
+        if uresults[rid].tokens != r.tokens:
+            return False, (f"prefix_donor[paged]: request {rid} "
+                           f"diverged from the padded oracle with the "
+                           f"cache OFF")
+    # Prefix cache armed, unfaulted: hits happen AND nothing diverges.
+    sexp, pparams, pstate = _serving_setup(kv_block=8, buckets=(16,),
+                                           prefix_cache=True)
+    cresults, cstats = Server(sexp, pparams, pstate,
+                              decode_steps=4).run(reqs())
+    if cstats.get("prefix_hits", 0) < 2:
+        return False, (f"prefix_donor: expected >= 2 prefix hits "
+                       f"unfaulted, got {cstats.get('prefix_hits')}")
+    for rid, r in base_results.items():
+        if cresults[rid].tokens != r.tokens:
+            return False, (f"prefix_donor: request {rid} diverged "
+                           f"from the unshared oracle (cache on, "
+                           f"unfaulted)")
+    # Donor eviction: raise before superstep 1 kills r0 (slot 0).
+    inj = ServingFaultInjector(raise_at={1: 0})
+    fres, fstats = Server(sexp, pparams, pstate, decode_steps=4,
+                          fault_injector=inj).run(reqs())
+    if {m for m, _, _ in inj.fired} != {"raise"}:
+        return False, (f"prefix_donor: injector fired "
+                       f"{sorted(m for m, _, _ in inj.fired)}")
+    failed = sorted(rid for rid, r in fres.items() if r.error)
+    if failed != [0]:
+        return False, (f"prefix_donor: expected the donor [0] to "
+                       f"error out, got {failed}")
+    if fstats.get("prefix_hits", 0) < 2:
+        return False, (f"prefix_donor: expected the index to survive "
+                       f"the donor (>= 2 hits), got "
+                       f"{fstats.get('prefix_hits')}")
+    for rid in (1, 2, 3):
+        if fres[rid].tokens != base_results[rid].tokens:
+            return False, (f"prefix_donor: sharer {rid}'s tokens "
+                           f"DIVERGED from the unshared oracle after "
+                           f"the donor crash (shared block freed or "
+                           f"recycled under a live refcount)")
+    return True, ("prefix_donor_eviction: donor crash left sharers "
+                  "byte-identical to the unshared run (refcounts held "
+                  "the shared block; the index survived — "
+                  f"{fstats['prefix_hits']} hits through the fault; "
+                  "padded oracle AND paged cache-off sub-checks)")
+
+
 SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "raised_fault": scenario_raised_fault,
     "nan_batch": scenario_nan_batch,
@@ -1070,6 +1165,7 @@ SCENARIOS: Dict[str, Callable[[str], Tuple[bool, str]]] = {
     "serving_engine_crash": scenario_serving_engine_crash,
     "serving_sigterm_drain": scenario_serving_sigterm_drain,
     "serving_spec_fault": scenario_serving_spec_fault,
+    "prefix_donor_eviction": scenario_prefix_donor_eviction,
     "replica_loss": scenario_replica_loss,
     "host_loss": scenario_host_loss,
     "coordinator_loss": scenario_coordinator_loss,

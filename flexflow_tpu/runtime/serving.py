@@ -280,6 +280,51 @@ class Request:
         return self.arrival_ms + self.slo_ms
 
 
+def prefix_digests(tokens, block: int) -> List[bytes]:
+    """Chained per-block content hashes of a prompt's FULL blocks —
+    the prefix-cache index key (SERVING.md "Prefix sharing").
+
+    Digest j covers tokens ``[0, (j+1)*block)``: ``h_0 =
+    sha1(block_0)``, ``h_j = sha1(h_{j-1} ‖ block_j)``, token ids
+    normalized to int64 bytes.  Chaining is what makes a digest a
+    sound key for CAUSAL KV content: K/V at row r depends only on
+    tokens ``[0, r]``, so two prompts agreeing on the first
+    ``(j+1)*block`` tokens have bit-equal KV in block j."""
+    import hashlib
+
+    toks = np.asarray(tokens, np.int64)
+    out: List[bytes] = []
+    prev = b""
+    for j in range(len(toks) // int(block)):
+        blk = toks[j * block:(j + 1) * block].tobytes()
+        out.append(hashlib.sha1(prev + blk).digest())
+        prev = out[-1]
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixPlan:
+    """Host-side admission plan from :meth:`KVBlockLedger.plan_prefix`.
+
+    ``use`` resident full-prefix blocks will be SHARED (refcount++);
+    ``cow`` matched blocks are recomputed privately instead (the
+    copy-on-write clamp: the prefill must compute at least the last
+    prompt token's logits, so a fully-covered prompt without a
+    memoized first token re-runs its final block); ``offset`` =
+    ``use * block`` is the first token row the offset prefill
+    computes.  ``full_hit`` means the whole prompt is covered AND the
+    first token is memoized — ZERO prefill dispatches; ``tok0`` is
+    that memoized token.  ``shared`` are the pool block ids to
+    reference, donor order."""
+
+    use: int
+    cow: int
+    offset: int
+    full_hit: bool
+    tok0: Optional[int] = None
+    shared: Tuple[int, ...] = ()
+
+
 class KVBlockLedger:
     """Host-side free-list accounting for the paged KV pool.
 
@@ -296,9 +341,20 @@ class KVBlockLedger:
     reads its own reserved region from it.  Freed blocks return to
     the free list and are reused LOWEST-FIRST (the list stays
     sorted), so allocation is deterministic across replays.
-    """
 
-    def __init__(self, num_blocks: int, block: int, max_seq: int):
+    ``prefix_cache=True`` arms prefix sharing (SERVING.md "Prefix
+    sharing"): every block carries a refcount, and a content-hash
+    index maps a prompt's chained full-block digests
+    (:func:`prefix_digests`) to resident pool blocks.
+    :meth:`plan_prefix` finds the longest resident prefix at
+    admission; :meth:`alloc` takes the shared block ids (refcount++)
+    and allocates only the tail fresh; :meth:`free` decrements and
+    returns a block to the free list only at refcount 0, dropping its
+    index entry with it.  All still host integers — sim exactness is
+    unchanged by construction."""
+
+    def __init__(self, num_blocks: int, block: int, max_seq: int,
+                 prefix_cache: bool = False):
         if block < 1 or max_seq % block:
             raise ValueError(
                 f"kv_block must divide max_seq: block={block}, "
@@ -314,8 +370,21 @@ class KVBlockLedger:
         self.max_seq = int(max_seq)
         #: Table-row width: worst-case blocks a slot could reference.
         self.blocks_per_slot = self.max_seq // self.block
+        self.prefix_cache = bool(prefix_cache)
         self._free: List[int] = list(range(1, self.num_blocks))
         self._held: Dict[int, List[int]] = {}
+        #: Per-block reference counts (every held block has one; 1 for
+        #: privately-owned blocks, > 1 when prefix-shared).
+        self._ref: Dict[int, int] = {}
+        #: Chained content digest -> resident pool block (live blocks
+        #: only — entries drop when their block's refcount hits 0).
+        self._index: Dict[bytes, int] = {}
+        #: Reverse map for index cleanup at free time.
+        self._digest_of: Dict[int, bytes] = {}
+        #: Full-prompt digest -> memoized greedy first token: the
+        #: zero-dispatch full-hit path.  Persists past eviction
+        #: (harmless: a full hit ALSO requires every block resident).
+        self._next_tok: Dict[bytes, int] = {}
 
     @property
     def capacity_blocks(self) -> int:
@@ -337,27 +406,119 @@ class KVBlockLedger:
     def can_admit(self, n_blocks: int) -> bool:
         return n_blocks <= len(self._free)
 
-    def alloc(self, slot: int, n_blocks: int) -> np.ndarray:
-        """Reserve ``n_blocks`` for ``slot``; returns the slot's full
-        ``(blocks_per_slot,)`` int32 table row (unreserved entries
-        point at scratch block 0)."""
+    def plan_prefix(self, prompt,
+                    total_len: Optional[int] = None) -> "PrefixPlan":
+        """Longest-resident-prefix lookup for one admission — pure
+        host arithmetic over :func:`prefix_digests` and the index.
+        ``total_len`` is the re-prefill length (prompt ‖ carried) for
+        journal/preemption resumes; matching is over the PROMPT only
+        (carried tokens are per-request decode output, never
+        indexed).  Returns the no-share plan when the cache is off or
+        nothing matches."""
+        plen = len(prompt)
+        flen = int(total_len) if total_len is not None else plen
+        if not self.prefix_cache or plen < self.block:
+            return PrefixPlan(0, 0, 0, False)
+        digests = prefix_digests(prompt, self.block)
+        matched: List[int] = []
+        for dgst in digests:
+            blk = self._index.get(dgst)
+            if blk is None:
+                break
+            matched.append(blk)
+        m = len(matched)
+        if m == 0:
+            return PrefixPlan(0, 0, 0, False)
+        if flen == plen == m * self.block:
+            tok0 = self._next_tok.get(digests[m - 1])
+            if tok0 is not None:
+                return PrefixPlan(m, 0, m * self.block, True,
+                                  int(tok0), tuple(matched))
+        # The offset prefill must compute the last real token's row
+        # (logits at flen - 1), so sharing clamps to offset <= flen-1:
+        # a fully-covered prompt without a first-token memo recomputes
+        # its final matched block privately — the copy-on-write case.
+        use = min(m, (flen - 1) // self.block)
+        return PrefixPlan(use, m - use, use * self.block, False,
+                          None, tuple(matched[:use]))
+
+    def alloc(self, slot: int, n_blocks: int,
+              shared: Sequence[int] = ()) -> np.ndarray:
+        """Reserve ``n_blocks`` TOTAL for ``slot``; returns the slot's
+        full ``(blocks_per_slot,)`` int32 table row (unreserved
+        entries point at scratch block 0).  ``shared`` names resident
+        pool blocks the slot references instead of allocating
+        (prefix sharing: refcount++, they fill the front of the row);
+        only ``n_blocks - len(shared)`` fresh blocks leave the free
+        list."""
+        shared = list(shared)
         if slot in self._held:
             raise RuntimeError(f"slot {slot} already holds KV blocks")
-        if n_blocks > len(self._free):
+        fresh_n = int(n_blocks) - len(shared)
+        if fresh_n < 0:
+            raise ValueError(
+                f"alloc: {len(shared)} shared blocks exceed the "
+                f"{n_blocks}-block reservation"
+            )
+        if fresh_n > len(self._free):
             raise RuntimeError(
-                f"paged KV pool exhausted: need {n_blocks} blocks, "
+                f"paged KV pool exhausted: need {fresh_n} blocks, "
                 f"{len(self._free)} free of {self.capacity_blocks}"
             )
-        got, self._free = self._free[:n_blocks], self._free[n_blocks:]
-        self._held[slot] = got
+        got, self._free = self._free[:fresh_n], self._free[fresh_n:]
+        for b in shared:
+            self._ref[b] += 1
+        for b in got:
+            self._ref[b] = 1
+        held = shared + got
+        self._held[slot] = held
         row = np.zeros((self.blocks_per_slot,), np.int32)
-        row[: len(got)] = got
+        row[: len(held)] = held
         return row
 
     def free(self, slot: int) -> None:
         got = self._held.pop(slot, None)
-        if got:
-            self._free = sorted(self._free + got)
+        if not got:
+            return
+        released: List[int] = []
+        for b in got:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                released.append(b)
+                dgst = self._digest_of.pop(b, None)
+                if dgst is not None and self._index.get(dgst) == b:
+                    del self._index[dgst]
+        if released:
+            self._free = sorted(self._free + released)
+
+    def register_prefix(self, slot: int, digests: Sequence[bytes],
+                        start: int = 0) -> None:
+        """Index ``slot``'s freshly-INSTALLED full-prompt blocks
+        (``digests[start:]`` onto held blocks ``start..``) so later
+        admissions can share them.  Called only AFTER the prefill
+        fence validated the install (never index blocks that were
+        never written — an engine-fault rollback ``free()`` would
+        otherwise leave dangling garbage shareable).  First writer
+        wins on digest collisions."""
+        if not self.prefix_cache:
+            return
+        held = self._held.get(slot, [])
+        for j in range(int(start), len(digests)):
+            if j >= len(held):
+                break
+            dgst = digests[j]
+            if dgst in self._index:
+                continue
+            self._index[dgst] = held[j]
+            self._digest_of[held[j]] = dgst
+
+    def record_next(self, digest: bytes, tok: int) -> None:
+        """Memoize the greedy first token after a block-aligned fresh
+        prefill — what upgrades a later identical admission from
+        offset-prefill to the ZERO-dispatch full hit."""
+        if self.prefix_cache:
+            self._next_tok[bytes(digest)] = int(tok)
 
 
 @dataclasses.dataclass
@@ -453,6 +614,7 @@ class ServingExecutor:
         kv_blocks: Optional[int] = None,
         shard: Optional[Tuple[int, int]] = None,
         draft_layers: int = 0,
+        prefix_cache: bool = False,
     ):
         self.model = model
         self.config = config or model.config
@@ -517,6 +679,14 @@ class ServingExecutor:
                 raise ValueError("kv_blocks needs kv_block > 0 (paged mode)")
             self.blocks_per_slot = 0
             self.kv_blocks = 0
+        # -- prefix sharing (SERVING.md "Prefix sharing") --
+        self.prefix_cache = bool(prefix_cache)
+        if self.prefix_cache and not self.paged:
+            raise ValueError(
+                "prefix_cache needs the paged KV layout (kv_block > 0): "
+                "sharing is block-table indirection — the padded layout "
+                "has no blocks to share"
+            )
         # -- sharded decode (batch on 'n', heads on 'c') --
         # Paged caches compose: the pool shards heads on 'c' only (no
         # batch axis to shard on 'n'), block tables stay host-side
@@ -707,7 +877,8 @@ class ServingExecutor:
         exact."""
         if not self.paged:
             raise ValueError("make_ledger() needs kv_block > 0 (paged mode)")
-        return KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq)
+        return KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq,
+                             prefix_cache=self.prefix_cache)
 
     def _budget_check(self):
         """Refuse BEFORE the first ``device_put`` when the KV cache
@@ -850,7 +1021,7 @@ class ServingExecutor:
     # -- the forward walk ---------------------------------------------------
 
     def _forward(self, params, op_state, tokens, caches, pos,
-                 block_table=None, skip=None):
+                 block_table=None, skip=None, chunk=0):
         """Forward-only walk over the non-loss op graph in inference
         mode: attention ops get their caches + the per-slot position
         vector through the existing ``state`` mechanism
@@ -861,8 +1032,12 @@ class ServingExecutor:
         outputs pass their first input through unchanged — skipping a
         whole ``blk{i}_`` group forwards the residual stream past the
         block, which is safe because every skipped op's internal
-        consumers are skipped with it.  Returns ``(logits,
-        new_caches)``."""
+        consumers are skipped with it.  ``chunk`` (static int, the
+        offset-prefill path) tells multi-token attention/position ops
+        that ``tokens`` starts at absolute row ``chunk`` of an
+        already-populated cache — KV writes land at
+        ``[chunk, chunk + t)`` and queries attend the full
+        ``[0, chunk + t)`` span.  Returns ``(logits, new_caches)``."""
         env: Dict[str, Any] = {self._tokens_name: tokens}
         new_caches: Dict[str, Any] = {}
         for op in self._layers:
@@ -892,8 +1067,12 @@ class ServingExecutor:
                 s["pos"] = pos
                 if block_table is not None:
                     s["block_table"] = block_table
+                if chunk:
+                    s["chunk"] = int(chunk)
             elif isinstance(op, PositionEmbedding):
                 s["pos"] = pos
+                if chunk:
+                    s["chunk"] = int(chunk)
             ys, s_new = op.forward(params.get(op.name, {}), xs, s,
                                    training=False)
             if op.name in caches:
@@ -905,6 +1084,35 @@ class ServingExecutor:
         return env[self._logits_name], new_caches
 
     # -- compiled programs ---------------------------------------------------
+
+    def _pick_first(self, sample: Optional[Tuple[float, int, int]]):
+        """THE prefill first-token closure, shared by
+        :meth:`build_prefill` and :meth:`build_prefill_from` so the
+        two can never drift: greedy argmax, or (sampled variant) the
+        ``fold_in(fold_in(key(seed), req_id), length - 1)`` draw for
+        RESUMED positions — a fresh admission (``length == plen``)
+        stays greedy, the decode head only ever samples positions past
+        the prompt."""
+        base_key = (
+            jax.random.key(sample[2]) if sample is not None else None
+        )
+
+        def pick_first(last, length, plen, rid):
+            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            if sample is None:
+                return greedy
+            temperature, top_k, _seed = sample
+            kkey = jax.random.fold_in(
+                jax.random.fold_in(base_key, rid), length - 1
+            )
+            lg = last.astype(jnp.float32) / temperature
+            if 0 < top_k < lg.shape[-1]:
+                kth = jax.lax.top_k(lg, top_k)[0][-1]
+                lg = jnp.where(lg >= kth, lg, -jnp.inf)
+            drawn = jax.random.categorical(kkey, lg).astype(jnp.int32)
+            return jnp.where(length > plen, drawn, greedy)
+
+        return pick_first
 
     def build_prefill(self, bucket: int,
                       sample: Optional[Tuple[float, int, int]] = None):
@@ -932,24 +1140,7 @@ class ServingExecutor:
         if fn is not None:
             return fn
         S = self.max_seq
-        base_key = (
-            jax.random.key(sample[2]) if sample is not None else None
-        )
-
-        def pick_first(last, length, plen, rid):
-            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            if sample is None:
-                return greedy
-            temperature, top_k, _seed = sample
-            kkey = jax.random.fold_in(
-                jax.random.fold_in(base_key, rid), length - 1
-            )
-            lg = last.astype(jnp.float32) / temperature
-            if 0 < top_k < lg.shape[-1]:
-                kth = jax.lax.top_k(lg, top_k)[0][-1]
-                lg = jnp.where(lg >= kth, lg, -jnp.inf)
-            drawn = jax.random.categorical(kkey, lg).astype(jnp.int32)
-            return jnp.where(length > plen, drawn, greedy)
+        pick_first = self._pick_first(sample)
 
         def run(params, op_state, tokens, length, plen, rid):
             caches = {
@@ -984,6 +1175,98 @@ class ServingExecutor:
         fn = self._prefill_fns[key] = jax.jit(prefill)
         _telemetry.current().emit("serving_program", kind="prefill",
                                   bucket=int(bucket),
+                                  sampled=sample is not None)
+        return fn
+
+    def build_prefill_from(
+        self, bucket: int, offset: int,
+        sample: Optional[Tuple[float, int, int]] = None,
+    ):
+        """Offset prefill for prefix sharing (SERVING.md "Prefix
+        sharing"; paged + ``prefix_cache`` only): the
+        :meth:`build_prefill` body started at row ``offset`` — the
+        shared span's KV is GATHERED from resident pool blocks
+        instead of recomputed, so the program runs ``bucket - offset``
+        token positions at the same one-dispatch-one-fence
+        discipline.  ``(params, op_state, pool, shared_ids
+        (offset/kv_block,), tokens (1, bucket), length) ->
+        (cache_rows, first_token, finite)`` — ``pool`` is the live
+        paged cache dict (read-only: NOT donated), ``cache_rows``
+        carry zeros for ``[0, offset)`` (the masked install writes
+        those chunks into scratch block 0; the slot's table row keeps
+        pointing at the shared blocks).  The sampled variant appends
+        ``(prompt_len, req_id)`` exactly like :meth:`build_prefill`.
+
+        Byte-identity to the unshared run: K/V at row r is causal —
+        it depends only on tokens ``[0, r]`` — so the gathered donor
+        rows are bit-equal to what this prompt's own prefill would
+        have written there, and the tail attends the full
+        ``[0, bucket)`` key span under the same offset-causal mask
+        the dense prefill applies (``ops/attention.py`` chunk
+        sub-mode)."""
+        if not self.paged or not self.prefix_cache:
+            raise ValueError(
+                "build_prefill_from needs paged + prefix_cache "
+                "(SERVING.md 'Prefix sharing')"
+            )
+        offset = int(offset)
+        if offset < self.kv_block or offset % self.kv_block or \
+                offset >= bucket:
+            raise ValueError(
+                f"offset must be a multiple of kv_block="
+                f"{self.kv_block} in [kv_block, bucket): offset="
+                f"{offset}, bucket={bucket}"
+            )
+        if sample is not None:
+            temperature, top_k, sample_seed = sample
+            sample = (float(temperature), int(top_k), int(sample_seed))
+        key = ("from", bucket, offset, sample)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        S = self.max_seq
+        o = offset
+        pick_first = self._pick_first(sample)
+
+        def run(params, op_state, pool, shared_ids, tokens, length,
+                plen, rid):
+            caches = {}
+            for name, (h, hd, dt) in self._cache_specs.items():
+                gk = pool[name]["k"][shared_ids].reshape(o, h, hd)
+                gv = pool[name]["v"][shared_ids].reshape(o, h, hd)
+                caches[name] = {
+                    "k": jnp.zeros((1, S, h, hd), dt).at[0, :o].set(gk),
+                    "v": jnp.zeros((1, S, h, hd), dt).at[0, :o].set(gv),
+                }
+            pos = jnp.full((1,), o, jnp.int32)
+            logits, caches = self._forward(
+                params, op_state, tokens[:, o:], caches, pos, chunk=o
+            )
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1 - o, axis=0, keepdims=False
+            )
+            tok = pick_first(last, length, plen, rid)
+            ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)))
+            rows = {
+                name: {"k": c["k"][0], "v": c["v"][0]}
+                for name, c in caches.items()
+            }
+            return rows, tok, ok
+
+        if sample is not None:
+            def prefill(params, op_state, pool, shared_ids, tokens,
+                        length, plen, rid):
+                return run(params, op_state, pool, shared_ids, tokens,
+                           length, plen, rid)
+        else:
+            def prefill(params, op_state, pool, shared_ids, tokens,
+                        length):
+                return run(params, op_state, pool, shared_ids, tokens,
+                           length, None, None)
+
+        fn = self._prefill_fns[key] = jax.jit(prefill)
+        _telemetry.current().emit("serving_program", kind="prefill_from",
+                                  bucket=int(bucket), offset=o,
                                   sampled=sample is not None)
         return fn
 
@@ -1409,6 +1692,23 @@ class ServingExecutor:
                 params, op_state, caches, pos, tok,
             )
         out["decode"] = toks
+        if self.paged and self.prefix_cache:
+            # Prefix sharing: trace the offset prefill at one
+            # representative offset (kv_block) per bucket that can
+            # host one — the dry-run coverage for the chunked forward.
+            out["prefill_from"] = {}
+            o = self.kv_block
+            ids = jax.ShapeDtypeStruct((1,), jnp.int32)
+            for bucket in self.buckets:
+                if bucket <= o:
+                    continue
+                toks_in = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+                ln = jax.ShapeDtypeStruct((), jnp.int32)
+                _rows, tok_a, _okf = jax.eval_shape(
+                    self.build_prefill_from(bucket, o),
+                    params, op_state, caches, ids, toks_in, ln,
+                )
+                out["prefill_from"][bucket] = tok_a
         if speculate:
             dcaches = {
                 name: {
@@ -1530,6 +1830,10 @@ class Server:
         total_tokens = 0
         supersteps = 0
         prefills = 0
+        prefix_hits = 0
+        full_hits = 0
+        prefill_tokens_saved = 0
+        kv_cows = 0
         draft_prefills = 0
         decode_tokens = 0
         spec_accept_total = 0
@@ -1675,6 +1979,7 @@ class Server:
                         carried_map.pop(r.id, None)
                         reject(r, str(e))
                         continue
+                    plan = None
                     if ledger is not None:
                         need = ledger.blocks_for(plen, r.max_new_tokens)
                         if need > ledger.capacity_blocks:
@@ -1685,7 +1990,13 @@ class Server:
                                 f"{ledger.capacity_blocks}"
                             ))
                             continue
-                        if not ledger.can_admit(need):
+                        # Prefix sharing: shared blocks don't leave the
+                        # free list, so admission only needs the
+                        # non-shared tail — a hit can admit where a
+                        # miss would head-of-line wait.
+                        plan = ledger.plan_prefix(r.prompt,
+                                                  total_len=flen)
+                        if not ledger.can_admit(need - plan.use):
                             # Head-of-line wait: blocks free up when an
                             # active slot finishes (deterministic FIFO —
                             # no reorder, no livelock: the whole pool
@@ -1705,24 +2016,73 @@ class Server:
                         padded[0, plen:flen] = np.asarray(
                             prior, np.int32
                         )
+                    digests = (
+                        prefix_digests(r.prompt, ledger.block)
+                        if ledger is not None and ledger.prefix_cache
+                        else []
+                    )
                     t0 = time.perf_counter()
-                    # Sampled runs prefill through the sampled
-                    # variant so a RESUMED position replays the
-                    # decode head's exact draw (greedy when
-                    # flen == plen, i.e. a fresh admission).
-                    pf = ex.build_prefill(bucket, sample=self.sample)
-                    pf_args = (self.params, self.op_state, padded,
-                               np.int32(flen))
-                    if self.sample is not None:
-                        pf_args += (np.int32(plen), np.int32(r.id))
-                    tel.program_cost("prefill", pf, pf_args,
-                                     bucket=bucket)
-                    rows, tok0, okf = pf(*pf_args)
-                    tok0, ok = tel.fence((tok0, okf), "prefill")
-                    pf_s = time.perf_counter() - t0
-                    prefills += 1
-                    tel.emit("prefill", id=r.id, bucket=bucket,
-                             wall_s=round(pf_s, 6))
+                    if plan is not None and plan.full_hit:
+                        # -- ZERO-dispatch admission: the whole prompt
+                        # is resident full blocks and the greedy first
+                        # token is memoized — no prefill program runs
+                        # at all (the prefix-sharing headline).
+                        tok0, ok, rows = plan.tok0, True, None
+                        pf_s = 0.0
+                        prefix_hits += 1
+                        full_hits += 1
+                        prefill_tokens_saved += plan.offset
+                        tel.emit("prefix_hit", id=r.id,
+                                 blocks=plan.use, full=True,
+                                 tokens_saved=plan.offset)
+                    elif plan is not None and plan.use > 0:
+                        # -- partial hit: gather the shared span from
+                        # the pool, compute only the tail through the
+                        # offset prefill (same fence discipline).
+                        pf = ex.build_prefill_from(
+                            bucket, plan.offset, sample=self.sample
+                        )
+                        shared_ids = np.asarray(plan.shared, np.int32)
+                        pf_args = (self.params, self.op_state, caches,
+                                   shared_ids, padded, np.int32(flen))
+                        if self.sample is not None:
+                            pf_args += (np.int32(plen), np.int32(r.id))
+                        tel.program_cost("prefill", pf, pf_args,
+                                         bucket=bucket)
+                        rows, tok0, okf = pf(*pf_args)
+                        tok0, ok = tel.fence((tok0, okf), "prefill")
+                        pf_s = time.perf_counter() - t0
+                        prefills += 1
+                        prefix_hits += 1
+                        prefill_tokens_saved += plan.offset
+                        tel.emit("prefill", id=r.id, bucket=bucket,
+                                 offset=plan.offset,
+                                 wall_s=round(pf_s, 6))
+                        tel.emit("prefix_hit", id=r.id,
+                                 blocks=plan.use, full=False,
+                                 tokens_saved=plan.offset)
+                        if plan.cow:
+                            kv_cows += plan.cow
+                            tel.emit("kv_cow", id=r.id,
+                                     blocks=plan.cow)
+                    else:
+                        # Sampled runs prefill through the sampled
+                        # variant so a RESUMED position replays the
+                        # decode head's exact draw (greedy when
+                        # flen == plen, i.e. a fresh admission).
+                        pf = ex.build_prefill(bucket, sample=self.sample)
+                        pf_args = (self.params, self.op_state, padded,
+                                   np.int32(flen))
+                        if self.sample is not None:
+                            pf_args += (np.int32(plen), np.int32(r.id))
+                        tel.program_cost("prefill", pf, pf_args,
+                                         bucket=bucket)
+                        rows, tok0, okf = pf(*pf_args)
+                        tok0, ok = tel.fence((tok0, okf), "prefill")
+                        pf_s = time.perf_counter() - t0
+                        prefills += 1
+                        tel.emit("prefill", id=r.id, bucket=bucket,
+                                 wall_s=round(pf_s, 6))
                     if jr is not None:
                         jr.admit(r.id, plen,
                                  int(tok0) if bool(ok) else None,
@@ -1735,9 +2095,32 @@ class Server:
                                error="non-finite logits in prefill")
                         continue
                     if ledger is not None:
-                        row = ledger.alloc(slot_i, need)
+                        row = ledger.alloc(slot_i, need,
+                                           shared=plan.shared)
                         block_table[slot_i] = row
-                        caches = ex.install_paged(caches, rows, row)
+                        if rows is not None:
+                            # Masked install: shared entries write
+                            # their (all-zero) chunks into scratch
+                            # block 0 — the donor's blocks are never
+                            # touched; the table row keeps the real
+                            # shared ids for decode.
+                            masked = row.copy()
+                            masked[: plan.use] = 0
+                            caches = ex.install_paged(caches, rows,
+                                                      masked)
+                        if digests:
+                            # Index only AFTER the fence validated the
+                            # install (never make never-written blocks
+                            # shareable); memoize the first token when
+                            # the prompt is exactly block-aligned and
+                            # fresh — the future full-hit upgrade.
+                            ledger.register_prefix(slot_i, digests,
+                                                   start=plan.use)
+                            if flen == plen and \
+                                    plen % ledger.block == 0 and \
+                                    not plan.full_hit:
+                                ledger.record_next(digests[-1],
+                                                   int(tok0))
                     else:
                         caches = ex.install(caches, rows, slot_i)
                     if spec_d:
@@ -1925,6 +2308,22 @@ class Server:
         if ex.paged:
             stats["kv_block"] = ex.kv_block
             stats["kv_blocks"] = ex.kv_blocks
+        if getattr(ex, "prefix_cache", False):
+            stats["prefix_cache"] = True
+            stats["prefix_hits"] = prefix_hits
+            stats["prefix_hit_rate"] = round(
+                prefix_hits / max(prefills + full_hits, 1), 4
+            )
+            stats["prefill_tokens_saved"] = prefill_tokens_saved
+            stats["kv_cows"] = kv_cows
+            if prefix_hits:
+                # Final-rounded into the run_end summary block;
+                # reconstruct_summary recomputes both from the raw
+                # prefill/prefix_hit events and must match bit-for-bit.
+                tel.note_summary(
+                    prefix_hit_rate=stats["prefix_hit_rate"],
+                    prefill_tokens_saved=prefill_tokens_saved,
+                )
         if self.speculate:
             stats["speculate"] = self.speculate
             stats["draft_layers"] = ex.draft_layers

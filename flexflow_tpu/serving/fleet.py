@@ -23,11 +23,14 @@ lowest index breaks ties):
   outstanding): the latency-critical class prefers the
   least-degraded replica; other tiers fall back to least-loaded.
 - ``affinity`` — sticky keyed placement: a fold_in-style seeded draw
-  over the live replicas (``default_rng([affinity_seed, request.id])``
-  — the workload generator's keyed-stream idiom), so a request id
-  lands on the same replica across replays and re-runs while the live
-  set is unchanged.  This is the hook the future prefix-sharing cache
-  will route warm requests through.
+  over the live replicas keyed by the prompt's PREFIX HASH
+  (``default_rng([affinity_seed, first_block_digest])`` — the first
+  ``kv_block``-token chained digest from ``prefix_digests`` on the
+  paged layout, a whole-prompt hash otherwise), so every request
+  sharing a system-prompt span lands on the replica whose prefix
+  cache is already warm (SERVING.md "Prefix sharing") — and still
+  deterministically across replays and re-runs while the live set is
+  unchanged.
 
 **Replica loss.**  Each replica journals to its OWN request journal.
 When an engine-class fault exhausts a replica's restart budget its
@@ -72,6 +75,7 @@ from flexflow_tpu.runtime.serving import (
     Request,
     RequestResult,
     ServingCrashLoop,
+    prefix_digests,
 )
 from flexflow_tpu.serving.journal import JournalState, MemoryJournal
 from flexflow_tpu.serving.scheduler import ScheduledServer
@@ -187,6 +191,23 @@ class FleetRouter:
         k = max(srv.decode_steps, 1)
         return model.prefill_ms(bucket) + model.decode_ms(k) * (-(-new // k))
 
+    def _affinity_key(self, r: Request) -> int:
+        """The sticky-routing key: the prompt's first-block chained
+        digest on the paged layout (the prefix-cache index key, so
+        same-span requests warm the SAME replica's pool), a
+        whole-prompt hash otherwise.  Pure host arithmetic — identical
+        in real and simulated fleets."""
+        import hashlib
+
+        ex = self.replicas[0].ex
+        blk = int(getattr(ex, "kv_block", 0) or 0)
+        toks = np.asarray(r.prompt, np.int64)
+        if blk > 0 and len(toks) >= blk:
+            digest = prefix_digests(toks, blk)[0]
+        else:
+            digest = hashlib.sha1(toks.tobytes()).digest()
+        return int.from_bytes(digest[:8], "big")
+
     def _route(self, r: Request, live: List[int]) -> int:
         """Pick the replica for ``r`` at its arrival instant.  Pure
         host arithmetic over modeled load + advertised capacity —
@@ -195,7 +216,7 @@ class FleetRouter:
         cand = sorted(live)
         if self.router == "affinity":
             rng = np.random.default_rng(
-                [self.affinity_seed, int(r.id)]
+                [self.affinity_seed, self._affinity_key(r)]
             )
             i = cand[int(rng.integers(0, len(cand)))]
         else:

@@ -57,9 +57,13 @@ class ServingLatencyModel:
 
     # -- the program prices --------------------------------------------------
 
-    def prefill_ms(self, bucket: int) -> float:
+    def prefill_ms(self, bucket: int, offset: int = 0) -> float:
+        """``offset`` is the prefix-sharing offset prefill's skipped
+        span (SERVING.md "Prefix sharing"): the program computes only
+        ``bucket - offset`` token positions behind the same one
+        dispatch + one fence."""
         return self.dispatch_ms + self.fence_ms + \
-            bucket * self.prefill_token_ms
+            max(bucket - offset, 0) * self.prefill_token_ms
 
     def decode_ms(self, k: int) -> float:
         return self.dispatch_ms + self.fence_ms + k * self.decode_token_ms
@@ -136,6 +140,11 @@ class ServingLatencyModel:
                 continue
             wall_ms = float(wall) * 1e3
             if kind == "prefill" and ev.get("bucket"):
+                if ev.get("offset"):
+                    # Prefix-sharing offset prefills computed fewer
+                    # tokens than the bucket — folding them in would
+                    # bias the slope low.
+                    continue
                 pf.append(max(wall_ms - overhead, 0.0)
                           / float(ev["bucket"]))
             elif kind == "decode_superstep" and ev.get("k"):

@@ -76,6 +76,7 @@ from flexflow_tpu.runtime.serving import (
     ServingEngineFault,
     ServingExecutor,
     ServingFault,
+    prefix_digests,
 )
 from flexflow_tpu.serving.latency_model import ServingLatencyModel
 
@@ -185,6 +186,7 @@ class SlotShape:
     buckets: Tuple[int, ...]
     kv_block: int = 0
     kv_blocks: Optional[int] = None
+    prefix_cache: bool = False
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -198,6 +200,10 @@ class SlotShape:
         # Mirrors ServingExecutor's paged validation exactly.
         if self.kv_blocks is not None and self.kv_block <= 0:
             raise ValueError("kv_blocks requires kv_block > 0")
+        if self.prefix_cache and self.kv_block <= 0:
+            raise ValueError(
+                "prefix_cache requires the paged layout (kv_block > 0)"
+            )
         if self.kv_block > 0:
             if self.max_seq % self.kv_block != 0:
                 raise ValueError(
@@ -225,7 +231,8 @@ class SlotShape:
 
         if not self.paged:
             raise ValueError("make_ledger() needs kv_block > 0")
-        return KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq)
+        return KVBlockLedger(self.kv_blocks, self.kv_block, self.max_seq,
+                             prefix_cache=self.prefix_cache)
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
@@ -259,21 +266,35 @@ class _RealEngine:
 
     def prefill(self, prompt: np.ndarray, bucket: int, slot_i: int,
                 row: Optional[np.ndarray] = None,
-                plen: Optional[int] = None, rid: int = 0):
+                plen: Optional[int] = None, rid: int = 0,
+                offset: int = 0, shared_ids=None):
         """Pad-to-bucket prefill + cache install into ``slot_i``
         (padded rows, or the ledger-assigned block ``row`` on the
         paged layout): ``(first_token, finite, wall_s)`` after one
         fence.  ``prompt`` is the full (prompt ‖ carried) sequence;
         ``plen``/``rid`` key the sampled variant so a RESUMED
-        position replays the decode head's draw."""
+        position replays the decode head's draw.  ``offset > 0``
+        runs the prefix-sharing offset prefill instead
+        (``build_prefill_from``): the shared span's KV is gathered
+        from the pool blocks ``shared_ids`` and ``row`` is the
+        MASKED table row (shared entries -> scratch block 0) so the
+        donor's blocks are never written."""
         tel = _telemetry.current()
         ex = self.ex
         flen = len(prompt)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :flen] = np.asarray(prompt, np.int32)
         t0 = time.perf_counter()
-        pf = ex.build_prefill(bucket, sample=self.sample)
-        pf_args = (self.params, self.op_state, padded, np.int32(flen))
+        if offset:
+            pf = ex.build_prefill_from(bucket, offset,
+                                       sample=self.sample)
+            pf_args = (self.params, self.op_state, self.caches,
+                       np.asarray(shared_ids, np.int32), padded,
+                       np.int32(flen))
+        else:
+            pf = ex.build_prefill(bucket, sample=self.sample)
+            pf_args = (self.params, self.op_state, padded,
+                       np.int32(flen))
         if self.sample is not None:
             pf_args += (np.int32(flen if plen is None else plen),
                         np.int32(rid))
@@ -361,7 +382,7 @@ class _SimEngine:
         self.shape = shape
 
     def prefill(self, prompt, bucket, slot_i, row=None, plen=None,
-                rid=0):
+                rid=0, offset=0, shared_ids=None):
         return 1, True, 0.0
 
     def decode(self, pos_vec, tok_vec, k, block_table=None,
@@ -628,6 +649,7 @@ class ScheduledServer:
         e2es: Dict[int, float] = {}
         slo_oks: Dict[int, bool] = {}
         sheds = preempts = prefills = supersteps = 0
+        prefix_hits = full_hits = prefill_tokens_saved = kv_cows = 0
         draft_prefills = spec_accept_total = spec_draft_total = 0
         total_tokens = decode_tokens = 0
         decode_s = 0.0
@@ -841,8 +863,10 @@ class ScheduledServer:
             finish_result(r, prior, None, admit_v0, t_wall0)
             return True
 
-        def admit(r: Request, slot_i: int):
+        def admit(r: Request, slot_i: int, plan=None):
             nonlocal vclock, prefills, draft_prefills, total_tokens
+            nonlocal prefix_hits, full_hits, prefill_tokens_saved, \
+                kv_cows
             waiting.remove(r)
             admit_v0, prior, n_pre = carried.pop(r.id, (vclock, [], 0))
             if prior and resume_done(r, prior, admit_v0):
@@ -863,48 +887,126 @@ class ScheduledServer:
                 finish_result(r, prior, str(e), admit_v0, t_wall0)
                 return
             others = [w for w in waiting if w is not r]
+            use = plan.use if plan is not None else 0
+            fullhit = bool(plan is not None and plan.full_hit)
+            pfx_cache = ledger is not None and ledger.prefix_cache
             tel.emit("request_start", id=r.id, prompt_len=len(r.prompt),
                      bucket=bucket, slot=slot_i)
             log("admit", id=r.id, slot=slot_i, bucket=bucket,
                 tier=r.priority, resumed=len(prior),
                 waiting_min_tier=min(
                     (w.priority for w in others), default=None),
+                # Prefix-sharing decisions ride the admit record only
+                # when the cache is armed, so cache-off decision traces
+                # stay byte-identical to the pre-knob scheduler.
+                **({"prefix_blocks": use, "prefix_full": fullhit}
+                   if pfx_cache else {}),
             )
-            vclock += model.prefill_ms(bucket)
-            if self.speculate:
-                vclock += model.draft_prefill_ms(bucket)
-            row = None
-            if ledger is not None:
-                row = ledger.alloc(slot_i, ledger.blocks_for(
-                    len(r.prompt), r.max_new_tokens))
-                block_table[slot_i] = row
-            try:
-                tok0, ok, pf_s = self.engine.prefill(
-                    full, bucket, slot_i, row=row,
-                    plen=len(r.prompt), rid=r.id,
-                )
-                if self.speculate and ok:
-                    # The draft cache's own prefill — spec mode's
-                    # second admission dispatch (no fence).
-                    pf_s += self.engine.draft_prefill(
-                        full, bucket, slot_i
-                    )
-            except (RuntimeError, OSError) as e:
-                if res is None or isinstance(e, ServingFault):
-                    raise
+            digests = (prefix_digests(r.prompt, ledger.block)
+                       if pfx_cache else [])
+            def rollback(e):
                 # Engine-class fault mid-prefill: roll the admission
-                # back so the restart path re-queues it cleanly.
+                # back so the restart path re-queues it cleanly (the
+                # ledger free decrements shared refcounts too).
                 if ledger is not None:
                     ledger.free(slot_i)
                     block_table[slot_i] = 0
                 carried[r.id] = (admit_v0, prior, n_pre)
                 waiting.append(r)
                 raise ServingEngineFault(str(e)) from e
-            prefills += 1
-            if self.speculate and ok:
-                draft_prefills += 1
-            tel.emit("prefill", id=r.id, bucket=bucket,
-                     wall_s=round(pf_s, 6))
+            if fullhit:
+                # -- ZERO-dispatch admission: the whole prompt is
+                # resident full blocks and the greedy first token is
+                # memoized — no prefill program, no vclock advance.
+                row = ledger.alloc(slot_i, ledger.blocks_for(
+                    len(r.prompt), r.max_new_tokens),
+                    shared=plan.shared)
+                block_table[slot_i] = row
+                tok0, ok, pf_s = plan.tok0, True, 0.0
+                prefix_hits += 1
+                full_hits += 1
+                prefill_tokens_saved += plan.offset
+                tel.emit("prefix_hit", id=r.id, blocks=plan.use,
+                         full=True, tokens_saved=plan.offset)
+                if self.speculate:
+                    # The draft cache is padded, never shared: its
+                    # prefill still runs (and is still priced).
+                    vclock += model.draft_prefill_ms(bucket)
+                    try:
+                        pf_s += self.engine.draft_prefill(
+                            full, bucket, slot_i
+                        )
+                    except (RuntimeError, OSError) as e:
+                        if res is None or isinstance(e, ServingFault):
+                            raise
+                        rollback(e)
+                    draft_prefills += 1
+            else:
+                vclock += model.prefill_ms(
+                    bucket, plan.offset if use else 0
+                )
+                if self.speculate:
+                    vclock += model.draft_prefill_ms(bucket)
+                row = masked = None
+                if ledger is not None:
+                    row = ledger.alloc(slot_i, ledger.blocks_for(
+                        len(r.prompt), r.max_new_tokens),
+                        shared=(plan.shared if plan is not None
+                                else ()))
+                    block_table[slot_i] = row
+                    # Masked install: shared entries write their
+                    # (all-zero) chunks into scratch block 0 — the
+                    # donor's blocks are never touched; the table row
+                    # keeps the real shared ids for decode.
+                    masked = row
+                    if use:
+                        masked = row.copy()
+                        masked[:use] = 0
+                try:
+                    tok0, ok, pf_s = self.engine.prefill(
+                        full, bucket, slot_i, row=masked,
+                        plen=len(r.prompt), rid=r.id,
+                        offset=(plan.offset if use else 0),
+                        shared_ids=(plan.shared if use else None),
+                    )
+                    if self.speculate and ok:
+                        # The draft cache's own prefill — spec mode's
+                        # second admission dispatch (no fence).
+                        pf_s += self.engine.draft_prefill(
+                            full, bucket, slot_i
+                        )
+                except (RuntimeError, OSError) as e:
+                    if res is None or isinstance(e, ServingFault):
+                        raise
+                    rollback(e)
+                prefills += 1
+                if self.speculate and ok:
+                    draft_prefills += 1
+                if use:
+                    prefix_hits += 1
+                    prefill_tokens_saved += plan.offset
+                    tel.emit("prefill", id=r.id, bucket=bucket,
+                             offset=plan.offset,
+                             wall_s=round(pf_s, 6))
+                    tel.emit("prefix_hit", id=r.id, blocks=plan.use,
+                             full=False, tokens_saved=plan.offset)
+                    if plan.cow:
+                        kv_cows += plan.cow
+                        tel.emit("kv_cow", id=r.id, blocks=plan.cow)
+                else:
+                    tel.emit("prefill", id=r.id, bucket=bucket,
+                             wall_s=round(pf_s, 6))
+            if ok and digests:
+                # Index only AFTER the fence validated the install
+                # (never make never-written blocks shareable);
+                # memoize the first token when the prompt is exactly
+                # block-aligned and fresh — the future full-hit
+                # upgrade.
+                ledger.register_prefix(slot_i, digests, start=use)
+                if len(full) == len(r.prompt) and \
+                        len(r.prompt) % ledger.block == 0 and \
+                        not fullhit:
+                    ledger.record_next(digests[-1], int(tok0))
             if jr is not None:
                 jr.admit(r.id, len(r.prompt),
                          int(tok0) if ok else None, resumed=len(prior))
@@ -1084,19 +1186,34 @@ class ScheduledServer:
                         slot_i = try_preempt(cand)
                     if slot_i is None:
                         break
-                    if ledger is not None and not ledger.can_admit(
-                            ledger.blocks_for(len(cand.prompt),
-                                              cand.max_new_tokens)):
-                        # Free slot but not enough free KV blocks:
-                        # head-of-line wait for block turnover (an
-                        # active slot finishing frees its reservation;
-                        # the pool covers any single admissible
-                        # request, so no livelock).
-                        log("kv_wait", id=cand.id,
-                            free_blocks=ledger.free_blocks)
-                        break
+                    plan = None
+                    if ledger is not None:
+                        # Prefix sharing: planned AFTER any preemption
+                        # freed blocks (free() may evict index
+                        # entries), so the plan admit() executes is the
+                        # one priced here.  Shared blocks never leave
+                        # the free list — a hit can admit where a miss
+                        # would head-of-line wait.
+                        plan = ledger.plan_prefix(
+                            cand.prompt,
+                            total_len=len(cand.prompt) + len(
+                                carried.get(cand.id,
+                                            (None, [], 0))[1]),
+                        )
+                        if not ledger.can_admit(
+                                ledger.blocks_for(len(cand.prompt),
+                                                  cand.max_new_tokens)
+                                - plan.use):
+                            # Free slot but not enough free KV blocks:
+                            # head-of-line wait for block turnover (an
+                            # active slot finishing frees its
+                            # reservation; the pool covers any single
+                            # admissible request, so no livelock).
+                            log("kv_wait", id=cand.id,
+                                free_blocks=ledger.free_blocks)
+                            break
                     try:
-                        admit(cand, slot_i)
+                        admit(cand, slot_i, plan)
                     except ServingEngineFault as e:
                         engine_restart(str(e), "prefill")
                         engine_down = True
@@ -1288,6 +1405,22 @@ class ScheduledServer:
         stats = self._stats(results, qwaits, e2es, slo_oks, sheds,
                             preempts, prefills, supersteps,
                             total_tokens, decode_s, elapsed)
+        if ledger is not None and ledger.prefix_cache:
+            stats["prefix_cache"] = True
+            stats["prefix_hits"] = prefix_hits
+            stats["prefix_hit_rate"] = round(
+                prefix_hits / max(prefills + full_hits, 1), 4
+            )
+            stats["prefill_tokens_saved"] = prefill_tokens_saved
+            stats["kv_cows"] = kv_cows
+            if prefix_hits:
+                # Same formula and gating as the legacy Server loop;
+                # reconstruct_summary recomputes both from the raw
+                # prefill/prefix_hit events and must match bit-for-bit.
+                tel.note_summary(
+                    prefix_hit_rate=stats["prefix_hit_rate"],
+                    prefill_tokens_saved=prefill_tokens_saved,
+                )
         if self.speculate:
             stats["speculate"] = self.speculate
             stats["draft_layers"] = getattr(self.ex, "draft_layers", 0)

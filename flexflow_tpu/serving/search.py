@@ -60,6 +60,11 @@ class ServingConfig:
     #: a paged candidate's queueing behavior is priced exactly.
     kv_block: int = 0
     kv_blocks: Optional[int] = None
+    #: Prefix sharing (SERVING.md "Prefix sharing"; paged only): the
+    #: ledger refcounts blocks and shares resident full-block
+    #: prefixes at admission.  A searchable on/off knob — hit rate vs
+    #: pool pressure is exactly the trade the ledger-gated sim prices.
+    prefix_cache: bool = False
     #: Mesh shard (n, c) — carried through to the executor, not
     #: searched (the device count is a deployment fact, not a knob).
     shard: Optional[Tuple[int, int]] = None
@@ -104,13 +109,16 @@ class ServingConfig:
     def shape(self) -> SlotShape:
         return SlotShape(max_batch=self.max_batch, max_seq=self.max_seq,
                          buckets=self.buckets, kv_block=self.kv_block,
-                         kv_blocks=self.kv_blocks)
+                         kv_blocks=self.kv_blocks,
+                         prefix_cache=self.prefix_cache)
 
     def describe(self) -> str:
         bits = (f"buckets={list(self.buckets)} k={self.decode_steps} "
                 f"max_batch={self.max_batch}")
         if self.kv_block > 0:
             bits += f" kv={self.kv_blocks}x{self.kv_block}"
+        if self.prefix_cache:
+            bits += " prefix-cache"
         if self.shard is not None:
             bits += f" shard={self.shard[0]}x{self.shard[1]}"
         if self.speculate > 0:
@@ -131,6 +139,7 @@ class ServingConfig:
             "shed_depth": self.policy.shed_depth,
             "kv_block": self.kv_block,
             "kv_blocks": self.kv_blocks,
+            "prefix_cache": self.prefix_cache,
             "shard": list(self.shard) if self.shard else None,
             "speculate": self.speculate,
             "replicas": self.replicas,
@@ -191,20 +200,25 @@ def candidate_bucket_sets(
 
 def candidate_kv_layouts(
     baseline: "ServingConfig",
-) -> List[Tuple[int, Optional[int]]]:
+) -> List[Tuple[int, Optional[int], bool]]:
     """Paged block-size variants at the baseline's pool-TOKEN capacity
     (halved/doubled block, pool re-sized so HBM stays fixed) — the
-    block-granularity vs fragmentation trade the ledger gating prices.
-    A padded baseline stays padded: the layout switch is an HBM-budget
-    decision the operator makes, not a latency one the search may."""
+    block-granularity vs fragmentation trade the ledger gating prices
+    — each crossed with the prefix-cache on/off knob (SERVING.md
+    "Prefix sharing": hit rate vs pool pressure, priced by the same
+    ledger arithmetic).  A padded baseline stays padded: the layout
+    switch is an HBM-budget decision the operator makes, not a latency
+    one the search may."""
     if baseline.kv_block <= 0:
-        return [(0, None)]
+        return [(0, None, False)]
     pool_tokens = (baseline.kv_blocks - 1) * baseline.kv_block
-    out = {(baseline.kv_block, baseline.kv_blocks)}
+    pairs = {(baseline.kv_block, baseline.kv_blocks)}
     for blk in (baseline.kv_block // 2, baseline.kv_block * 2):
         if blk >= 1 and baseline.max_seq % blk == 0:
-            out.add((blk, max(pool_tokens // blk, 1) + 1))
-    return sorted(out)
+            pairs.add((blk, max(pool_tokens // blk, 1) + 1))
+    return sorted(
+        (blk, n, pfx) for blk, n in pairs for pfx in (False, True)
+    )
 
 
 def _score(config: ServingConfig, requests: Sequence[Request],
@@ -286,7 +300,7 @@ def search_serving_config(
     for bks in bucket_sets:
         for k in ks:
             for b in batches:
-                for kvb, kvn in kv_layouts:
+                for kvb, kvn, pfx in kv_layouts:
                     for sp in specs:
                         # d replaces k in spec mode (the round is
                         # d+1 draft + d+1 verify; adaptive-k is
@@ -305,7 +319,7 @@ def search_serving_config(
                                     else (baseline.router,)
                                 for rt in routers:
                                     key = (bks, k_eff, b, kvb, kvn,
-                                           sp, adaptive, rep, rt)
+                                           pfx, sp, adaptive, rep, rt)
                                     if key in seen:
                                         continue
                                     seen.add(key)
@@ -316,6 +330,7 @@ def search_serving_config(
                                         max_seq=baseline.max_seq,
                                         policy=pol,
                                         kv_block=kvb, kv_blocks=kvn,
+                                        prefix_cache=pfx,
                                         shard=baseline.shard,
                                         speculate=sp,
                                         replicas=rep, router=rt,
@@ -339,6 +354,7 @@ def search_serving_config(
             len(s.config.buckets),
             s.config.buckets,
             s.config.kv_block,
+            not s.config.prefix_cache,
             s.config.speculate,
             not s.config.policy.adaptive_k,
             s.config.replicas,

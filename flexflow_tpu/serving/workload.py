@@ -67,6 +67,15 @@ class WorkloadSpec:
     priorities: int = 1
     #: Base SLO deadline (virtual ms) for tier 0; inf = best-effort.
     slo_ms: float = float("inf")
+    #: Prefix sharing (SERVING.md "Prefix sharing"): a P-token
+    #: system-prompt span drawn ONCE per workload (its own rng block,
+    #: disjoint from every per-request block); each request
+    #: independently shares it with probability ``shared_frac`` —
+    #: sharers' prompts become ``span ‖ own_tokens[:plen - P]``.
+    #: 0 = off (bit-identical to the pre-knob trace: the share draw
+    #: is appended AFTER every existing per-request draw).
+    shared_prefix: int = 0
+    shared_frac: float = 0.75
     seed: int = 0
 
     def __post_init__(self):
@@ -86,6 +95,41 @@ class WorkloadSpec:
                 raise ValueError(
                     f"{name} must be 1 <= lo <= hi, got ({lo}, {hi})"
                 )
+        if self.shared_prefix < 0:
+            raise ValueError(
+                f"shared_prefix must be >= 0, got {self.shared_prefix}"
+            )
+        if not 0.0 <= self.shared_frac <= 1.0:
+            raise ValueError(
+                f"shared_frac must be in [0, 1], got {self.shared_frac}"
+            )
+
+
+def _shared_span(spec: WorkloadSpec):
+    """The workload's one shared system-prompt span (None when the
+    knob is off).  Its rng block ``[seed, 0, 0]`` is length-disjoint
+    from every per-request ``[seed, i]`` block, so arming the knob
+    perturbs no existing draw."""
+    if not spec.shared_prefix:
+        return None
+    rng = np.random.default_rng([spec.seed, 0, 0])
+    return rng.integers(
+        0, spec.vocab, size=spec.shared_prefix
+    ).astype(np.int32)
+
+
+def _maybe_share(spec: WorkloadSpec, span, rng: np.random.Generator,
+                 prompt: np.ndarray) -> np.ndarray:
+    """Per-request share draw — APPENDED after every pre-existing
+    draw in the request's rng block, so shared_prefix=0 workloads are
+    bit-identical to the pre-knob generator.  A sharer's prompt keeps
+    ``max(plen, P)`` tokens: the span plus its own tail."""
+    if span is None:
+        return prompt
+    if float(rng.random()) >= spec.shared_frac:
+        return prompt
+    tail = prompt[: max(len(prompt) - spec.shared_prefix, 0)]
+    return np.concatenate([span, tail]).astype(np.int32)
 
 
 def make_workload(spec: WorkloadSpec) -> List[Request]:
@@ -94,6 +138,7 @@ def make_workload(spec: WorkloadSpec) -> List[Request]:
     ``(spec, seed)``."""
     out: List[Request] = []
     t_ms = 0.0
+    span = _shared_span(spec)
     for i in range(spec.n_requests):
         rng = np.random.default_rng([spec.seed, i])
         plen = _bounded_zipf(rng, spec.prompt_alpha, *spec.prompt_len)
@@ -105,6 +150,7 @@ def make_workload(spec: WorkloadSpec) -> List[Request]:
         # load is burst-invariant); the rest arrive with it.
         if i % spec.burst == 0 and i > 0:
             t_ms += float(rng.exponential(spec.mean_gap_ms * spec.burst))
+        prompt = _maybe_share(spec, span, rng, prompt)
         slo = spec.slo_ms * (tier + 1)
         out.append(Request(
             id=i, prompt=prompt, max_new_tokens=max_new,
@@ -139,6 +185,7 @@ def production_workload(spec: WorkloadSpec,
     )
     out: List[Request] = []
     t_ms = 0.0
+    span = _shared_span(spec)
     for i in range(spec.n_requests):
         rng = np.random.default_rng([spec.seed, i])
         plen = _bounded_zipf(rng, spec.prompt_alpha, *spec.prompt_len)
@@ -151,6 +198,7 @@ def production_workload(spec: WorkloadSpec,
         tier = int(rng.integers(0, spec.priorities))
         if i % spec.burst == 0 and i > 0:
             t_ms += float(rng.exponential(spec.mean_gap_ms * spec.burst))
+        prompt = _maybe_share(spec, span, rng, prompt)
         out.append(Request(
             id=i, prompt=prompt, max_new_tokens=max_new,
             arrival_ms=round(t_ms, 3), priority=tier,

@@ -121,6 +121,13 @@ def stubbed_bench(monkeypatch):
             "fleet_dead_replicas": 1,
             "fleet_redistributed": 3,
             "fleet_loss_slo_attainment": 0.9,
+            "prefix_hits": 9,
+            "prefix_hit_rate": 0.75,
+            "prefill_tokens_saved": 72,
+            "prefix_kv_cows": 2,
+            "prefix_prefills": 3,
+            "prefix_off_prefills": 12,
+            "prefix_match": True,
         }),
     )
     monkeypatch.setattr(
@@ -246,6 +253,16 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert serving["fleet_dead_replicas"] == 1
     assert serving["fleet_redistributed"] == 3
     assert serving["fleet_loss_slo_attainment"] == 0.9
+    # Prefix-cache columns (ISSUE 18): ref-counted block sharing —
+    # hit rate, prefill dispatches saved vs the cache-off paged run,
+    # and the byte-parity bit (shared decode == unshared decode).
+    assert serving["prefix_hits"] == 9
+    assert serving["prefix_hit_rate"] == 0.75
+    assert serving["prefill_tokens_saved"] == 72
+    assert serving["prefix_kv_cows"] == 2
+    assert serving["prefix_prefills"] == 3
+    assert serving["prefix_off_prefills"] == 12
+    assert serving["prefix_match"] is True
     # The execution-autotuner leg (ISSUE 6): auto-chosen config with
     # its predicted-vs-measured ms/step + the search wall time.
     search = record["extra"]["search"]

@@ -24,12 +24,13 @@ def chaos_root(tmp_path_factory):
 # The multi-host rig scenarios spawn real 2-process jax.distributed
 # worlds (generations are jit-compile dominated, ~2 min together), the
 # speculation scenario compiles spec + plain decode programs for
-# padded AND paged layouts, and the fleet scenario compiles three
-# replica engines — slow-marked so the tier-1 `-m 'not slow'` budget
+# padded AND paged layouts, the fleet scenario compiles three replica
+# engines, and the prefix-donor scenario compiles padded + two paged
+# serving stacks — slow-marked so the tier-1 `-m 'not slow'` budget
 # holds; the targeted `pytest tests/test_chaos.py` run and
 # `tools/chaos_smoke.py` exercise them.
 _SLOW_SCENARIOS = {"host_loss", "coordinator_loss", "serving_spec_fault",
-                   "replica_loss"}
+                   "replica_loss", "prefix_donor_eviction"}
 
 
 @pytest.mark.parametrize("name", [

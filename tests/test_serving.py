@@ -883,3 +883,162 @@ def test_spec_crash_resume_mid_generation(sex, weights, tmp_path):
         assert res[rid].error is None
         assert res[rid].tokens == base[rid].tokens
         assert res[rid].tokens == plain[rid].tokens
+
+# -- prefix sharing (SERVING.md "Prefix sharing") ---------------------------
+
+@pytest.fixture(scope="module")
+def prefix_sex(lm):
+    """Prefix-sharing oracle executor: 4-token blocks + the
+    content-hash index (ISSUE 18)."""
+    return ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                           decode_kernel=False, kv_block=4,
+                           prefix_cache=True)
+
+
+def _prefix_reqs(tail_lens, max_new=5):
+    """Requests sharing an 8-token (two full blocks) span, each with
+    its own ``tail_lens[i]``-token suffix (0 = the bare span)."""
+    rng = np.random.default_rng(5)
+    span = rng.integers(0, V, size=8).astype(np.int32)
+    out = []
+    for i, t in enumerate(tail_lens):
+        tail = rng.integers(0, V, size=t).astype(np.int32)
+        out.append(_req(i, np.concatenate([span, tail]), max_new=max_new))
+    return out
+
+
+def test_prefix_cache_requires_paged(lm):
+    with pytest.raises(ValueError, match="paged"):
+        ServingExecutor(lm, max_batch=2, max_seq=S, buckets=(8, S),
+                        prefix_cache=True)
+
+
+@pytest.mark.parametrize("tails", [
+    (0, 0),    # identical 8-token prompts: plen % B == 0, FULL hit
+    (0, 1),    # hit exactly at the block boundary, 1-token tail
+    (0, 3),    # partial-block tail
+    (0, 4),    # sharer plen % B == 0 with a divergent final block
+    (3, 3),    # identical prompts with a partial final block
+])
+def test_prefix_shared_greedy_parity(sex, prefix_sex, weights, tails):
+    """The tentpole bar: shared-prefix decode is byte-identical to the
+    unshared PADDED run at every block-boundary shape, and the second
+    request actually hit the index."""
+    base, _ = _serve(sex, weights, _prefix_reqs(tails), decode_steps=4)
+    shared, stats = _serve(prefix_sex, weights, _prefix_reqs(tails),
+                           decode_steps=4)
+    assert stats["prefix_cache"] is True
+    assert stats["prefix_hits"] >= 1
+    for rid in range(len(tails)):
+        assert shared[rid].error is None
+        assert shared[rid].tokens == base[rid].tokens
+
+
+def test_prefix_full_hit_zero_dispatch(sex, prefix_sex, weights):
+    """An identical full-block prompt with a memoized first token
+    admits with ZERO prefill dispatches (the prefix-sharing
+    headline): the prefill count stays at the donor's."""
+    base, _ = _serve(sex, weights, _prefix_reqs((0, 0)), decode_steps=4)
+    shared, stats = _serve(prefix_sex, weights, _prefix_reqs((0, 0)),
+                           decode_steps=4)
+    assert stats["prefills"] == 1          # donor only
+    assert stats["prefix_hits"] == 1
+    assert stats["prefix_hit_rate"] == 0.5
+    assert stats["prefill_tokens_saved"] == 8
+    for rid in (0, 1):
+        assert shared[rid].tokens == base[rid].tokens
+
+
+def test_prefix_cow_divergence(sex, prefix_sex, weights):
+    """Copy-on-write: a prompt fully covered by resident blocks but
+    WITHOUT a memoized next token recomputes its final block privately
+    (the prefill must produce the last prompt position's logits) —
+    and stays byte-identical to the unshared run."""
+    rng = np.random.default_rng(5)
+    span = rng.integers(0, V, size=8).astype(np.int32)
+    tail = rng.integers(0, V, size=4).astype(np.int32)
+
+    def reqs():
+        # Donor's prompt EXTENDS past the sharer's: the sharer's full
+        # 2-block digest has no memo entry (the donor memoized its own
+        # 3-block digest), forcing the CoW clamp on block 1.
+        return [_req(0, np.concatenate([span, tail]), max_new=4),
+                _req(1, span, max_new=4)]
+
+    base, _ = _serve(sex, weights, reqs(), decode_steps=4)
+    shared, stats = _serve(prefix_sex, weights, reqs(), decode_steps=4)
+    assert stats["kv_cows"] >= 1
+    assert stats["prefix_hits"] >= 1
+    for rid in (0, 1):
+        assert shared[rid].error is None
+        assert shared[rid].tokens == base[rid].tokens
+
+
+def test_prefix_sampled_parity(sex, prefix_sex, weights):
+    """Sampled decode (seeded fold_in(seed, rid, pos) draws) is
+    byte-identical shared vs unshared — including the FULL-hit path,
+    whose memoized first token is the greedy draw a fresh admission
+    takes in sampled mode too."""
+    kw = dict(decode_steps=4, temperature=0.8, top_k=8, sample_seed=3)
+    for tails in ((0, 0), (0, 3)):
+        base, _ = _serve(sex, weights, _prefix_reqs(tails), **kw)
+        shared, stats = _serve(prefix_sex, weights, _prefix_reqs(tails),
+                               **kw)
+        assert stats["sampled"] and stats["prefix_hits"] >= 1
+        for rid in (0, 1):
+            assert shared[rid].error is None
+            assert shared[rid].tokens == base[rid].tokens
+
+
+def test_prefix_ledger_refcount_free_at_zero():
+    """Ledger unit contract (pure host integers): refcounts gate the
+    free list — a donor's death keeps shared blocks resident and
+    indexed; the LAST holder's free returns them (lowest-first order
+    preserved) and evicts the index entries."""
+    from flexflow_tpu.runtime.serving import KVBlockLedger, prefix_digests
+
+    led = KVBlockLedger(9, 4, S, prefix_cache=True)
+    prompt = np.arange(1, 9, dtype=np.int32)          # 2 full blocks
+    dig = prefix_digests(prompt, 4)
+    assert len(dig) == 2
+    row = led.alloc(0, 3)
+    led.register_prefix(0, dig)
+    # Full coverage without a memo: CoW clamp recomputes block 1.
+    plan = led.plan_prefix(prompt)
+    assert (plan.use, plan.cow, plan.offset) == (1, 1, 4)
+    assert not plan.full_hit
+    assert plan.shared == (int(row[0]),)
+    led.record_next(dig[-1], 7)
+    plan2 = led.plan_prefix(prompt)
+    assert plan2.full_hit and plan2.tok0 == 7
+    assert plan2.use == 2 and plan2.offset == 8
+    assert plan2.shared == (int(row[0]), int(row[1]))
+    led.alloc(1, 3, shared=plan2.shared)              # refcount 2
+    led.free(0)                                       # donor dies
+    # Shared blocks stay resident + indexed under the live refcount.
+    assert led.plan_prefix(prompt).full_hit
+    assert int(row[0]) not in led._free
+    led.free(1)                                       # last holder
+    plan3 = led.plan_prefix(prompt)
+    assert plan3.use == 0 and not plan3.full_hit      # index evicted
+    assert list(led._free) == sorted(led._free)
+    assert led.free_blocks == led.capacity_blocks     # all returned
+    # Lowest-first reuse is unchanged by the refcount machinery.
+    assert list(led.alloc(0, 2)) == [1, 2, 0, 0]
+
+
+def test_prefix_donor_eviction_sharers_survive(sex, prefix_sex, weights):
+    """The chaos property at unit scale: the donor request errors out
+    mid-decode, the sharer keeps decoding against the shared blocks —
+    byte-identical to the unshared run (refcount holds the block)."""
+    def reqs():
+        return _prefix_reqs((3, 4), max_new=8)
+
+    base, _ = _serve(sex, weights, reqs(), decode_steps=4)
+    inj = ServingFaultInjector(raise_at={1: 0})
+    faulted, stats = _serve(prefix_sex, weights, reqs(), decode_steps=4,
+                            fault_injector=inj)
+    assert faulted[0].error is not None
+    assert faulted[1].error is None
+    assert faulted[1].tokens == base[1].tokens
+    assert stats["prefix_hits"] >= 1

@@ -150,6 +150,39 @@ def test_affinity_sticky_across_fleets():
     assert rehomed != homes[0]
 
 
+def test_affinity_routes_shared_prefix_to_same_replica():
+    """Prefix warmth (SERVING.md "Prefix sharing"): on a PAGED fleet
+    the affinity key is the first-block chained digest, so requests
+    sharing a full-block prefix land on the replica whose pool already
+    holds those blocks — regardless of request id or tail; a different
+    first block re-keys, and sub-block prompts fall back to the
+    whole-prompt hash."""
+    shape = SlotShape(max_batch=2, max_seq=S, buckets=(8, S),
+                      kv_block=8, kv_blocks=17, prefix_cache=True)
+    fleet = FleetRouter.simulated(
+        shape, 3, router="affinity", decode_steps=4,
+        policy=SchedulerPolicy(name="slo"))
+    span = np.arange(1, 9, dtype=np.int32)       # one full block
+    other = np.arange(9, 17, dtype=np.int32)     # a different block
+
+    def shared(rid, tail, base=span):
+        return Request(
+            id=rid,
+            prompt=np.concatenate([base, np.asarray(tail, np.int32)]),
+            max_new_tokens=4, arrival_ms=float(rid))
+
+    reqs = [shared(0, [30]), shared(1, [31, 32]), shared(2, []),
+            shared(3, [40], base=other), shared(4, [], base=other),
+            _req(5, 3, 4, arrival_ms=5.0), _req(6, 3, 4, arrival_ms=6.0)]
+    fleet.run(reqs)
+    routed = {d["id"]: d["replica"] for d in fleet.decisions
+              if d["d"] == "route"}
+    assert routed[0] == routed[1] == routed[2]
+    assert routed[3] == routed[4]
+    # Sub-block prompts (identical content) still share a home.
+    assert routed[5] == routed[6]
+
+
 def test_tier_aware_steers_tier0_off_degraded():
     """Tier-0 requests prefer the least-degraded replica even when it
     carries more outstanding load; other tiers stay least-loaded."""

@@ -604,6 +604,69 @@ def test_sched_paged_output_parity(sex, weights):
         assert paged[rid].tokens == base[rid].tokens
 
 
+def test_sim_matches_real_dispatch_prefix(lm, weights):
+    """sim==real EXTENDS to the prefix cache: the ledger (refcounts +
+    content-hash index) is shared verbatim by both engines, a full hit
+    skips the prefill dispatch in BOTH loops, and the kv_wait gate
+    admits against need - shared blocks."""
+    from flexflow_tpu.runtime.telemetry import Telemetry
+
+    params, state = weights
+    # kv_block=8 over max_seq=64, pool of 8 allocatable blocks.  _req
+    # prompts share content positionally, so every plen>=8 request
+    # shares its first block.  The index only lives while a holder is
+    # resident (refcount > 0), so the chain is arranged to overlap:
+    # r0+r1 co-admit (r1 a FULL hit — memoised next token, zero
+    # dispatch), r2 partial-hits r1's still-resident block (offset
+    # prefill), and r3 (7 blocks) must kv_wait behind r2's pool share.
+    sex_pfx = ServingExecutor(lm, max_batch=2, max_seq=S,
+                              buckets=(8, S), decode_kernel=False,
+                              kv_block=8, kv_blocks=9,
+                              prefix_cache=True)
+    reqs = lambda: [_req(0, 8, 4, 0.0), _req(1, 8, 8, 0.0),
+                    _req(2, 12, 20, 1.0), _req(3, 8, 40, 2.0)]
+    pol = SchedulerPolicy(name="slo")
+    real = ScheduledServer(sex_pfx, params, state, decode_steps=4,
+                           policy=pol)
+    with Telemetry(None):
+        _, real_st = real.run(reqs())
+    sim = _sim(pol, SlotShape(max_batch=2, max_seq=S, buckets=(8, S),
+                              kv_block=8, kv_blocks=9,
+                              prefix_cache=True), decode_steps=4)
+    _, sim_st = sim.run(reqs())
+    assert sim.decisions == real.decisions
+    assert any(d["d"] == "kv_wait" for d in real.decisions)
+    assert real_st["prefix_cache"] and sim_st["prefix_cache"]
+    assert real_st["prefix_hits"] == sim_st["prefix_hits"] >= 2
+    assert real_st["prefill_tokens_saved"] == \
+        sim_st["prefill_tokens_saved"] > 0
+    assert sim_st["prefills"] == real_st["prefills"]
+    assert sim_st["decode_supersteps"] == real_st["decode_supersteps"]
+    assert _virt(sim_st) == _virt(real_st)
+
+
+def test_sched_prefix_output_parity(sex, weights):
+    """Prefix sharing changes DISPATCH COUNT, never content: greedy
+    sequences through hits (full and partial) equal the padded
+    scheduler's, byte for byte."""
+    params, state = weights
+    sex_pfx = ServingExecutor(sex.model, max_batch=2, max_seq=S,
+                              buckets=(8, S), decode_kernel=False,
+                              kv_block=8, kv_blocks=17,
+                              prefix_cache=True)
+    reqs = lambda: [_req(0, 8, 10, 0.0), _req(1, 8, 10, 1.0),
+                    _req(2, 12, 10, 2.0)]
+    pol = SchedulerPolicy(name="slo")
+    base, _ = ScheduledServer(sex, params, state, decode_steps=4,
+                              policy=pol).run(reqs())
+    pfx, st = ScheduledServer(sex_pfx, params, state, decode_steps=4,
+                              policy=pol).run(reqs())
+    assert st["prefix_hits"] >= 1
+    for rid in (0, 1, 2):
+        assert pfx[rid].error is None
+        assert pfx[rid].tokens == base[rid].tokens
+
+
 def test_serve_auto_kv_layout_candidates():
     """A paged baseline searches block-size variants at fixed pool
     HBM; every candidate is executor-legal; a padded baseline stays
@@ -613,14 +676,17 @@ def test_serve_auto_kv_layout_candidates():
     pol = SchedulerPolicy(name="slo")
     padded = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
                            max_seq=32, policy=pol)
-    assert candidate_kv_layouts(padded) == [(0, None)]
+    assert candidate_kv_layouts(padded) == [(0, None, False)]
     paged = ServingConfig(buckets=(8, 32), decode_steps=8, max_batch=2,
                           max_seq=32, policy=pol, kv_block=8,
                           kv_blocks=9)
     variants = candidate_kv_layouts(paged)
-    assert (8, 9) in variants and len(variants) >= 2
+    assert (8, 9, False) in variants and len(variants) >= 4
+    # Every paged layout is offered with the prefix cache off AND on.
+    assert (8, 9, True) in variants
+    assert {p for _, _, p in variants} == {False, True}
     # Pool-token capacity is preserved across block-size variants.
-    for blk, n in variants:
+    for blk, n, _pfx in variants:
         assert (n - 1) * blk == 64
     reqs = make_workload(WorkloadSpec(
         n_requests=6, vocab=V, prompt_len=(3, 6), max_new=(2, 8),

@@ -17,6 +17,11 @@ at the fence, clean exit, resume byte-identical) and
 ``serving_spec_fault`` (faults inside the speculative draft+verify
 round: faulted slots error at the verify fence, survivors
 byte-identical to the UNSPECULATED run, padded AND paged) and
+``prefix_donor_eviction`` (prefix sharing: the donor of a shared
+KV block crashes mid-decode — refcounts keep the block alive, the
+content-hash index survives, sharers byte-identical to the unshared
+run; padded oracle AND paged cache-off sub-checks; SERVING.md
+"Prefix sharing") and
 ``replica_loss`` (fleet: a replica engine-fault exhausts its restart
 budget, the router redistributes its journaled in-flight requests to
 the survivor, merged output byte-identical to the single-replica run,

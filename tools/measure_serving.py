@@ -34,6 +34,14 @@ The measurements, each against its acceptance bar:
   the survivor with ZERO failed requests, and its SLO attainment must
   be >= the restarting single engine's (max_restarts=1, same fault)
   every rep.
+- ``prefix t0 p99`` + ``prefix exactness``: a burst sharing a
+  full-block prompt prefix, prefix cache ON vs OFF on the same paged
+  pool (SERVING.md "Prefix sharing").  Full hits skip the prefill
+  dispatch entirely, so the prefill count must drop and the tier-0
+  queue-wait p99 must improve >= 1.3x — at byte-identical outputs
+  (sharing changes dispatch count, never content); and sim == real
+  dispatch exactness must HOLD with the cache armed (serve-auto
+  scores prefix-cache candidates through the same ledger).
 
 All compared metrics are VIRTUAL-clock values (the latency model's
 deterministic ms), so the paired protocol's A/A control reads exactly
@@ -381,6 +389,96 @@ def child(argv):
           f"{worst_gap:+.3f}, bar >= 0; {moved} redistributed, "
           f"{'0 failed' if clean else 'FAILED/NOT-DEAD'}) "
           f"{'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
+
+    # -- prefix sharing: hit-rate leg (bar >= 1.3x on tier-0 p99) -------------
+    # SERVING.md "Prefix sharing": every request in the burst carries
+    # the same full-block 16-token prompt, so with the cache ON the
+    # first admission seeds the index and every later one is a FULL
+    # hit — zero prefill dispatch, memoised first token.  The win is
+    # the removed prefill_ms per admission under overload; outputs
+    # must stay byte-identical (sharing changes dispatch count, never
+    # content).
+    pfx_buckets = (16, max_seq)
+
+    def pfx_ex(on):
+        return ServingExecutor(ff, max_batch=max_batch,
+                               max_seq=max_seq, buckets=pfx_buckets,
+                               kv_block=8, kv_blocks=9,
+                               prefix_cache=on)
+
+    pfx_on, pfx_off = pfx_ex(True), pfx_ex(False)
+
+    def pfx_workload(seed):
+        return make_workload(WorkloadSpec(
+            n_requests=24, vocab=32, prompt_len=(16, 16),
+            max_new=(2, 6), mean_gap_ms=1.0, burst=12, priorities=3,
+            slo_ms=60.0, shared_prefix=16, shared_frac=1.0,
+            seed=31 + seed))
+
+    pfx_toks = {True: {}, False: {}}
+    pfx_stats = {}
+
+    def pfx_run(on, seed):
+        srv = ScheduledServer(pfx_on if on else pfx_off, params, state,
+                              decode_steps=8, policy=slo_pol)
+        reqs = pfx_workload(seed)
+        results, stats = srv.run(reqs)
+        pfx_toks[on][seed] = {r: results[r].tokens for r in results}
+        pfx_stats[(on, seed)] = stats
+        return t0_p99(srv.last_queue_waits, reqs)
+
+    res = paired_measure(
+        make_a=lambda r: pfx_run(False, r),
+        make_b=lambda r: pfx_run(True, r),
+        reps=reps,
+        control=lambda r: pfx_run(False, r),
+    )
+    med, ctl = res.median_ratio, res.median_aa_ratio
+    parity = all(pfx_toks[True][s] == pfx_toks[False][s]
+                 for s in pfx_toks[False])
+    fewer = all(pfx_stats[(True, s)]["prefills"]
+                < pfx_stats[(False, s)]["prefills"]
+                for s in range(reps))
+    pf_on, pf_off = pfx_stats[(True, 0)], pfx_stats[(False, 0)]
+    ok = med >= 1.3 and parity and fewer
+    print(f"{'prefix t0 p99':<22} {med:>7.3f}x  (cache on vs off, bar "
+          f">= 1.3x, a_a {ctl:.3f}x) prefills "
+          f"{pf_off['prefills']} -> {pf_on['prefills']} (hit rate "
+          f"{pf_on['prefix_hit_rate']:.2f}, "
+          f"{pf_on['prefill_tokens_saved']} tokens saved), outputs "
+          f"{'byte-identical' if parity else 'DIVERGED'} "
+          f"{'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
+
+    # -- prefix sharing: sim == real with the cache armed ---------------------
+    sim = ScheduledServer.simulated(
+        SlotShape(max_batch=max_batch, max_seq=max_seq,
+                  buckets=pfx_buckets, kv_block=8, kv_blocks=9,
+                  prefix_cache=True),
+        decode_steps=8, policy=slo_pol)
+    _, sim_st = sim.run(pfx_workload(0))
+    real = ScheduledServer(pfx_on, params, state, decode_steps=8,
+                           policy=slo_pol)
+    with Telemetry(None):
+        _, real_st = real.run(pfx_workload(0))
+    checks = [
+        ("decision log", sim.decisions == real.decisions),
+        ("prefills", sim_st["prefills"] == real_st["prefills"]),
+        ("prefix hits",
+         sim_st["prefix_hits"] == real_st["prefix_hits"]),
+        ("supersteps", sim_st["decode_supersteps"]
+         == real_st["decode_supersteps"]),
+    ]
+    bad = [n for n, c in checks if not c]
+    ok = not bad and real_st["prefix_hits"] > 0
+    print(f"{'prefix exactness':<22} sim == real with cache on: "
+          f"{real_st['prefix_hits']} hits, "
+          f"{real_st['prefills']} prefills"
+          + (f"; MISMATCH {bad}" if bad else "")
+          + f" {'PASS' if ok else 'FAIL'}")
     if not ok:
         failures += 1
 
