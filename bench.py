@@ -822,6 +822,14 @@ def bench_serving(n_chips: int, on_tpu: bool):
         fifo["queue_wait_ms_p99"] / max(slo["queue_wait_ms_p99"], 1e-9),
         3,
     )
+    # Tail-autopsy columns (OBSERVABILITY.md "Reading a request"):
+    # which phase dominated the SLO misses, per tier — the span-layer
+    # attribution folded straight from the run's stats block.
+    autopsy = slo.get("slo_autopsy") or {}
+    out["slo_missed"] = sum(r["missed"] for r in autopsy.values())
+    out["slo_dominant_phase"] = {
+        tier: row["dominant_phase"] for tier, row in autopsy.items()
+    }
 
     # Failure-model columns (SERVING.md "Failure model"): the same
     # workload with one injected slot fault and one engine-class fault

@@ -363,7 +363,7 @@ FF008_EVENT_NAMES = frozenset({
     "fault", "rollback", "replay", "preempt",
     "stall", "stall_recovered", "profile_skipped",
     "analysis", "search",
-    "request_start", "prefill", "prefix_hit", "kv_cow",
+    "request_start", "kv_wait", "prefill", "prefix_hit", "kv_cow",
     "decode_superstep", "spec_verify",
     "request_end", "serving_program",
     "sched_decision", "request_preempt", "request_shed",

@@ -710,6 +710,13 @@ def _run_scheduled(cfg, *, max_seq, max_batch, decode_steps, n_requests,
           f"p99 = {stats['e2e_ms_p99']:.1f} ms (virtual)")
     if "slo_attainment" in stats:
         print(f"SLO attainment = {stats['slo_attainment'] * 100:.1f}%")
+    if stats.get("slo_autopsy"):
+        # Tail autopsy (OBSERVABILITY.md "Reading a request"):
+        # per-tier dominant phase over the misses; waterfalls via
+        # `python -m flexflow_tpu.obs request`.
+        for tier, row in stats["slo_autopsy"].items():
+            print(f"slo autopsy tier {tier}: {row['missed']} missed, "
+                  f"dominant phase = {row['dominant_phase']}")
     print(f"decode supersteps = {stats['decode_supersteps']} "
           f"(k<={stats['decode_steps_per_call']}, 1 dispatch + 1 fence "
           f"per superstep)")

@@ -125,6 +125,27 @@ def _rel(a: Optional[float], b: Optional[float]) -> Optional[float]:
     return (b - a) / abs(a)
 
 
+#: Synthetic metric-name prefixes the autopsy block flattens into
+#: (``slo_missed_t<tier>``, ``autopsy_t<tier>_<phase>_ms``).
+_AUTOPSY_PREFIX = ("slo_missed_t", "autopsy_t")
+
+
+def _flatten_autopsy(summary: Dict[str, Any]) -> None:
+    """Flatten a ``slo_autopsy`` block (OBSERVABILITY.md "Reading a
+    request") into per-tier scalar rows the 1%-accounting drift table
+    can diff: missed count + per-phase attributed ms.  In place; a
+    summary without the block is untouched."""
+    block = summary.pop("slo_autopsy", None)
+    if not isinstance(block, dict):
+        return
+    for tier, row in block.items():
+        if not isinstance(row, dict):
+            continue
+        summary[f"slo_missed_t{tier}"] = row.get("missed", 0)
+        for phase, ms in (row.get("phase_ms") or {}).items():
+            summary[f"autopsy_t{tier}_{phase}_ms"] = ms
+
+
 def compare_runs(a: RunLog, b: RunLog,
                  thresholds: Optional[Dict[str, float]] = None,
                  ) -> CompareResult:
@@ -137,6 +158,14 @@ def compare_runs(a: RunLog, b: RunLog,
         th.update(thresholds)
     sa, sb = a.summary(), b.summary()
     ca, cb = a.calibration(), b.calibration()
+    _flatten_autopsy(sa)
+    _flatten_autopsy(sb)
+    for metric in sorted(set(k for s in (sa, sb) for k in s
+                             if k.startswith(_AUTOPSY_PREFIX))):
+        # Autopsy rows are virtual-clock accounting like the other
+        # serving metrics: any change is a scheduling/attribution
+        # regression, never box noise.
+        th.setdefault(metric, 0.01)
     rows: List[MetricRow] = []
     verdict = "ok"
     for metric in th:

@@ -45,6 +45,7 @@ EVENT_CATALOG = frozenset({
     "search",
     # serving (SERVING.md)
     "request_start",
+    "kv_wait",
     "prefill",
     "prefix_hit",
     "kv_cow",

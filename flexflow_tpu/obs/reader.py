@@ -147,6 +147,34 @@ class RunLog:
                    torn_tail=torn, unknown_events=unknown)
 
     @classmethod
+    def load_streams(cls, paths: Sequence[str]) -> "RunLog":
+        """Merge several per-process streams (a fleet's ``PATH.r{i}``
+        journals, a multi-host run's ``-p<id>`` telemetry files) into
+        ONE log: events concatenated in the given path order — per-
+        stream order is what the span fold keys on, and the shared
+        virtual clock makes cross-stream order immaterial.  Each
+        stream is loaded with the full tolerance contract
+        independently, so a torn tail (or unreadable file) in one
+        stream never poisons the others' events."""
+        merged = cls(path=" + ".join(paths) if paths else None, events=[])
+        seen_unknown: set = set()
+        errors: List[str] = []
+        for p in paths:
+            part = cls.load(p)
+            merged.events.extend(part.events)
+            merged.malformed += part.malformed
+            merged.torn_tail = merged.torn_tail or part.torn_tail
+            for u in part.unknown_events:
+                if u not in seen_unknown:
+                    seen_unknown.add(u)
+                    merged.unknown_events.append(u)
+            if part.read_error:
+                errors.append(f"{p}: {part.read_error}")
+        if errors and not merged.events:
+            merged.read_error = "; ".join(errors)
+        return merged
+
+    @classmethod
     def from_events(cls, records) -> "RunLog":
         """Wrap already-parsed dicts (an in-memory stream)."""
         events = [
@@ -341,6 +369,17 @@ class RunLog:
             out["spec_tokens_per_dispatch"] = round(
                 spec_emitted / max(spec_rounds, 1), 3
             )
+        if slo_oks and not all(slo_oks):
+            # Tail autopsy (OBSERVABILITY.md "Reading a request"):
+            # the SAME span fold the scheduler runs over its in-memory
+            # event copy, so run_end.summary and reconstruction agree
+            # bit-for-bit.  Lazy import keeps module load light.
+            from flexflow_tpu.obs import spans as _spans
+
+            autopsy = _spans.slo_autopsy(
+                _spans.build_timelines(self.iter_raw()))
+            if autopsy:
+                out["slo_autopsy"] = autopsy
         return out
 
     def summary(self) -> Dict[str, Any]:

@@ -35,10 +35,15 @@ _log = logging.getLogger("ff.obs")
 #: Index file name under the telemetry dir (append-only JSONL).
 INDEX_NAME = "runs.jsonl"
 
-#: Summary keys copied onto index rows (the compare headline metrics).
+#: Summary keys copied onto index rows (the compare headline metrics;
+#: the serving block makes `obs history` answer "how did serving runs
+#: trend" without opening each log — SERVING.md).
 _INDEX_SUMMARY_KEYS = (
     "steps", "fences_per_step", "programs_per_step",
     "step_ms_p50", "step_ms_p95", "input_wait_ms_p50",
+    "queue_wait_ms_p50", "queue_wait_ms_p99", "slo_attainment",
+    "request_sheds", "request_preempts", "engine_restarts",
+    "fleet_replicas", "fleet_dead_replicas",
 )
 
 
@@ -164,18 +169,22 @@ def format_history(rows: List[Dict[str, Any]]) -> str:
     if not rows:
         return "run registry: no runs recorded"
     hdr = (f"{'run_id':<26} {'exit':<20} {'steps':>6} {'p50 ms':>8} "
-           f"{'fence/st':>8} {'git':>8}  app")
+           f"{'fence/st':>8} {'qw p99':>8} {'slo':>6} {'git':>8}  app")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
         fp = r.get("fingerprint") or {}
         meta = r.get("meta") or {}
         p50 = r.get("step_ms_p50")
         fps = r.get("fences_per_step")
+        qw99 = r.get("queue_wait_ms_p99")
+        slo = r.get("slo_attainment")
         lines.append(
             f"{str(r.get('run_id')):<26} {str(r.get('exit')):<20} "
             f"{str(r.get('steps', '')):>6} "
             f"{('' if p50 is None else format(p50, '.3f')):>8} "
             f"{('' if fps is None else format(fps, '.2f')):>8} "
+            f"{('' if qw99 is None else format(qw99, '.2f')):>8} "
+            f"{('' if slo is None else format(slo, '.3f')):>6} "
             f"{str(fp.get('git_sha') or ''):>8}  "
             f"{meta.get('app', '')}"
         )

@@ -785,6 +785,7 @@ def scenario_replica_loss(root: str) -> Tuple[bool, str]:
     SINGLE-replica run — regardless of which replica finished each
     request.  Paged sub-check against the same padded baseline."""
     from flexflow_tpu.runtime.serving import ServingFaultInjector
+    from flexflow_tpu.runtime.telemetry import Telemetry
     from flexflow_tpu.serving import (
         FleetRouter,
         RequestJournal,
@@ -826,7 +827,10 @@ def scenario_replica_loss(root: str) -> Tuple[bool, str]:
         "padded",
         [_serving_setup(buckets=buckets), (sex, params, state)],
     )
-    results, stats = fleet.run(_serving_requests())
+    tel = Telemetry(os.path.join(root, "replica_loss", "telemetry"))
+    tel_path = tel.path
+    with tel:
+        results, stats = fleet.run(_serving_requests())
     if not any(m == "engine" for m, _, _ in inj.fired):
         return False, f"replica_loss: injector fired {inj.fired}"
     if stats.get("dead_replicas") != 1 or fleet.dead != [0]:
@@ -846,6 +850,23 @@ def scenario_replica_loss(root: str) -> Tuple[bool, str]:
     if not carried:
         return False, ("replica_loss: no redistributed request carried "
                        "a journaled prefix (resume path never exercised)")
+    # Span completeness FROM LOGS ALONE (OBSERVABILITY.md "Reading a
+    # request"): the telemetry JSONL of the faulted fleet run must
+    # yield a complete, exactly-reconciled timeline for EVERY request
+    # — transplanted ones included.
+    from flexflow_tpu.obs import spans as _spans
+    from flexflow_tpu.obs.reader import RunLog
+    tls = _spans.timelines_from_run(RunLog.load(tel_path))
+    if sorted(tls) != sorted(results):
+        return False, (f"replica_loss: span timelines incomplete "
+                       f"({sorted(tls)} vs {sorted(results)})")
+    bad = [i for i in sorted(tls) if not tls[i].reconciled]
+    if bad:
+        return False, f"replica_loss: unreconciled span timelines {bad}"
+    moved = [i for i in sorted(tls) if tls[i].transplanted]
+    if not moved:
+        return False, ("replica_loss: no transplanted timeline in the "
+                       "span reconstruction")
     # Paged sub-check: the same loss on the paged-KV fleet — params are
     # identical across layouts, so the merged output must match the
     # PADDED single-replica baseline byte for byte.
@@ -869,7 +890,9 @@ def scenario_replica_loss(root: str) -> Tuple[bool, str]:
                   f"{stats['redistributed']} journaled request(s) "
                   f"({len(carried)} with carried prefixes) finished on "
                   f"the survivor byte-identical to the single-replica "
-                  f"run (padded AND paged layouts)")
+                  f"run (padded AND paged layouts); {len(tls)} span "
+                  f"timelines ({len(moved)} transplanted) reconstructed "
+                  f"from the telemetry log, all reconciled exactly")
 
 
 # -- multi-host elastic scenarios (RESILIENCE.md "Host loss & elastic

@@ -2218,9 +2218,14 @@ class Server:
                 # one fence covered k_eff decode steps (programs/step
                 # == 1/k_eff).
                 tel.add_programs(1, steps=k_eff)
+                # `slots`: per-superstep occupancy by request id — the
+                # span layer's decode attribution (this loop carries no
+                # vclock stamps; ids still tell WHO was in the batch
+                # each dispatch).  Captured before finish() frees slots.
+                occ = [slots[i].request.id for i in active]
                 if not spec_d:
                     tel.emit("decode_superstep", k=k, active=len(active),
-                             wall_s=round(wall, 6))
+                             slots=occ, wall_s=round(wall, 6))
                 for j in range(k_eff):
                     tel.record_step((supersteps - 1) * k_eff + j,
                                     wall_s=wall / k_eff)
@@ -2268,7 +2273,7 @@ class Server:
                     tel.emit("spec_verify", d=spec_d, active=n_active,
                              accepted=acc_round,
                              draft=spec_d * n_active,
-                             emitted=emitted_round,
+                             emitted=emitted_round, slots=occ,
                              wall_s=round(wall, 6))
         finally:
             preempt.__exit__(None, None, None)

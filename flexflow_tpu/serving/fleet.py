@@ -79,6 +79,7 @@ from flexflow_tpu.runtime.serving import (
 )
 from flexflow_tpu.serving.journal import JournalState, MemoryJournal
 from flexflow_tpu.serving.scheduler import ScheduledServer
+from flexflow_tpu.obs import spans as _spans
 
 _log = logging.getLogger("ff.serving.fleet")
 
@@ -136,6 +137,13 @@ class FleetRouter:
         self._load = [0.0] * len(self.replicas)
         self._owned: List[Dict[int, Request]] = \
             [{} for _ in self.replicas]
+        #: The fleet-merged serving event stream, in telemetry-stream
+        #: order (router markers interleaved between each replica's
+        #: contiguous run blocks) — ``obs/spans.py`` input for the
+        #: fleet-level ``slo_autopsy``, bit-identical to folding the
+        #: on-disk log.
+        self.span_events: List[Dict[str, Any]] = []
+        self._span_taken = [0] * len(self.replicas)
 
     @classmethod
     def simulated(
@@ -172,6 +180,24 @@ class FleetRouter:
             ))
         return cls(reps, router=router, affinity_seed=affinity_seed)
 
+    # -- the fleet-merged span stream ---------------------------------------
+
+    def _sev(self, tel, name: str, **fields) -> None:
+        """Router-level serving event: telemetry + the merged span
+        stream (the scheduler's ``sev`` idiom, one level up)."""
+        self.span_events.append({"ev": name, **fields})
+        tel.emit(name, **fields)
+
+    def _collect_spans(self, i: int) -> None:
+        """Fold replica ``i``'s NEW serving events (since the last
+        collect) into the merged stream — called right after each
+        replica run (crashed or not), so per-replica blocks stay
+        contiguous and in execution order, exactly like the on-disk
+        telemetry stream."""
+        buf = self.replicas[i].span_events
+        self.span_events.extend(buf[self._span_taken[i]:])
+        self._span_taken[i] = len(buf)
+
     # -- routing ------------------------------------------------------------
 
     def _est_cost_ms(self, srv: ScheduledServer, r: Request) -> float:
@@ -186,10 +212,12 @@ class FleetRouter:
         new = max(int(r.max_new_tokens), 1)
         if srv.speculate:
             rounds = -(-new // (srv.speculate + 1))
-            return (model.prefill_ms(bucket) + model.draft_prefill_ms(bucket)
+            return (model.expected_prefill_ms(bucket)
+                    + model.draft_prefill_ms(bucket)
                     + model.spec_ms(srv.speculate) * rounds)
         k = max(srv.decode_steps, 1)
-        return model.prefill_ms(bucket) + model.decode_ms(k) * (-(-new // k))
+        return model.expected_prefill_ms(bucket) \
+            + model.decode_ms(k) * (-(-new // k))
 
     def _affinity_key(self, r: Request) -> int:
         """The sticky-routing key: the prompt's first-block chained
@@ -276,11 +304,11 @@ class FleetRouter:
             "in_flight": len(st.in_flight),
             "redistributed": len(remaining), "survivors": len(live),
         })
-        tel.emit("replica_loss", replica=i, error=str(why)[:200],
-                 completed=len(st.completed),
-                 in_flight=len(st.in_flight),
-                 redistributed=len(remaining), survivors=len(live),
-                 vclock_ms=v)
+        self._sev(tel, "replica_loss", replica=i, error=str(why)[:200],
+                  completed=len(st.completed),
+                  in_flight=len(st.in_flight),
+                  redistributed=len(remaining), survivors=len(live),
+                  vclock_ms=v)
         _log.warning(
             "replica %d dead (%s): %d journaled complete, %d in "
             "flight; redistributing %d request(s) across %d "
@@ -333,9 +361,9 @@ class FleetRouter:
                 "id": r.id, "from": i, "to": j,
                 "carried": len(toks or ()),
             })
-            tel.emit("replica_route", id=r.id, replica=j,
-                     policy=self.router, redistributed=True,
-                     vclock_ms=round(float(r.arrival_ms), 3))
+            self._sev(tel, "replica_route", id=r.id, replica=j,
+                      policy=self.router, redistributed=True,
+                      vclock_ms=round(float(r.arrival_ms), 3))
 
     # -- the fleet loop -----------------------------------------------------
 
@@ -357,9 +385,9 @@ class FleetRouter:
                 "d": "route", "v": round(float(r.arrival_ms), 3),
                 "id": r.id, "replica": i,
             })
-            tel.emit("replica_route", id=r.id, replica=i,
-                     policy=self.router,
-                     vclock_ms=round(float(r.arrival_ms), 3))
+            self._sev(tel, "replica_route", id=r.id, replica=i,
+                      policy=self.router,
+                      vclock_ms=round(float(r.arrival_ms), 3))
         results: Dict[int, RequestResult] = {}
         qwaits: Dict[int, float] = {}
         e2es: Dict[int, float] = {}
@@ -376,8 +404,12 @@ class FleetRouter:
                 try:
                     res_i, st_i = self.replicas[i].run(batch)
                 except ServingCrashLoop as e:
+                    # Collect everything the dying replica emitted up
+                    # to the crash — the transplant donor segment.
+                    self._collect_spans(i)
                     crashed.append((i, str(e)))
                     continue
+                self._collect_spans(i)
                 results.update(res_i)
                 srv = self.replicas[i]
                 qwaits.update(srv.last_queue_waits)
@@ -412,7 +444,9 @@ class FleetRouter:
                  requests=len(results), rounds=rounds)
         tel.note_summary(fleet_replicas=n,
                          fleet_dead_replicas=len(self.dead),
-                         fleet_redistributed=self.redistributed)
+                         fleet_redistributed=self.redistributed,
+                         **({"slo_autopsy": stats["slo_autopsy"]}
+                            if "slo_autopsy" in stats else {}))
         return results, stats
 
     # -- stats + the merged event queue -------------------------------------
@@ -472,6 +506,14 @@ class FleetRouter:
             )
         if any(st and st.get("drained") for st in self.replica_stats):
             stats["drained"] = True
+        # Fleet-level tail autopsy over the merged span stream —
+        # transplanted requests fold with their donor segment
+        # archived, so the attribution covers every request exactly
+        # like the log-only reconstruction does.
+        autopsy = _spans.slo_autopsy(
+            _spans.build_timelines(self.span_events))
+        if autopsy:
+            stats["slo_autopsy"] = autopsy
         return stats
 
     def merged_decisions(self) -> List[Dict[str, Any]]:

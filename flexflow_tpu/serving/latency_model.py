@@ -52,6 +52,13 @@ class ServingLatencyModel:
     prefill_token_ms: float = DEFAULT_PREFILL_TOKEN_MS
     decode_token_ms: float = DEFAULT_DECODE_TOKEN_MS
     draft_token_ms: float = DEFAULT_DRAFT_TOKEN_MS
+    #: Prefix-cache behaviour observed in the fitted run (SERVING.md
+    #: "Prefix sharing"): fraction of admissions that adopted a
+    #: resident prefix, and the mean token span a hit skipped.  Both
+    #: default 0.0 — :meth:`expected_prefill_ms` then equals
+    #: :meth:`prefill_ms`, so uncalibrated decisions are unchanged.
+    prefix_hit_rate: float = 0.0
+    prefix_mean_offset: float = 0.0
     calibrated: bool = False
     source: Optional[str] = None
 
@@ -64,6 +71,17 @@ class ServingLatencyModel:
         dispatch + one fence."""
         return self.dispatch_ms + self.fence_ms + \
             max(bucket - offset, 0) * self.prefill_token_ms
+
+    def expected_prefill_ms(self, bucket: int) -> float:
+        """The prefix-cache-aware EXPECTED prefill price: the bucket's
+        token span discounted by the fitted hit rate × mean skipped
+        offset.  An ESTIMATE for routing / preemption-worth decisions
+        only — the virtual clock always advances by the exact
+        :meth:`prefill_ms` of the program actually built, so using
+        this in estimates never perturbs dispatch accounting."""
+        saved = self.prefix_hit_rate * self.prefix_mean_offset
+        return self.dispatch_ms + self.fence_ms + \
+            max(bucket - saved, 0.0) * self.prefill_token_ms
 
     def decode_ms(self, k: int) -> float:
         return self.dispatch_ms + self.fence_ms + k * self.decode_token_ms
@@ -84,11 +102,15 @@ class ServingLatencyModel:
     def describe(self) -> str:
         tag = f"calibrated from {self.source}" if self.calibrated else \
             "uncalibrated defaults"
+        prefix = ""
+        if self.prefix_hit_rate:
+            prefix = (f", prefix hit {self.prefix_hit_rate:.2f} × "
+                      f"{self.prefix_mean_offset:.1f} tok")
         return (f"serving latency model ({tag}): dispatch "
                 f"{self.dispatch_ms:.3f} + fence {self.fence_ms:.3f} ms, "
                 f"prefill {self.prefill_token_ms:.4f} ms/token, decode "
                 f"{self.decode_token_ms:.4f} ms/token, draft "
-                f"{self.draft_token_ms:.4f} ms/token")
+                f"{self.draft_token_ms:.4f} ms/token{prefix}")
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -97,6 +119,8 @@ class ServingLatencyModel:
             "prefill_token_ms": round(self.prefill_token_ms, 5),
             "decode_token_ms": round(self.decode_token_ms, 5),
             "draft_token_ms": round(self.draft_token_ms, 5),
+            "prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "prefix_mean_offset": round(self.prefix_mean_offset, 3),
             "calibrated": self.calibrated,
             "source": self.source,
         }
@@ -129,17 +153,32 @@ class ServingLatencyModel:
         dispatch_ms - fence_ms) / tokens``, floored at 0 — one robust
         point per event, no regression machinery.  The draft slope is
         the spec-round residual AFTER the (possibly just-fitted)
-        decode slope prices the d+1 verify steps.  Returns a NEW
+        decode slope prices the d+1 verify steps.  ``prefix_hit``
+        events (no ``wall_s`` — full hits run no program) fit the
+        prefix terms: hit rate over admissions (``prefill`` events +
+        full hits) and the mean ``tokens_saved`` per hit, feeding
+        :meth:`expected_prefill_ms`.  Returns a NEW
         model; self is untouched."""
         pf, dc, sp = [], [], []
+        admissions = hits = 0
+        saved_total = 0.0
         overhead = self.dispatch_ms + self.fence_ms
         for ev in events:
             kind = ev.get("ev")
+            if kind == "prefix_hit":
+                hits += 1
+                saved_total += float(ev.get("tokens_saved") or 0)
+                if ev.get("full"):
+                    # Full hits never emit a prefill event — they are
+                    # admissions all the same.
+                    admissions += 1
+                continue
             wall = ev.get("wall_s")
             if wall is None:
                 continue
             wall_ms = float(wall) * 1e3
             if kind == "prefill" and ev.get("bucket"):
+                admissions += 1
                 if ev.get("offset"):
                     # Prefix-sharing offset prefills computed fewer
                     # tokens than the bucket — folding them in would
@@ -169,6 +208,10 @@ class ServingLatencyModel:
             prefill_token_ms=med(pf, self.prefill_token_ms),
             decode_token_ms=decode_slope,
             draft_token_ms=draft,
+            prefix_hit_rate=(hits / admissions) if admissions
+            else self.prefix_hit_rate,
+            prefix_mean_offset=(saved_total / hits) if hits
+            else self.prefix_mean_offset,
             calibrated=self.calibrated or bool(pf or dc or sp),
             source=source or self.source,
         )

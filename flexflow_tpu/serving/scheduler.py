@@ -79,6 +79,7 @@ from flexflow_tpu.runtime.serving import (
     prefix_digests,
 )
 from flexflow_tpu.serving.latency_model import ServingLatencyModel
+from flexflow_tpu.obs import spans as _spans
 
 _log = logging.getLogger("ff.serving.sched")
 
@@ -489,6 +490,12 @@ class ScheduledServer:
         #: The replayable decision trace: one dict per admit / evict /
         #: shed / reject / decode / advance decision, vclock-stamped.
         self.decisions: List[Dict[str, Any]] = []
+        #: In-memory copy of every serving telemetry event this
+        #: instance emitted (``obs/spans.py`` input): the run's
+        #: ``slo_autopsy`` stats block folds THESE, so stats and the
+        #: log-only reconstruction are bit-identical by construction —
+        #: and it works with telemetry off (the sim pricing loop).
+        self.span_events: List[Dict[str, Any]] = []
         self._params, self._op_state = params, op_state
         self.engine = _engine or self._build_engine(initial=True)
         # Bounded k candidate set (compile cache stays small).
@@ -702,12 +709,28 @@ class ScheduledServer:
             rec.update(fields)
             self.decisions.append(rec)
 
+        span_events = self.span_events
+
+        def sev(name: str, **fields):
+            # Every serving event goes out twice: to telemetry (may be
+            # the NULL sink) and to the in-memory span buffer the
+            # slo_autopsy fold runs on.  One dict append per event —
+            # deterministic accounting, zero fences.
+            span_events.append({"ev": name, **fields})
+            tel.emit(name, **fields)
+
         def finish_result(r: Request, toks: List[int], err: Optional[str],
                           admit_v: Optional[float], wall0: float,
                           pf_s: float = 0.0):
-            qw = round((admit_v if admit_v is not None else vclock)
-                       - r.arrival_ms, 3)
-            e2e = round(vclock - r.arrival_ms, 3)
+            # Latency split from the ROUNDED stamps (3 decimals =
+            # integer microseconds), so the span layer's telescoped
+            # phase totals equal e2e_ms EXACTLY — the obs/spans.py
+            # reconciliation contract.
+            arr = round(r.arrival_ms, 3)
+            end_v = round(vclock, 3)
+            e2e = round(end_v - arr, 3)
+            qw = e2e if admit_v is None else \
+                round(round(admit_v, 3) - arr, 3)
             qwaits[r.id] = qw
             e2es[r.id] = e2e
             fields: Dict[str, Any] = {}
@@ -720,9 +743,10 @@ class ScheduledServer:
                 error=err, latency_s=time.perf_counter() - wall0,
                 prefill_s=pf_s,
             )
-            tel.emit("request_end", id=r.id, tokens=len(toks), error=err,
-                     latency_s=round(results[r.id].latency_s, 6),
-                     queue_wait_ms=qw, e2e_ms=e2e, **fields)
+            sev("request_end", id=r.id, tokens=len(toks), error=err,
+                latency_s=round(results[r.id].latency_s, 6),
+                queue_wait_ms=qw, e2e_ms=e2e, arrival_ms=arr,
+                vclock_ms=end_v, tier=r.priority, **fields)
             if jr is not None:
                 jr.done(r.id, len(r.prompt), len(toks), err,
                         qw=qw, e2e=e2e, slo_ok=fields.get("slo_ok"),
@@ -754,9 +778,9 @@ class ScheduledServer:
                 except ValueError as e:
                     # Infeasible prompt: refuse on arrival with the
                     # legacy complete start/end event pair.
-                    tel.emit("request_start", id=r.id,
-                             prompt_len=len(r.prompt), bucket=None,
-                             slot=None)
+                    sev("request_start", id=r.id,
+                        prompt_len=len(r.prompt), bucket=None,
+                        slot=None, vclock_ms=round(vclock, 3))
                     log("reject", id=r.id, reason="no_bucket")
                     finish_result(r, [], str(e), None, t_wall0)
                     continue
@@ -764,9 +788,9 @@ class ScheduledServer:
                     need = ledger.blocks_for(len(r.prompt),
                                              r.max_new_tokens)
                     if need > ledger.capacity_blocks:
-                        tel.emit("request_start", id=r.id,
-                                 prompt_len=len(r.prompt), bucket=None,
-                                 slot=None)
+                        sev("request_start", id=r.id,
+                            prompt_len=len(r.prompt), bucket=None,
+                            slot=None, vclock_ms=round(vclock, 3))
                         log("reject", id=r.id, reason="kv_pool")
                         finish_result(r, [], (
                             f"request needs {need} KV blocks but the "
@@ -798,14 +822,17 @@ class ScheduledServer:
                 return None
             slack = cand.deadline_ms - vclock
             bucket = ex.bucket_for(len(cand.prompt))
+            # expected_prefill_ms: the prefix-cache-discounted ESTIMATE
+            # (defaults make it == prefill_ms).  The vclock still
+            # advances by the exact price of the program built.
             if self.speculate:
                 d = self.speculate
-                need = model.prefill_ms(bucket) + \
+                need = model.expected_prefill_ms(bucket) + \
                     model.draft_prefill_ms(bucket) + \
                     model.spec_ms(d) * math.ceil(
                         max(cand.max_new_tokens, 1) / (d + 1))
             else:
-                need = model.prefill_ms(bucket) + model.decode_ms(
+                need = model.expected_prefill_ms(bucket) + model.decode_ms(
                     self._k_candidates[0]
                 ) * math.ceil(max(cand.max_new_tokens, 1)
                               / self._k_candidates[0])
@@ -828,10 +855,10 @@ class ScheduledServer:
             sl = slots[slot_i]
             carried[vid] = (sl.admit_v, sl.all_tokens, sl.preempts + 1)
             preempts += 1
-            tel.emit("request_preempt", id=vid, slot=slot_i,
-                     tier=sl.request.priority, by=cand.id,
-                     tokens_kept=len(sl.all_tokens),
-                     vclock_ms=round(vclock, 3))
+            sev("request_preempt", id=vid, slot=slot_i,
+                tier=sl.request.priority, by=cand.id,
+                tokens_kept=len(sl.all_tokens),
+                vclock_ms=round(vclock, 3))
             log("evict", id=vid, slot=slot_i, by=cand.id,
                 kept=len(sl.all_tokens))
             # Re-queue at its original key; the freed slot admits cand.
@@ -857,8 +884,8 @@ class ScheduledServer:
             )
             if not terminal:
                 return False
-            tel.emit("request_start", id=r.id, prompt_len=len(r.prompt),
-                     bucket=None, slot=None)
+            sev("request_start", id=r.id, prompt_len=len(r.prompt),
+                bucket=None, slot=None, vclock_ms=round(vclock, 3))
             log("resume_done", id=r.id, tokens=len(prior))
             finish_result(r, prior, None, admit_v0, t_wall0)
             return True
@@ -880,9 +907,9 @@ class ScheduledServer:
                 bucket = ex.bucket_for(len(full))
             except ValueError as e:
                 # Journal-resumed sequence outgrew the largest bucket.
-                tel.emit("request_start", id=r.id,
-                         prompt_len=len(r.prompt), bucket=None,
-                         slot=None)
+                sev("request_start", id=r.id,
+                    prompt_len=len(r.prompt), bucket=None,
+                    slot=None, vclock_ms=round(vclock, 3))
                 log("reject", id=r.id, reason="resume_bucket")
                 finish_result(r, prior, str(e), admit_v0, t_wall0)
                 return
@@ -890,8 +917,9 @@ class ScheduledServer:
             use = plan.use if plan is not None else 0
             fullhit = bool(plan is not None and plan.full_hit)
             pfx_cache = ledger is not None and ledger.prefix_cache
-            tel.emit("request_start", id=r.id, prompt_len=len(r.prompt),
-                     bucket=bucket, slot=slot_i)
+            sev("request_start", id=r.id, prompt_len=len(r.prompt),
+                bucket=bucket, slot=slot_i,
+                vclock_ms=round(vclock, 3))
             log("admit", id=r.id, slot=slot_i, bucket=bucket,
                 tier=r.priority, resumed=len(prior),
                 waiting_min_tier=min(
@@ -926,8 +954,9 @@ class ScheduledServer:
                 prefix_hits += 1
                 full_hits += 1
                 prefill_tokens_saved += plan.offset
-                tel.emit("prefix_hit", id=r.id, blocks=plan.use,
-                         full=True, tokens_saved=plan.offset)
+                sev("prefix_hit", id=r.id, blocks=plan.use,
+                    full=True, tokens_saved=plan.offset,
+                    vclock_ms=round(vclock, 3))
                 if self.speculate:
                     # The draft cache is padded, never shared: its
                     # prefill still runs (and is still priced).
@@ -985,17 +1014,20 @@ class ScheduledServer:
                 if use:
                     prefix_hits += 1
                     prefill_tokens_saved += plan.offset
-                    tel.emit("prefill", id=r.id, bucket=bucket,
-                             offset=plan.offset,
-                             wall_s=round(pf_s, 6))
-                    tel.emit("prefix_hit", id=r.id, blocks=plan.use,
-                             full=False, tokens_saved=plan.offset)
+                    sev("prefill", id=r.id, bucket=bucket,
+                        offset=plan.offset, wall_s=round(pf_s, 6),
+                        vclock_ms=round(vclock, 3))
+                    sev("prefix_hit", id=r.id, blocks=plan.use,
+                        full=False, tokens_saved=plan.offset,
+                        vclock_ms=round(vclock, 3))
                     if plan.cow:
                         kv_cows += plan.cow
-                        tel.emit("kv_cow", id=r.id, blocks=plan.cow)
+                        sev("kv_cow", id=r.id, blocks=plan.cow,
+                            vclock_ms=round(vclock, 3))
                 else:
-                    tel.emit("prefill", id=r.id, bucket=bucket,
-                             wall_s=round(pf_s, 6))
+                    sev("prefill", id=r.id, bucket=bucket,
+                        wall_s=round(pf_s, 6),
+                        vclock_ms=round(vclock, 3))
             if ok and digests:
                 # Index only AFTER the fence validated the install
                 # (never make never-written blocks shareable);
@@ -1039,13 +1071,13 @@ class ScheduledServer:
                 waiting.remove(r)
                 expiries += 1
                 _v, prior, _n = carried.pop(r.id, (None, [], 0))
-                tel.emit("request_expire", id=r.id,
-                         deadline_ms=round(r.deadline_ms, 3),
-                         vclock_ms=round(vclock, 3))
+                sev("request_expire", id=r.id,
+                    deadline_ms=round(r.deadline_ms, 3),
+                    vclock_ms=round(vclock, 3))
                 log("expire", id=r.id)
-                tel.emit("request_start", id=r.id,
-                         prompt_len=len(r.prompt), bucket=None,
-                         slot=None)
+                sev("request_start", id=r.id,
+                    prompt_len=len(r.prompt), bucket=None,
+                    slot=None, vclock_ms=round(vclock, 3))
                 finish_result(r, prior, (
                     f"expired: deadline {r.deadline_ms:.0f}ms passed "
                     f"at vclock {vclock:.0f}ms"
@@ -1065,11 +1097,14 @@ class ScheduledServer:
             backoff = retry_backoff * (2 ** a)
             retries += 1
             carried[r.id] = (sl.admit_v, sl.all_tokens, sl.preempts)
-            retrying.append((round(vclock + backoff, 3), r.id, r))
+            until = round(vclock + backoff, 3)
+            retrying.append((until, r.id, r))
             retrying.sort(key=lambda t: (t[0], t[1]))
-            tel.emit("request_retry", id=r.id, attempt=a + 1,
-                     backoff_ms=round(backoff, 3), error=err,
-                     vclock_ms=round(vclock, 3))
+            # until_ms is the EXACT eligibility instant scan_retries
+            # keys on — the span layer's retry-backoff window edge.
+            sev("request_retry", id=r.id, attempt=a + 1,
+                backoff_ms=round(backoff, 3), until_ms=until,
+                error=err, vclock_ms=round(vclock, 3))
             log("retry", id=r.id, attempt=a + 1,
                 backoff=round(backoff, 3))
             slots[slot_i] = None
@@ -1084,8 +1119,13 @@ class ScheduledServer:
             nonlocal restarts, ledger, block_table, slots, B
             restarts += 1
             budget = res.max_restarts if res is not None else 0
-            tel.emit("engine_restart", restart=restarts, phase=phase,
-                     error=str(why)[:200], vclock_ms=round(vclock, 3))
+            # requeued rides the event BEFORE the crash-loop raise so
+            # a fleet replica death still records which requests were
+            # in flight — the span layer's transplant donor edge.
+            sev("engine_restart", restart=restarts, phase=phase,
+                error=str(why)[:200], vclock_ms=round(vclock, 3),
+                requeued=[sl.request.id for sl in slots
+                          if sl is not None])
             log("engine_restart", n=restarts, phase=phase)
             _log.warning("serving engine fault (%s): %s — restart "
                          "%d/%d", phase, why, restarts, budget)
@@ -1111,7 +1151,7 @@ class ScheduledServer:
                         "phase engine faults — flash_decode disabled, "
                         "serving from the _einsum_decode oracle",
                         self._decode_faults)
-                    tel.emit("degraded_mode", **rung)
+                    sev("degraded_mode", **rung)
                     log("degraded", rung="decode_oracle")
             for i, sl in enumerate(slots):
                 if sl is None:
@@ -1142,9 +1182,9 @@ class ScheduledServer:
                     drained = True
                     n_flight = sum(1 for sl in slots if sl is not None)
                     n_q = len(waiting) + len(pending) + len(retrying)
-                    tel.emit("serving_drain", signum=preempt.signum,
-                             in_flight=n_flight, queued=n_q,
-                             vclock_ms=round(vclock, 3))
+                    sev("serving_drain", signum=preempt.signum,
+                        in_flight=n_flight, queued=n_q,
+                        vclock_ms=round(vclock, 3))
                     log("drain", in_flight=n_flight, queued=n_q)
                     _log.warning(
                         "drain: signal %s — %d in flight, %d queued; "
@@ -1200,15 +1240,21 @@ class ScheduledServer:
                                 carried.get(cand.id,
                                             (None, [], 0))[1]),
                         )
-                        if not ledger.can_admit(
-                                ledger.blocks_for(len(cand.prompt),
-                                                  cand.max_new_tokens)
-                                - plan.use):
+                        need = ledger.blocks_for(
+                            len(cand.prompt), cand.max_new_tokens
+                        ) - plan.use
+                        if not ledger.can_admit(need):
                             # Free slot but not enough free KV blocks:
                             # head-of-line wait for block turnover (an
                             # active slot finishing frees its
                             # reservation; the pool covers any single
                             # admissible request, so no livelock).
+                            # The event makes the previously log-only
+                            # blocking visible to the span layer.
+                            sev("kv_wait", id=cand.id,
+                                need_blocks=need,
+                                free_blocks=ledger.free_blocks,
+                                vclock_ms=round(vclock, 3))
                             log("kv_wait", id=cand.id,
                                 free_blocks=ledger.free_blocks)
                             break
@@ -1227,10 +1273,10 @@ class ScheduledServer:
                         victim = max(waiting, key=self._shed_key)
                         waiting.remove(victim)
                         sheds += 1
-                        tel.emit("request_shed", id=victim.id,
-                                 tier=victim.priority,
-                                 queue_depth=len(waiting) + 1,
-                                 vclock_ms=round(vclock, 3))
+                        sev("request_shed", id=victim.id,
+                            tier=victim.priority,
+                            queue_depth=len(waiting) + 1,
+                            vclock_ms=round(vclock, 3))
                         log("shed", id=victim.id, tier=victim.priority)
                         finish_result(
                             victim, [],
@@ -1272,22 +1318,27 @@ class ScheduledServer:
                 # -- one fused decode superstep (or speculative
                 # round) over the whole batch --
                 spec_d = self.speculate
+                # Per-superstep slot occupancy, by request id — the
+                # compact field the span layer pairs the decision's
+                # pre-advance stamp with the superstep's post-advance
+                # stamp through (one small list per dispatch).
+                occ = [slots[i].request.id for i in active]
                 if spec_d:
                     # d is a per-run knob (serve-auto searches it);
                     # adaptive-k is a plain-decode concept.
                     k_eff = spec_d + 1
-                    tel.emit("sched_decision", d=spec_d,
-                             active=len(active), waiting=len(waiting),
-                             policy=pol.name,
-                             vclock_ms=round(vclock, 3))
+                    sev("sched_decision", d=spec_d,
+                        active=len(active), waiting=len(waiting),
+                        policy=pol.name, slots=occ,
+                        vclock_ms=round(vclock, 3))
                     log("spec", depth=spec_d, active=len(active),
                         waiting=len(waiting))
                 else:
                     k = self._choose_k(slots, len(waiting))
                     k_eff = k
-                    tel.emit("sched_decision", k=k, active=len(active),
-                             waiting=len(waiting), policy=pol.name,
-                             vclock_ms=round(vclock, 3))
+                    sev("sched_decision", k=k, active=len(active),
+                        waiting=len(waiting), policy=pol.name,
+                        slots=occ, vclock_ms=round(vclock, 3))
                     log("decode", k=k, active=len(active),
                         waiting=len(waiting))
                 pos_vec = np.array(
@@ -1341,8 +1392,9 @@ class ScheduledServer:
                 # (programs/step == 1/k_eff).
                 tel.add_programs(1, steps=k_eff)
                 if not spec_d:
-                    tel.emit("decode_superstep", k=k,
-                             active=len(active), wall_s=round(wall, 6))
+                    sev("decode_superstep", k=k,
+                        active=len(active), wall_s=round(wall, 6),
+                        slots=occ, vclock_ms=round(vclock, 3))
                 for j in range(k_eff):
                     tel.record_step((supersteps - 1) * k_eff + j,
                                     wall_s=wall / k_eff)
@@ -1385,11 +1437,12 @@ class ScheduledServer:
                 if spec_d:
                     acc_round = int(sum(int(accs[i]) for i in active))
                     spec_draft_total += spec_d * len(active)
-                    tel.emit("spec_verify", d=spec_d,
-                             active=len(active), accepted=acc_round,
-                             draft=spec_d * len(active),
-                             emitted=emitted_round,
-                             wall_s=round(wall, 6))
+                    sev("spec_verify", d=spec_d,
+                        active=len(active), accepted=acc_round,
+                        draft=spec_d * len(active),
+                        emitted=emitted_round,
+                        wall_s=round(wall, 6), slots=occ,
+                        vclock_ms=round(vclock, 3))
         finally:
             preempt.__exit__(None, None, None)
             if jr is not None:
@@ -1450,6 +1503,15 @@ class ScheduledServer:
             ) if kk in stats
         }, **({"slo_attainment": stats["slo_attainment"]}
               if "slo_attainment" in stats else {}))
+        # Tail autopsy (OBSERVABILITY.md "Reading a request"): fold
+        # the run's OWN emitted serving events through the same span
+        # layer a log reader runs, so the stats block and the log-only
+        # reconstruction agree bit-for-bit.
+        autopsy = _spans.slo_autopsy(
+            _spans.build_timelines(span_events))
+        if autopsy:
+            stats["slo_autopsy"] = autopsy
+            tel.note_summary(slo_autopsy=autopsy)
         return results, tel.fold_stats(stats)
 
     # -- stats --------------------------------------------------------------

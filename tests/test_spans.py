@@ -1,0 +1,445 @@
+"""Per-request span timelines + tail autopsy (OBSERVABILITY.md
+"Reading a request", ``flexflow_tpu/obs/spans.py``).
+
+Pinned invariants:
+
+- **Exact reconciliation**: every request's phase totals telescope to
+  EXACTLY ``us(e2e_ms)`` — integer-microsecond equality, no tolerance.
+  The scheduler's stamps and its ``e2e_ms`` come from the same rounded
+  virtual-clock values, so any gap is an instrumentation bug.  Holds
+  through kv_wait, preemption, retry backoff and replica-loss
+  transplant.
+- **Stats == log**: the scheduler's in-memory ``span_events`` fold and
+  the telemetry-JSONL fold produce bit-identical timelines and the
+  same ``slo_autopsy`` block (the ``sev`` dual-write) — and
+  ``RunLog.reconstruct_summary`` rebuilds that block from the log
+  alone.
+- **Fleet merge**: a replica-loss run yields a complete timeline for
+  EVERY request — transplanted ones archive the donor segment and
+  still reconcile; a 1-replica fleet's merged stream equals the
+  single-server fold; a torn tail in one stream of a multi-stream
+  load never poisons the merged timeline.
+- **Latency-model prefix pricing** (satellite): ``expected_prefill_ms``
+  defaults to ``prefill_ms`` exactly; fitting from ``prefix_hit``
+  events discounts it; serve-auto still ranks prefix-cache-on first
+  on the shared-prefix workload.
+
+All cases run the compute-free simulated loop (no jax programs); the
+real-engine reconciliation lives in ``test_serving_sched.py``'s
+telemetered run + ``tools/measure_serving.py``'s reconciliation leg.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import spans
+from flexflow_tpu.obs.reader import RunLog
+from flexflow_tpu.runtime.serving import (
+    Request,
+    ServingFaultInjector,
+)
+from flexflow_tpu.runtime.telemetry import Telemetry
+from flexflow_tpu.serving import (
+    FleetRouter,
+    ScheduledServer,
+    SchedulerPolicy,
+    ServingLatencyModel,
+    ServingResilience,
+    SlotShape,
+    WorkloadSpec,
+    make_workload,
+    search_serving_config,
+)
+from flexflow_tpu.serving.search import ServingConfig
+
+V, S = 64, 32
+
+SHAPE = SlotShape(max_batch=2, max_seq=S, buckets=(8, S))
+
+#: Bursty overload with tight tier-0 deadlines — guarantees misses, so
+#: the autopsy block is non-empty.
+BURSTY = WorkloadSpec(n_requests=16, vocab=V, prompt_len=(3, 6),
+                      max_new=(2, 10), mean_gap_ms=1.0, burst=8,
+                      priorities=3, slo_ms=20.0, seed=5)
+
+FLEET_BURSTY = WorkloadSpec(n_requests=12, vocab=V, prompt_len=(3, 6),
+                            max_new=(2, 10), mean_gap_ms=1.0, burst=6,
+                            priorities=3, slo_ms=60.0, seed=5)
+
+
+def _req(rid, plen, max_new, arrival_ms=0.0, priority=0,
+         slo_ms=float("inf")):
+    return Request(id=rid,
+                   prompt=(np.arange(1, plen + 1, dtype=np.int32)
+                           * 3 % V),
+                   max_new_tokens=max_new, arrival_ms=arrival_ms,
+                   priority=priority, slo_ms=slo_ms)
+
+
+def _sim(shape=SHAPE, decode_steps=4, **kw):
+    return ScheduledServer.simulated(
+        shape, decode_steps=decode_steps,
+        policy=SchedulerPolicy(name="slo"), **kw)
+
+
+def _assert_all_reconciled(tls):
+    bad = [i for i in sorted(tls) if not tls[i].reconciled]
+    assert not bad, {
+        i: (tls[i].phase_ms, tls[i].total_us, spans.us(tls[i].e2e_ms))
+        for i in bad
+    }
+
+
+# -- the microsecond currency -------------------------------------------------
+
+
+def test_us_lossless_on_rounded_stamps():
+    for x in (0.0, 0.001, 8.25, 41.667, 12345.999):
+        assert spans.us(round(x, 3)) == int(round(x * 1000.0))
+    assert spans.us(round(0.1 + 0.2, 3)) == 300
+
+
+def test_kv_wait_event_registered():
+    # The catalog<->FF008 equality pin lives in test_obs; this pins
+    # that the span layer's phase events are actually registered.
+    from flexflow_tpu.obs.events import EVENT_CATALOG
+    for name in ("kv_wait", "sched_decision", "request_retry",
+                 "request_preempt", "spec_verify"):
+        assert name in EVENT_CATALOG, name
+
+
+# -- reconciliation on the simulated loop -------------------------------------
+
+
+def test_bursty_sim_reconciles_and_autopsy_three_ways(tmp_path):
+    """Every request reconciles exactly; stats-side, run_end-side and
+    log-reconstructed autopsies are bit-identical; every missed tier-0
+    request carries a dominant phase."""
+    tel = Telemetry(str(tmp_path))
+    path = tel.path
+    with tel:
+        srv = _sim()
+        results, stats = srv.run(make_workload(BURSTY))
+    assert stats["completed"] + stats["failed"] == BURSTY.n_requests
+
+    tls = spans.build_timelines(srv.span_events)
+    assert len(tls) == BURSTY.n_requests
+    _assert_all_reconciled(tls)
+
+    run = RunLog.load(path)
+    assert not run.unknown_events
+    log_tls = spans.timelines_from_run(run)
+    assert sorted(log_tls) == sorted(tls)
+    for i in tls:
+        assert log_tls[i].phase_us == tls[i].phase_us, i
+        assert log_tls[i].e2e_ms == tls[i].e2e_ms, i
+
+    # The run missed SLOs (overloaded by construction) and the autopsy
+    # agrees between the stats block, run_end and the reconstruction.
+    autopsy = stats["slo_autopsy"]
+    assert autopsy
+    assert run.summary()["slo_autopsy"] == autopsy
+    assert run.reconstruct_summary()["slo_autopsy"] == autopsy
+
+    # 100% dominant-phase coverage over the missed tier-0 class.
+    missed_t0 = [tl for tl in tls.values()
+                 if tl.slo_ok is False and tl.tier == 0]
+    assert missed_t0
+    assert autopsy["0"]["missed"] == len(missed_t0)
+    for tl in missed_t0:
+        assert tl.dominant_phase in spans.PHASES
+    assert autopsy["0"]["dominant_phase"] in spans.PHASES
+
+
+def test_replay_determinism_of_span_events():
+    def virt(evs):
+        # Everything but wall time is virtual-clock deterministic.
+        return [{k: v for k, v in e.items()
+                 if k not in ("latency_s", "wall_s")} for e in evs]
+
+    a, b = _sim(), _sim()
+    a.run(make_workload(BURSTY))
+    b.run(make_workload(BURSTY))
+    assert virt(a.span_events) == virt(b.span_events)
+
+
+def test_kv_wait_phase_reconciles():
+    """A block-starved paged pool produces kv_wait spans that still
+    telescope exactly."""
+    shp = SlotShape(max_batch=2, max_seq=64, buckets=(8, 64),
+                    kv_block=16, kv_blocks=5)
+    srv = _sim(shape=shp)
+    _, stats = srv.run([_req(0, 4, 30), _req(1, 4, 30, 1.0),
+                        _req(2, 4, 8, 2.0)])
+    assert any(d["d"] == "kv_wait" for d in srv.decisions)
+    tls = spans.build_timelines(srv.span_events)
+    _assert_all_reconciled(tls)
+    assert any(tl.phase_us.get("kv_wait", 0) > 0 for tl in tls.values())
+
+
+def test_preempted_phase_reconciles():
+    """An evicted request's out-of-slot gap is attributed to the
+    ``preempted`` phase and the timeline still reconciles."""
+    shp = SlotShape(max_batch=1, max_seq=S, buckets=(8, S))
+    srv = _sim(shape=shp, decode_steps=8)
+    _, stats = srv.run([_req(0, 4, 40, 0.0, priority=1),
+                        _req(1, 4, 4, 5.0, priority=0, slo_ms=20.0)])
+    assert stats["request_preempts"] == 1
+    tls = spans.build_timelines(srv.span_events)
+    _assert_all_reconciled(tls)
+    assert tls[0].phase_us.get("preempted", 0) > 0
+
+
+def test_retry_backoff_span_splits_at_until():
+    """The retry window is its own phase, clamped at ``until_ms``:
+    8 ms + 16 ms of deterministic backoff show up as exactly 24000 µs
+    of ``retry_backoff``."""
+    srv = _sim(
+        resilience=ServingResilience(max_retries=2),
+        fault_injector=ServingFaultInjector(nan_cache_at={0: 0, 1: 0}),
+    )
+    results, stats = srv.run([_req(0, 4, 6)])
+    assert stats["request_retries"] == 2
+    assert results[0].error is None
+    tls = spans.build_timelines(srv.span_events)
+    _assert_all_reconciled(tls)
+    assert tls[0].phase_us["retry_backoff"] == spans.us(8.0) + spans.us(16.0)
+
+
+def test_dominant_phase_tie_breaks_to_earlier():
+    tl = spans.RequestTimeline(
+        id=0, arrival_ms=0.0, end_ms=2.0, e2e_ms=2.0,
+        queue_wait_ms=1.0, tier=0, slo_ok=False, error=None, tokens=1,
+        spans=[], donor_spans=[], transplanted=False,
+        phase_us={"queued": 1000, "decode": 1000},
+    )
+    assert tl.dominant_phase == "queued"
+    assert tl.total_us == 2000
+    assert tl.reconciled
+
+
+def test_render_waterfall_smoke():
+    srv = _sim()
+    srv.run(make_workload(BURSTY))
+    tls = spans.build_timelines(srv.span_events)
+    txt = spans.render_waterfall(tls[0])
+    assert "request 0" in txt and "reconciled=yes" in txt
+    assert "phase totals" in txt
+
+
+# -- fleet: transplant + merged streams ---------------------------------------
+
+
+def test_fleet_replica_loss_complete_timelines():
+    """The ISSUE acceptance bar: after a replica loss, EVERY request —
+    transplanted included — yields a complete, exactly-reconciled
+    timeline from the merged span stream; transplants archive the
+    donor segment."""
+    inj = {0: ServingFaultInjector(engine_raise_at={1: "sim death"})}
+    fleet = FleetRouter.simulated(
+        SHAPE, 2, decode_steps=4, policy=SchedulerPolicy(name="slo"),
+        resilience=ServingResilience(max_restarts=0),
+        fault_injectors=inj,
+    )
+    results, stats = fleet.run(make_workload(FLEET_BURSTY))
+    assert fleet.dead == [0] and stats["redistributed"] > 0
+
+    tls = spans.build_timelines(fleet.span_events)
+    assert sorted(tls) == list(range(FLEET_BURSTY.n_requests))
+    _assert_all_reconciled(tls)
+    moved = [i for i in tls if tls[i].transplanted]
+    assert len(moved) == stats["redistributed"]
+    # A request transplanted mid-flight archives the donor replica's
+    # segment; one transplanted while still queued on the donor has no
+    # donor stamps to archive.  Either way the pin is completeness +
+    # exact reconciliation (asserted above for all ids).
+    assert any(tls[i].donor_spans for i in moved)
+    assert any(tls[i].phase_us.get("transplanted", 0) > 0 for i in moved)
+
+
+def test_fleet_single_replica_merges_equal_to_single_server():
+    fleet = FleetRouter.simulated(
+        SHAPE, 1, decode_steps=4, policy=SchedulerPolicy(name="slo"))
+    fleet.run(make_workload(FLEET_BURSTY))
+    single = _sim()
+    single.run(make_workload(FLEET_BURSTY))
+    ft = spans.build_timelines(fleet.span_events)
+    st = spans.build_timelines(single.span_events)
+    assert sorted(ft) == sorted(st)
+    for i in st:
+        assert ft[i].phase_us == st[i].phase_us, i
+        assert ft[i].e2e_ms == st[i].e2e_ms, i
+
+
+def test_load_streams_torn_tail_does_not_poison_merge(tmp_path):
+    """Satellite: a fleet-style multi-stream load — the events split
+    across two files, one with a torn tail — folds to the SAME
+    timelines as the intact single stream."""
+    tel = Telemetry(str(tmp_path / "whole"))
+    path = tel.path
+    with tel:
+        srv = _sim()
+        srv.run(make_workload(BURSTY))
+    lines = open(path).read().splitlines(keepends=True)
+    cut = len(lines) // 2
+    a, b = str(tmp_path / "s0.jsonl"), str(tmp_path / "s1.jsonl")
+    open(a, "w").writelines(lines[:cut])
+    with open(b, "w") as fh:
+        fh.writelines(lines[cut:])
+        fh.write('{"ev": "request_end", "id": 99, "torn')  # torn tail
+    merged = RunLog.load_streams([a, b])
+    assert merged.torn_tail
+    assert merged.read_error is None
+    whole_tls = spans.timelines_from_run(RunLog.load(path))
+    merged_tls = spans.timelines_from_run(merged)
+    assert sorted(merged_tls) == sorted(whole_tls)
+    for i in whole_tls:
+        assert merged_tls[i].phase_us == whole_tls[i].phase_us, i
+    _assert_all_reconciled(merged_tls)
+
+
+def test_load_streams_all_unreadable_sets_read_error(tmp_path):
+    merged = RunLog.load_streams([str(tmp_path / "gone.jsonl")])
+    assert merged.read_error is not None
+    assert merged.events == []
+
+
+def test_fleet_journal_paths_and_outcomes(tmp_path):
+    from flexflow_tpu.serving.journal import RequestJournal
+
+    base = str(tmp_path / "journal.jsonl")
+    journals = [RequestJournal(f"{base}.r{i}") for i in range(2)]
+    inj = {0: ServingFaultInjector(engine_raise_at={1: "sim death"})}
+    fleet = FleetRouter.simulated(
+        SHAPE, 2, decode_steps=4, policy=SchedulerPolicy(name="slo"),
+        resilience=ServingResilience(max_restarts=0),
+        fault_injectors=inj, journals=journals,
+    )
+    results, stats = fleet.run(make_workload(FLEET_BURSTY))
+    paths = spans.fleet_journal_paths(base)
+    assert paths == [f"{base}.r0", f"{base}.r1"]
+    rows = spans.journal_outcomes(paths)
+    done = {i for i, r in results.items() if r.error is None}
+    assert done <= set(rows)
+    for i in done:
+        assert rows[i]["tokens"] == len(results[i].tokens)
+
+
+# -- autopsy in the drift sentry ----------------------------------------------
+
+
+def test_compare_flattens_autopsy_and_gates_drift():
+    from flexflow_tpu.obs.compare import compare_runs
+
+    def log(missed):
+        return RunLog.from_events([
+            {"ev": "run_start", "app": "serve"},
+            {"ev": "run_end", "exit": "clean", "summary": {
+                "slo_attainment": 0.8,
+                "slo_autopsy": {"0": {
+                    "missed": missed, "dominant_phase": "queued",
+                    "phase_ms": {"queued": 30.0, "decode": 5.0},
+                }},
+            }},
+        ])
+
+    same = compare_runs(log(3), log(3))
+    assert same.verdict == "ok"
+    metrics = {r.metric for r in same.rows}
+    assert "slo_missed_t0" in metrics
+    assert "autopsy_t0_queued_ms" in metrics
+    drift = compare_runs(log(3), log(5))
+    assert drift.verdict.startswith("drift:slo_missed_t0")
+
+
+def test_registry_carries_serving_keys():
+    from flexflow_tpu.obs.registry import _INDEX_SUMMARY_KEYS
+
+    for k in ("queue_wait_ms_p99", "slo_attainment", "request_sheds",
+              "engine_restarts", "fleet_replicas"):
+        assert k in _INDEX_SUMMARY_KEYS, k
+
+
+# -- obs request CLI ----------------------------------------------------------
+
+
+def test_obs_request_cli(tmp_path, capsys):
+    from flexflow_tpu.obs.__main__ import main
+
+    tel = Telemetry(str(tmp_path))
+    path = tel.path
+    with tel:
+        _sim().run(make_workload(BURSTY))
+    assert main(["request", path]) == 0
+    table = capsys.readouterr().out
+    assert "dominant" in table
+    assert main(["request", path, "0"]) == 0
+    assert "reconciled=yes" in capsys.readouterr().out
+    assert main(["request", path, "--slo-miss", "--worst", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "slo=miss" in out
+    assert main(["request", str(tmp_path / "gone")]) == 2
+    capsys.readouterr()
+
+
+def test_obs_report_serving_block(tmp_path, capsys):
+    from flexflow_tpu.obs.__main__ import main
+
+    tel = Telemetry(str(tmp_path))
+    path = tel.path
+    with tel:
+        _sim().run(make_workload(BURSTY))
+    assert main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "serving:" in out
+    assert "slo autopsy" in out
+
+
+# -- latency-model prefix pricing (satellite) ---------------------------------
+
+
+def test_expected_prefill_defaults_to_exact_prefill():
+    m = ServingLatencyModel.from_calibration()
+    for bucket in (8, 32, 64):
+        assert m.expected_prefill_ms(bucket) == m.prefill_ms(bucket)
+
+
+def test_fit_events_prices_prefix_hits():
+    events = [
+        {"ev": "prefix_hit", "id": 1, "tokens_saved": 8, "full": False},
+        {"ev": "prefix_hit", "id": 2, "tokens_saved": 16, "full": True},
+        {"ev": "prefill", "id": 0, "bucket": 32, "wall_s": 0.004},
+        {"ev": "prefill", "id": 1, "bucket": 32, "wall_s": 0.004},
+        {"ev": "prefill", "id": 3, "bucket": 32, "wall_s": 0.004},
+    ]
+    m = ServingLatencyModel.from_calibration().fit_events(events)
+    # 2 hits over 4 admissions (3 prefills + 1 full hit), mean 12
+    # tokens saved per hit.
+    assert m.prefix_hit_rate == pytest.approx(0.5)
+    assert m.prefix_mean_offset == pytest.approx(12.0)
+    assert m.expected_prefill_ms(32) < m.prefill_ms(32)
+    assert m.expected_prefill_ms(32) == pytest.approx(
+        m.prefill_ms(32) - 6.0 * m.prefill_token_ms)
+    # No prefix events at all -> the defaults (and the exact price).
+    m2 = ServingLatencyModel.from_calibration().fit_events(
+        [{"ev": "prefill", "id": 0, "bucket": 32, "wall_s": 0.004}])
+    assert m2.prefix_hit_rate == 0.0
+    assert m2.expected_prefill_ms(32) == m2.prefill_ms(32)
+
+
+def test_serve_auto_ranks_prefix_cache_on_shared_prefix_workload():
+    reqs = make_workload(WorkloadSpec(
+        n_requests=10, vocab=V, prompt_len=(9, 12), max_new=(2, 6),
+        mean_gap_ms=1.0, burst=5, priorities=2, slo_ms=40.0, seed=7,
+        shared_prefix=8, shared_frac=0.9,
+    ))
+    base = ServingConfig(
+        buckets=(16, S), decode_steps=4, max_batch=2, max_seq=S,
+        policy=SchedulerPolicy(name="slo"), kv_block=8, kv_blocks=9,
+        prefix_cache=True,
+    )
+    res = search_serving_config(
+        reqs, base, model=ServingLatencyModel.from_calibration())
+    flags = {s.config.prefix_cache for s in res.candidates}
+    assert flags == {True, False}
+    assert res.chosen.config.prefix_cache is True

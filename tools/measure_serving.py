@@ -214,6 +214,27 @@ def child(argv):
         if not ok:
             failures += 1
 
+    # -- span reconciliation (OBSERVABILITY.md "Reading a request") -----------
+    # Every request's span timeline must telescope EXACTLY to its
+    # e2e_ms (integer-microsecond equality, no tolerance) in BOTH the
+    # real and the simulated loop — any gap is an instrumentation bug.
+    from flexflow_tpu.obs import spans as _spans
+
+    def unreconciled(srv):
+        tls = _spans.build_timelines(srv.span_events)
+        return [i for i in sorted(tls) if not tls[i].reconciled], len(tls)
+
+    bad_real, n_real = unreconciled(real)
+    bad_sim, n_sim = unreconciled(sim)
+    ok = not bad_real and not bad_sim and n_real > 0 and n_sim == n_real
+    print(f"{'span reconciliation':<22} phase sums == e2e for "
+          f"{n_real} real + {n_sim} sim requests"
+          + (f"; UNRECONCILED real {bad_real} sim {bad_sim}"
+             if bad_real or bad_sim else "")
+          + f" {'PASS' if ok else 'FAIL'}")
+    if not ok:
+        failures += 1
+
     # -- speculation tokens/dispatch (bar >= 1.5x) ----------------------------
     # SERVING.md "Speculative decoding": d=12 full self-draft vs plain
     # fused k=8, same requests (the tiny model is 1 layer, so the

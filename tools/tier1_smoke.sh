@@ -21,6 +21,22 @@ cd "$(dirname "$0")/.."
 # the invariants.
 env PYTHONPATH="$(pwd)" JAX_PLATFORMS=cpu \
     python -m flexflow_tpu.analysis --fast
+# obs compare --gate A/A self-check: two identical telemetered dry-run
+# logs must read `ok` (any drift verdict here means the comparator or
+# the telemetry schema broke — the round-6 sentry's own sanity leg).
+AA_DIR=$(mktemp -d /tmp/tier1_obs_aa.XXXXXX)
+trap 'rm -rf "$AA_DIR"' EXIT
+for leg in a b; do
+    env PYTHONPATH="$(pwd)" JAX_PLATFORMS=cpu \
+        python -m flexflow_tpu.apps.alexnet --dry-run \
+        --telemetry "$AA_DIR/$leg" > /dev/null
+done
+env PYTHONPATH="$(pwd)" \
+    python -m flexflow_tpu.obs compare "$AA_DIR/a" "$AA_DIR/b" --gate \
+    > /dev/null
+echo "obs compare --gate A/A: ok"
+rm -rf "$AA_DIR"   # exec below replaces the shell; the trap won't fire
+trap - EXIT
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ops.py \
     tests/test_analysis.py \
@@ -34,6 +50,7 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_elastic.py \
     tests/test_telemetry.py \
     tests/test_obs.py \
+    tests/test_spans.py \
     tests/test_data_stream.py \
     tests/test_serving.py \
     tests/test_serving_sched.py \
