@@ -714,6 +714,111 @@ def bench_data_plane(n_chips: int, on_tpu: bool):
         unpref["samples_per_s"], 2)
     out["throttled_overlap_speedup"] = round(
         throttled["samples_per_s"] / unpref["samples_per_s"], 3)
+
+    # Sharded-embedding capacity (ISSUE 20, SHARDING.md): under a
+    # synthetic FF_DEVICE_MEM_BYTES budget, the max vocab the
+    # zero-copy tier admits with the table replicated (c=1) vs
+    # row-sharded over c=4 — the per-device table shrinks by c, so
+    # the admitted vocab must grow >= 2x (acceptance bar lives in
+    # tools/measure_embedding.py; bench just reports the columns).
+    out.update(_embedding_capacity_columns(batch))
+    return out
+
+
+def _embedding_capacity_columns(batch: int):
+    """Doubling-probe the max vocab ``DeviceResidentLoader`` admits
+    under a fixed budget, replicated vs c=4 row-sharded, plus the
+    throughput ratio at a vocab both layouts hold."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data.loader import (
+        DeviceMemoryError,
+        DeviceResidentLoader,
+    )
+    from flexflow_tpu.graph import FFModel
+    from flexflow_tpu.optim import SGDOptimizer
+    from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+    from flexflow_tpu.runtime.executor import Executor
+    from flexflow_tpu.runtime.trainer import Trainer
+
+    bag, d_emb = 4, 16
+    rows = batch * 8
+    rng = np.random.default_rng(13)
+
+    def arrays(vocab):
+        return {
+            "ids": rng.integers(0, vocab, size=(rows, bag)).astype(np.int32),
+            "label": rng.integers(0, 8, size=(rows,)).astype(np.int32),
+        }
+
+    def executor(vocab, c):
+        ff = FFModel(FFConfig(batch_size=batch, seed=7,
+                              shard_embeddings=c > 1))
+        ids = ff.create_tensor((batch, bag), dtype=np.int32, name="ids")
+        lbl = ff.create_tensor((batch,), dtype=np.int32, name="label")
+        t = ff.embedding(ids, vocab, d_emb, aggr="sum", name="emb")
+        t = ff.dense(t, 8, name="head")
+        ff.softmax(t, lbl, name="softmax")
+        nd = len(jax.devices())
+        store = StrategyStore(nd)
+        if c > 1:
+            store.set("emb", ParallelConfig(n=nd // c, c=c))
+        return Executor(ff, strategy=store,
+                        optimizer=SGDOptimizer(lr=0.01))
+
+    def admits(vocab, c):
+        try:
+            DeviceResidentLoader(arrays(vocab), batch, executor(vocab, c),
+                                 shuffle=True, seed=3)
+            return True
+        except DeviceMemoryError:
+            return False
+
+    def max_vocab(c, start=128, cap=1 << 20):
+        v = 0
+        probe = start
+        while probe <= cap and admits(probe, c):
+            v = probe
+            probe *= 2
+        return v
+
+    budget = 72 * 1024  # fits ~1k replicated rows over dataset + head
+    saved = os.environ.get("FF_DEVICE_MEM_BYTES")
+    os.environ["FF_DEVICE_MEM_BYTES"] = str(budget)
+    try:
+        rep = max_vocab(c=1)
+        shd = max_vocab(c=4)
+    finally:
+        if saved is None:
+            os.environ.pop("FF_DEVICE_MEM_BYTES", None)
+        else:
+            os.environ["FF_DEVICE_MEM_BYTES"] = saved
+    out = {
+        "emb_budget_bytes": budget,
+        "max_vocab_replicated": rep,
+        "max_vocab_sharded_c4": shd,
+        "vocab_capacity_ratio": round(shd / rep, 2) if rep else None,
+    }
+
+    # Throughput at a vocab both layouts hold (no budget in force).
+    common = max(rep, 128)
+    data = arrays(common)
+
+    def sps(c):
+        ex = executor(common, c)
+        batches = iter(DeviceResidentLoader(data, batch, ex,
+                                            shuffle=True, seed=3))
+        return Trainer(ex).fit(iterations=8, batches=batches,
+                               warmup=1)["samples_per_s"]
+
+    rep_sps, shd_sps = sps(1), sps(4)
+    out["replicated_emb_samples_per_s"] = round(rep_sps, 2)
+    out["sharded_emb_samples_per_s"] = round(shd_sps, 2)
+    out["sharded_vs_replicated"] = round(shd_sps / rep_sps, 3)
     return out
 
 

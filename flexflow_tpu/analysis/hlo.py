@@ -298,6 +298,108 @@ def full_activation_allgathers(ex, hlo_text: str = None) -> List[Collective]:
     sizes = set(sharded_activation_sizes(ex).values())
     if getattr(getattr(ex, "config", None), "zero_sharded_optimizer", False):
         sizes -= _param_sizes(ex)
+    # Row-sharded embedding ops (--shard-embeddings) REPLICATE their
+    # output-shaped row/row-grad tensors across the c group by design:
+    # the shard-local masked scatter needs every row grad on every
+    # table shard, so an all-gather at exactly the op's output size is
+    # the designed rows-not-tables traffic, not replicate-then-slice.
+    # (The real hazard — gathering the TABLE — is FFH002,
+    # ``full_table_allgathers``.)
+    sizes -= _row_sparse_output_sizes(ex)
+    return [
+        c for c in collective_stats(hlo_text)
+        if c.opcode == "all-gather" and c.elements in sizes
+    ]
+
+
+def _row_sparse_output_sizes(ex) -> set:
+    """Output element counts of ops carrying row-range-sharded params —
+    the sizes at which the sparse/sharded row protocol legitimately
+    all-gathers (see ``full_activation_allgathers``)."""
+    from flexflow_tpu.ops.embedding import _row_sharding
+
+    sizes = set()
+    for op in ex.model.layers:
+        specs = op.param_specs()
+        if not specs:
+            continue
+        op.bind_mesh(ex.plan, ex._pc(op))
+        if not any(_row_sharding(op, k) is not None for k in specs):
+            continue
+        for t in op.outputs:
+            n = 1
+            for d in t.shape:
+                n *= int(d)
+            sizes.add(n)
+    return sizes
+
+
+def sharded_table_sizes(ex) -> Dict[str, int]:
+    """Global element counts of row-range-sharded embedding tables
+    (``--shard-embeddings``): params whose leading dim is c-tagged
+    under a strategy with c degree > 1.  These exist precisely so NO
+    device ever holds the full table — an all-gather reaching the
+    global size defeats the layout (the owning-shard gather + psum
+    combine must move activations, never table rows)."""
+    from flexflow_tpu.ops.embedding import _row_sharding
+
+    sizes: Dict[str, int] = {}
+    for op in ex.model.layers:
+        if not op.param_specs():
+            continue
+        op.bind_mesh(ex.plan, ex._pc(op))
+        for key, spec in op.param_specs().items():
+            if _row_sharding(op, key) is None:
+                continue
+            n = 1
+            for d in spec.shape:
+                n *= int(d)
+            sizes[f"{op.name}.{key}"] = n
+    return sizes
+
+
+def _row_tensor_sizes(ex) -> set:
+    """Element counts of the per-step gathered-ROWS tensors of
+    row-sharded ops: one ``(D,)`` table row per id, so
+    ``prod(ids.shape) * D``.  The sparse/sharded protocol replicates
+    these (and their grads) across the c group by design."""
+    from flexflow_tpu.ops.embedding import _row_sharding
+
+    sizes = set()
+    for op in ex.model.layers:
+        specs = op.param_specs()
+        if not specs:
+            continue
+        op.bind_mesh(ex.plan, ex._pc(op))
+        for key, spec in specs.items():
+            if _row_sharding(op, key) is None:
+                continue
+            ids_elems = 1
+            for d in op.inputs[0].shape:
+                ids_elems *= int(d)
+            sizes.add(ids_elems * int(spec.shape[-1]))
+    return sizes
+
+
+def full_table_allgathers(ex, hlo_text: str = None) -> List[Collective]:
+    """All-gathers whose per-device result reaches the full global
+    size of a row-sharded embedding table (rule FFH002).  Empty list =
+    the compiled step resolves sharded-table lookups shard-locally
+    (psum / all-to-all of gathered ROWS is fine and expected; the
+    full-table gather is the HBM blow-up ``--shard-embeddings`` exists
+    to avoid).
+
+    Matching is by element count, so the designed rows traffic
+    (``prod(ids.shape) * D`` per op, replicated across c for the
+    shard-local masked scatter) is excluded — at the cost of masking a
+    real table gather exactly when ``vocab == prod(ids.shape)`` (same
+    collision caveat as FFH001's ZeRO-1 parameter exemption)."""
+    if hlo_text is None:
+        hlo_text = ex.lower_train_step().compile().as_text()
+    sizes = set(sharded_table_sizes(ex).values())
+    sizes -= _row_tensor_sizes(ex)
+    if not sizes:
+        return []
     return [
         c for c in collective_stats(hlo_text)
         if c.opcode == "all-gather" and c.elements in sizes

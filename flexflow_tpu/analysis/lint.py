@@ -358,7 +358,7 @@ def _check_tool_subprocess_timeout(tree: ast.AST, path: str):
 FF008_EVENT_NAMES = frozenset({
     "run_start", "run_end",
     "step", "input_wait", "superstep", "fence", "compiled_step",
-    "program_cost",
+    "program_cost", "embedding_gather", "embedding_combine",
     "ckpt_save", "ckpt_restore", "ckpt_torn",
     "fault", "rollback", "replay", "preempt",
     "stall", "stall_recovered", "profile_skipped",

@@ -494,7 +494,9 @@ def _hlo_collective_violations(ex, program: str) -> List[ProgramViolation]:
     from flexflow_tpu.analysis import hlo
 
     try:
-        bad = hlo.full_activation_allgathers(ex)
+        hlo_text = ex.lower_train_step().compile().as_text()
+        bad = hlo.full_activation_allgathers(ex, hlo_text)
+        bad_tables = hlo.full_table_allgathers(ex, hlo_text)
     except Exception as e:
         return [ProgramViolation(
             "FFH001", program,
@@ -510,6 +512,16 @@ def _hlo_collective_violations(ex, program: str) -> List[ProgramViolation]:
             op=c.op_name,
         )
         for c in bad
+    ] + [
+        ProgramViolation(
+            "FFH002", program,
+            f"all-gather materializes a full row-sharded embedding "
+            f"table ({c.shape}, {c.elements} elements/device) — "
+            f"--shard-embeddings exists so no device holds the whole "
+            f"table; the gather must stay shard-local + psum",
+            op=c.op_name,
+        )
+        for c in bad_tables
     ]
 
 

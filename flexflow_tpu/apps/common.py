@@ -54,6 +54,11 @@ Common flags (reference: model.cc:729-785 + README.md flag table):
                     dataset is never host-materialized; DATA.md)
   --shuffle-window W (streaming shuffle width; 0 = whole host shard,
                     which matches the in-memory loader bit-for-bit)
+  --shard-embeddings (row/vocab-range-shard embedding tables over the
+                    mesh c axis: per-device HBM holds rows/c, the
+                    lookup is the owning-shard gather + psum; the
+                    capacity hatch for tables past FF_DEVICE_MEM_BYTES;
+                    SHARDING.md)
   --accum-steps N   --microbatches N   --pipeline-schedule 1f1b|gpipe
   --pipeline-chunk C (scan C microbatches per stage program)
   --pipeline-compiled (ONE jitted program per pipeline step: fence-free

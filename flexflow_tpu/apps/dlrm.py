@@ -66,7 +66,8 @@ def main(argv=None) -> int:
         )
     ff = build_dlrm(batch_size=cfg.batch_size, dlrm=dlrm, config=cfg)
     ndev = cfg.resolve_num_devices()
-    strategy = load_strategy(cfg, ndev) or dlrm_strategy(ndev, dlrm)
+    strategy = load_strategy(cfg, ndev) or dlrm_strategy(
+        ndev, dlrm, shard_embeddings=cfg.shard_embeddings)
     int_high = {"sparse_input": min(dlrm.embedding_size)}
     arrays = None
     stream_source = None
@@ -104,7 +105,16 @@ def main(argv=None) -> int:
             arrays = make_dlrm_arrays(
                 dlrm, num_samples=num_samples, path=cfg.dataset_path,
             )
+    # The data-tier flags need a real dataset to tier: forward
+    # num_samples so synthetic arrays materialize and flow through the
+    # loader (--zc-dataset then stages device-resident and its
+    # FF_DEVICE_MEM_BYTES capacity check — which counts the per-device
+    # table bytes — actually runs).  The default path keeps the
+    # reference's fixed syntheticInput batch.
+    synth_n = num_samples if (cfg.zc_dataset or cfg.stream_dataset) \
+        else None
     run_training(ff, cfg, strategy=strategy, int_high=int_high,
+                 num_samples=synth_n,
                  arrays=arrays, stream_source=stream_source)
     return 0
 

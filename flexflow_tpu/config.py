@@ -88,6 +88,16 @@ class FFConfig:
     # numerics; applies only when the optimizer reports
     # ``supports_sparse_rows`` (see flexflow_tpu/ops/base.py).
     sparse_embedding_updates: bool = True
+    # --shard-embeddings: row/vocab-range-shard embedding TABLES over
+    # the mesh c axis (SHARDING.md "Sharded embedding tables") —
+    # per-device HBM holds rows/c of each table instead of a full
+    # replica, the lookup becomes the owning-shard gather + psum
+    # (never a full-table all-gather), and the row-sparse backward
+    # stays a local per-shard scatter-add.  The capacity escape hatch
+    # when a replicated table exceeds FF_DEVICE_MEM_BYTES; needs a
+    # strategy c degree on the embedding op to take effect
+    # (apps/dlrm's default strategy supplies one).
+    shard_embeddings: bool = False
     # Hybrid mesh granules: number of slow-interconnect islands for
     # build_hybrid_mesh_plan (0/1 = flat single-slice mesh).
     granules: int = 0
@@ -312,6 +322,8 @@ class FFConfig:
                 cfg.zc_dataset = True
             elif a == "--stream-dataset":
                 cfg.stream_dataset = True
+            elif a == "--shard-embeddings":
+                cfg.shard_embeddings = True
             elif a == "--shuffle-window":
                 cfg.shuffle_window = int(_next())
                 if cfg.shuffle_window < 0:

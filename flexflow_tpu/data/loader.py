@@ -260,14 +260,34 @@ class DeviceResidentLoader(ArrayDataLoader):
         # first device_put rather than OOMing mid-staging.
         staged = sum(int(np.asarray(v).nbytes) for v in arrays.values())
         limit = _device_bytes_limit()
-        if limit is not None and staged > limit:
-            raise DeviceMemoryError(
-                f"--zc-dataset would stage {staged / 1e9:.2f} GB "
-                f"replicated per device, over the {limit / 1e9:.2f} GB "
-                f"per-device budget.  Use the host loader path (drop "
-                f"--zc-dataset) or the streaming tier (--stream-dataset "
-                f"with --shuffle-window, DATA.md)."
+        if limit is not None:
+            # Params share the device: count each weight at its
+            # PER-DEVICE (sharded) size — a row-sharded embedding
+            # table (--shard-embeddings) holds only vocab/c rows per
+            # device, so the estimate credits exactly the escape
+            # hatch the refusal names.  eval_shape only, no device
+            # touched.
+            pavals, _, _ = executor._abstract_init()
+            pshard = executor.params_shardings()
+            param_bytes = sum(
+                int(np.prod(pshard[op][k].shard_shape(v.shape)))
+                * v.dtype.itemsize
+                for op, tree in pavals.items()
+                for k, v in tree.items()
+                if op in pshard and k in pshard[op]
             )
+            if staged + param_bytes > limit:
+                raise DeviceMemoryError(
+                    f"--zc-dataset would stage {staged / 1e9:.2f} GB "
+                    f"replicated per device (+ {param_bytes / 1e9:.2f} "
+                    f"GB per-device params), over the "
+                    f"{limit / 1e9:.2f} GB per-device budget.  Use the "
+                    f"host loader path (drop --zc-dataset), the "
+                    f"streaming tier (--stream-dataset with "
+                    f"--shuffle-window, DATA.md), or shrink the "
+                    f"per-device tables with --shard-embeddings "
+                    f"(SHARDING.md)."
+                )
         #: the staged (replicated) dataset — one H2D per array, total.
         self.device_arrays = {
             k: jax.device_put(v, self._rep) for k, v in arrays.items()

@@ -176,6 +176,10 @@ class FFModel:
         **kw,
     ) -> TensorSpec:
         self._embedding_dtypes(kw)
+        # --shard-embeddings: flip the table to its row-range-sharded
+        # layout (vocab over c).  Multi/Hetero embeddings are already
+        # leading-dim 'c'-tagged, so only the single-table ops switch.
+        kw.setdefault("shard_rows", self.config.shard_embeddings)
         return self._add(
             Embedding(self._unique("embedding", name), x, num_entries, out_dim,
                       aggr=aggr, **kw)
@@ -239,6 +243,7 @@ class FFModel:
         """Token embedding (batch, seq) -> (batch, seq, dim) (reference:
         the NMT embed op, ``nmt/embed.cu``)."""
         self._embedding_dtypes(kw)
+        kw.setdefault("shard_rows", self.config.shard_embeddings)
         return self._add(
             WordEmbedding(self._unique("word_embedding", name), x, num_entries,
                           out_dim, **kw)

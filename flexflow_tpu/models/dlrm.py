@@ -182,13 +182,29 @@ def dlrm_random_benchmark_config(num_tables: int = 8) -> DLRMConfig:
     )
 
 
-def dlrm_strategy(num_devices: int, dlrm: DLRMConfig) -> StrategyStore:
+def dlrm_strategy(
+    num_devices: int, dlrm: DLRMConfig, shard_embeddings: bool = False
+) -> StrategyStore:
     """The reference's DLRM strategy (``dlrm_strategy.cc:5-36``):
     embedding tables spread across devices (table parallelism), all
-    MLP/concat/loss ops data parallel (the fallback)."""
+    MLP/concat/loss ops data parallel (the fallback).
+
+    ``shard_embeddings`` (--shard-embeddings) extends table parallelism
+    to the heterogeneous per-table towers: each ``embedding{i}`` gets
+    the largest c degree dividing both its vocab and the mesh, so its
+    ``shard_rows`` table range-shards over c (SHARDING.md "Sharded
+    embedding tables").  The uniform-vocab ``MultiEmbedding`` already
+    carries ``c = gcd(T, num_devices)`` — its stacked dim IS the row
+    dim of the flat view."""
     store = StrategyStore(num_devices)
     num_tables = len(dlrm.embedding_size)
+    uniform = len(set(dlrm.embedding_size)) == 1
     ep = math.gcd(num_tables, num_devices)
-    if ep > 1:
+    if uniform and ep > 1:
         store.set("embeddings", ParallelConfig(c=ep))
+    if shard_embeddings and not uniform:
+        for i, vocab in enumerate(dlrm.embedding_size):
+            c = math.gcd(vocab, num_devices)
+            if c > 1:
+                store.set(f"embedding{i}", ParallelConfig(c=c))
     return store

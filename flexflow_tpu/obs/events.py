@@ -28,6 +28,8 @@ EVENT_CATALOG = frozenset({
     "fence",
     "compiled_step",
     "program_cost",
+    "embedding_gather",
+    "embedding_combine",
     # checkpoint / resilience
     "ckpt_save",
     "ckpt_restore",

@@ -11,6 +11,24 @@ parallelism is first-class via :class:`MultiEmbedding`, which stacks
 all tables into one (T, vocab, dim) parameter sharded T-ways on the
 ``c`` axis — the GSPMD equivalent of per-table placement, with the
 all-to-all the mapper's copies implied now emitted by XLA.
+
+Row sharding (SHARDING.md "Sharded embedding tables"): any table whose
+LEADING param dim is tagged ``c`` (``MultiEmbedding``'s stacked T dim,
+``HeteroEmbedding``'s row-concat dim, ``Embedding``/``WordEmbedding``
+under ``shard_rows=True`` / ``--shard-embeddings``) is range-sharded
+over the mesh c group — per-device HBM holds ``rows/c`` of it, the
+capacity move past a replicated table that exceeds
+``FF_DEVICE_MEM_BYTES``.  The lookup then runs as an explicit
+``shard_map``: the OWNING shard resolves each id
+(``id // rows_per_shard`` routing as a masked, clipped local take) and
+a ``psum`` over the c group assembles full rows — never a full-table
+all-gather (fflint FFH001 checks the compiled HLO for exactly that).
+Its transpose is a LOCAL masked scatter-add into the owning shard
+(the reference's atomicAdd backward, ``embedding.cu:128-158``, without
+atomics and without any collective), so the row-sparse update path
+composes with sharding unchanged.  Both directions are value-exact vs
+the replicated forms: the psum adds structural zeros and the local
+scatter applies the same per-occurrence adds in the same order.
 """
 
 from __future__ import annotations
@@ -21,6 +39,139 @@ import jax.numpy as jnp
 
 from flexflow_tpu.initializers import NormInitializer
 from flexflow_tpu.ops.base import Op, ParamSpec, TensorSpec
+
+
+def _row_sharding(op: Op, key: str):
+    """``(c_axes, c_deg, local_rows)`` when ``op``'s param ``key`` is
+    row-RANGE sharded over the mesh c group, else None.
+
+    Row-sharded means: the param's LEADING dim is tagged ``c``, the
+    bound strategy gives the op a c degree > 1, and the leading extent
+    divides evenly (GSPMD would pad otherwise and the range routing
+    would misattribute rows).  ``local_rows`` is in FLAT rows — the
+    ``(prod(shape[:-1]), D)`` view all the id/scatter math uses, so a
+    ``MultiEmbedding``'s per-shard T/c tables are ``(T/c)*V`` flat
+    rows."""
+    spec = op.param_specs().get(key)
+    if spec is None or not spec.dim_axes or spec.dim_axes[0] != "c":
+        return None
+    plan = getattr(op, "_plan", None)
+    pc = getattr(op, "_pc", None)
+    if plan is None or pc is None:
+        return None
+    (c_axes, c_deg), = plan.local_degrees(pc, "c")
+    if c_deg <= 1 or not c_axes:
+        return None
+    if spec.shape[0] % c_deg:
+        return None
+    nrows = 1
+    for s in spec.shape[:-1]:
+        nrows *= int(s)
+    return c_axes, c_deg, nrows // c_deg
+
+
+def _note_shard_event(op: Op, event: str, **fields) -> None:
+    """One build-time telemetry counter per (op, event): the sharded
+    gather/combine programs announce themselves when first traced —
+    host-side only, nothing lands in the jitted program."""
+    noted = getattr(op, "_shard_events", None)
+    if noted is None:
+        noted = op._shard_events = set()
+    if event in noted:
+        return
+    noted.add(event)
+    from flexflow_tpu.runtime import telemetry as _telemetry
+
+    _telemetry.current().emit(event, op=op.name, **fields)
+
+
+def _shard_offset(plan, c_axes, local_rows):
+    """First flat row owned by this shard: the linearized c-group
+    coordinate times the shard extent (the ``id // rows_per_shard``
+    routing, solved from the owning side)."""
+    import jax
+
+    k = 0
+    for ax in c_axes:
+        k = k * plan.mesh.shape[ax] + jax.lax.axis_index(ax)
+    return k * local_rows
+
+
+def _sharded_gather(op: Op, table, flat_ids, shard):
+    """Row-range-sharded ``table[(R, D)][flat_ids]``: each shard takes
+    the ids in its range (masked, clipped), a ``psum`` over the c
+    group assembles full rows.  Never a full-table all-gather; the
+    psum adds only structural zeros, so values are bit-identical to
+    the replicated ``jnp.take``.  Differentiable (pure shard_map +
+    psum), so both the dense-grad forward AND the executor sparse
+    protocol may route here."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    c_axes, c_deg, local_rows = shard
+    plan = op._plan
+    (n_axes, _), = plan.local_degrees(op._pc, "n")
+    # Batch-shaped ids keep their leading dim on n; 1-D id vectors
+    # (the stateful sparse path's unique rows) replicate.
+    n_entry = n_axes if (n_axes and flat_ids.ndim > 1) else None
+
+    def local_fn(tbl, ids):
+        start = _shard_offset(plan, c_axes, local_rows)
+        loc = ids - start
+        ok = (loc >= 0) & (loc < local_rows)
+        got = jnp.take(tbl, jnp.clip(loc, 0, local_rows - 1), axis=0)
+        got = jnp.where(ok[..., None], got, 0.0)
+        return jax.lax.psum(got, c_axes)
+
+    _note_shard_event(op, "embedding_gather", shards=int(c_deg),
+                      rows_per_shard=int(local_rows), combine="psum")
+    id_spec = (n_entry,) + (None,) * (flat_ids.ndim - 1)
+    return jax.shard_map(
+        local_fn,
+        mesh=plan.mesh,
+        in_specs=(PartitionSpec(c_axes, None), PartitionSpec(*id_spec)),
+        out_specs=PartitionSpec(*id_spec, None),
+        check_vma=False,
+    )(table, flat_ids)
+
+
+def _sharded_scatter_add(op: Op, table, flat_ids, upd, shard):
+    """Transpose of :func:`_sharded_gather`: each shard scatter-adds
+    the updates whose ids fall in its row range — a LOCAL masked
+    read-modify-write, no collective (ids/updates are batch-sized and
+    replicate into the shard_map; only the table stays sharded).
+    Out-of-range slots add exact zeros to local row 0, the same
+    no-op-compatible convention the stateful sparse path uses for its
+    padding slots."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    c_axes, c_deg, local_rows = shard
+    plan = op._plan
+    d = table.shape[-1]
+
+    def local_fn(tbl, ids, u):
+        start = _shard_offset(plan, c_axes, local_rows)
+        loc = ids.reshape(-1) - start
+        ok = (loc >= 0) & (loc < local_rows)
+        safe = jnp.where(ok, loc, 0)
+        u = jnp.where(ok[:, None], u.reshape(-1, d), 0.0)
+        return tbl.at[safe].add(u)
+
+    _note_shard_event(op, "embedding_combine", shards=int(c_deg),
+                      rows_per_shard=int(local_rows),
+                      combine="local_scatter_add")
+    return jax.shard_map(
+        local_fn,
+        mesh=plan.mesh,
+        in_specs=(
+            PartitionSpec(c_axes, None),
+            PartitionSpec(*(None,) * flat_ids.ndim),
+            PartitionSpec(*(None,) * upd.ndim),
+        ),
+        out_specs=PartitionSpec(c_axes, None),
+        check_vma=False,
+    )(table, flat_ids, upd)
 
 
 def _row_kernels_ok(op: Op, n_ids: int, table, kind: str = "scatter") -> bool:
@@ -51,10 +202,15 @@ def _row_kernels_ok(op: Op, n_ids: int, table, kind: str = "scatter") -> bool:
 
 
 def _gather_dispatch(op: Op, table, flat_ids):
-    """``table[(R, D)][flat_ids] -> flat_ids.shape + (D,)`` via the
-    Pallas row kernel when eligible, else ``jnp.take``.  Executor
-    sparse path only (not differentiable through)."""
+    """``table[(R, D)][flat_ids] -> flat_ids.shape + (D,)`` — the
+    row-sharded ``shard_map`` gather when the op's table is range
+    sharded, else the Pallas row kernel when eligible, else
+    ``jnp.take``.  Executor sparse path only (the Pallas branch is not
+    differentiable through)."""
     d = table.shape[1]
+    shard = _row_sharding(op, op.sparse_keys()[0])
+    if shard is not None:
+        return _sharded_gather(op, table, flat_ids, shard)
     if _row_kernels_ok(op, flat_ids.size, table, kind="gather"):
         from flexflow_tpu.ops import pallas_kernels as pk
 
@@ -64,9 +220,13 @@ def _gather_dispatch(op: Op, table, flat_ids):
 
 
 def _scatter_add_dispatch(op: Op, table, flat_ids, upd):
-    """``table.at[flat_ids].add(upd)`` via the in-place Pallas row
+    """``table.at[flat_ids].add(upd)`` — the local per-shard scatter
+    when the op's table is row-sharded, else the in-place Pallas row
     kernel when eligible.  Executor sparse path only."""
     upd = upd.astype(table.dtype)
+    shard = _row_sharding(op, op.sparse_keys()[0])
+    if shard is not None:
+        return _sharded_scatter_add(op, table, flat_ids, upd, shard)
     if _row_kernels_ok(op, flat_ids.size, table):
         from flexflow_tpu.ops import pallas_kernels as pk
 
@@ -81,6 +241,13 @@ class Embedding(Op):
 
     Input: int indices (batch, bag); output (batch, out_dim) after
     sum/avg over the bag dim (the reference's aggr modes).
+
+    ``shard_rows=True`` (``--shard-embeddings``) retags the table's
+    dims from column-split ``(None, "c")`` to row-range-sharded
+    ``("c", None)``: a c degree then shards the VOCAB so per-device
+    HBM holds ``num_entries/c`` rows, the lookup becomes the
+    shard_map gather+psum, and the output loses its 'c' tag (full
+    rows are assembled by the psum).
     """
 
     def __init__(
@@ -93,6 +260,7 @@ class Embedding(Op):
         dtype=jnp.float32,
         out_dtype=None,
         kernel_initializer=None,
+        shard_rows: bool = False,
     ):
         super().__init__(name, [x])
         assert x.ndim == 2, f"embedding input must be (batch, bag), got {x.shape}"
@@ -103,7 +271,9 @@ class Embedding(Op):
         # lets f32 tables — required by the row-sparse update kernels —
         # emit activations in the model's compute dtype.
         self.table_dtype = jnp.dtype(dtype)
-        self._make_output((x.shape[0], out_dim), out_dtype or dtype, ("n", "c"))
+        self.shard_rows = bool(shard_rows)
+        self._make_output((x.shape[0], out_dim), out_dtype or dtype,
+                          ("n", None) if self.shard_rows else ("n", "c"))
 
     def param_specs(self) -> Dict[str, ParamSpec]:
         a = self.attrs
@@ -112,7 +282,7 @@ class Embedding(Op):
                 (a["num_entries"], a["out_dim"]),
                 self.table_dtype,
                 self.kernel_initializer,
-                (None, "c"),
+                ("c", None) if self.shard_rows else (None, "c"),
             )
         }
 
@@ -120,7 +290,11 @@ class Embedding(Op):
         # Pure jnp (differentiable): the dense-grad path traces this
         # under value_and_grad.
         (idx,) = xs
-        rows = jnp.take(params["table"], idx, axis=0)  # (batch, bag, dim)
+        shard = _row_sharding(self, "table")
+        if shard is not None:
+            rows = _sharded_gather(self, params["table"], idx, shard)
+        else:
+            rows = jnp.take(params["table"], idx, axis=0)  # (batch, bag, dim)
         return self.sparse_forward(rows, xs, state, training)
 
     def sparse_keys(self):
@@ -195,9 +369,23 @@ class MultiEmbedding(Op):
     def forward(self, params, xs, state, training):
         # Pure jnp (differentiable).  Gather row idx[b, t] from table
         # t: one_hot-free take_along_axis.  (T, vocab, dim) indexed by
-        # (batch, T) → (batch, T, dim).
+        # (batch, T) → (batch, T, dim).  When the stacked dim is
+        # c-sharded (and c | T — leading-axis sharding survives the
+        # flat-view merge) the lookup routes through the explicit
+        # sharded gather over the (T*V, D) view: each shard resolves
+        # the ids whose tables it owns, a psum assembles full rows —
+        # the fancy-index form would leave GSPMD free to all-gather
+        # the whole stacked table.
         (idx,) = xs  # (batch, T)
         tables = params["tables"]  # (T, vocab, dim)
+        shard = _row_sharding(self, "tables")
+        if shard is not None:
+            T, V, D = tables.shape
+            rows = _sharded_gather(
+                self, tables.reshape(T * V, D),
+                self._flat_ids(tables, idx), shard,
+            )
+            return [rows.astype(self.outputs[0].dtype)], state
         t_range = jnp.arange(tables.shape[0])[None, :]  # (1, T)
         return [tables[t_range, idx].astype(self.outputs[0].dtype)], state
 
@@ -318,20 +506,6 @@ class HeteroEmbedding(Op):
     def sparse_keys(self):
         return ("table",)
 
-    def _shards_rows(self, plan, pc) -> bool:
-        """Single predicate for 'the table is row-range sharded' —
-        shared by forward (shard_map lookup) and sparse_ok so the two
-        gates cannot drift."""
-        if plan is None:
-            return False
-        (_, c_deg), = plan.local_degrees(pc, "c")
-        return c_deg > 1 and self.attrs["rows"] % c_deg == 0
-
-    def sparse_ok(self, plan, pc) -> bool:
-        # The row-range-sharded lookup runs inside shard_map; the
-        # sparse row-grad path covers only the replicated table.
-        return not self._shards_rows(plan, pc)
-
     def sparse_rows(self, params, xs):
         (idx,) = xs
         offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
@@ -354,48 +528,16 @@ class HeteroEmbedding(Op):
         return idx + offsets[None, :]
 
     def forward(self, params, xs, state, training):
-        import jax
-        from jax.sharding import PartitionSpec
-
         (idx,) = xs  # (batch, T)
         table = params["table"]
         offsets = jnp.asarray(self.attrs["offsets"], idx.dtype)
         flat = idx + offsets[None, :]  # global row ids
 
         out_dtype = self.outputs[0].dtype
-        plan = getattr(self, "_plan", None)
-        if not self._shards_rows(plan, getattr(self, "_pc", None)):
+        shard = _row_sharding(self, "table")
+        if shard is None:
             return [jnp.take(table, flat, axis=0).astype(out_dtype)], state
-        (n_axes, n_deg), (c_axes, c_deg) = plan.local_degrees(
-            self._pc, "n", "c"
-        )
-
-        local_rows = self.attrs["rows"] // c_deg
-
-        def local_fn(tbl, ids):
-            # Shard id along the c group: this device owns rows
-            # [k*local_rows, (k+1)*local_rows).
-            k = 0
-            for ax in (c_axes or ()):
-                k = k * plan.mesh.shape[ax] + jax.lax.axis_index(ax)
-            start = k * local_rows
-            loc = ids - start
-            ok = (loc >= 0) & (loc < local_rows)
-            got = jnp.take(tbl, jnp.clip(loc, 0, local_rows - 1), axis=0)
-            got = jnp.where(ok[..., None], got, 0.0)
-            return jax.lax.psum(got, c_axes)
-
-        n_entry = n_axes if n_axes else None
-        gathered = jax.shard_map(
-            local_fn,
-            mesh=plan.mesh,
-            in_specs=(
-                PartitionSpec(c_axes, None),
-                PartitionSpec(n_entry, None),
-            ),
-            out_specs=PartitionSpec(n_entry, None, None),
-            check_vma=False,
-        )(table, flat)
+        gathered = _sharded_gather(self, table, flat, shard)
         return [gathered.astype(out_dtype)], state
 
 
@@ -417,12 +559,19 @@ class WordEmbedding(Op):
         dtype=jnp.float32,
         out_dtype=None,
         kernel_initializer=None,
+        shard_rows: bool = False,
     ):
         super().__init__(name, [x])
         assert x.ndim == 2, f"word embedding input must be (batch, seq), got {x.shape}"
         self.attrs = dict(num_entries=num_entries, out_dim=out_dim)
         self.kernel_initializer = kernel_initializer or NormInitializer(0.0, 0.01)
         self.table_dtype = jnp.dtype(dtype)
+        # shard_rows (--shard-embeddings): vocab-range-shard the table
+        # over c — per-device HBM holds num_entries/c rows, the lookup
+        # runs the shard_map gather+psum (the replicated table stays
+        # the default: LM vocabs usually fit, and replication keeps
+        # the lookup collective-free).
+        self.shard_rows = bool(shard_rows)
         self._make_output((x.shape[0], x.shape[1], out_dim), out_dtype or dtype,
                           ("n", "s", None))
 
@@ -433,12 +582,17 @@ class WordEmbedding(Op):
                 (a["num_entries"], a["out_dim"]),
                 self.table_dtype,
                 self.kernel_initializer,
+                ("c", None) if self.shard_rows else None,
             )
         }
 
     def forward(self, params, xs, state, training):
         (idx,) = xs
-        rows = jnp.take(params["table"], idx, axis=0)
+        shard = _row_sharding(self, "table")
+        if shard is not None:
+            rows = _sharded_gather(self, params["table"], idx, shard)
+        else:
+            rows = jnp.take(params["table"], idx, axis=0)
         return [rows.astype(self.outputs[0].dtype)], state
 
     def sparse_keys(self):

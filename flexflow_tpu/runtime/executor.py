@@ -757,7 +757,18 @@ class Executor:
         lead = 1 + (1 if accum_steps > 1 else 0)
         sh = self._batch_shardings
         out = {}
-        for name in batches[0]:
+        # Integer inputs (embedding/label id queues) stage FIRST:
+        # device_put returns with the H2D copy in flight, so the id
+        # transfer overlaps the host-side np.stack of the (much
+        # larger) float inputs instead of queueing behind it.  Stable
+        # sort — within each dtype class the input order is unchanged.
+        names = sorted(
+            batches[0],
+            key=lambda n: 0 if np.issubdtype(
+                batches[0][n].dtype, np.integer
+            ) else 1,
+        )
+        for name in names:
             vals = [b[name] for b in batches]
             if all(isinstance(v, np.ndarray) for v in vals):
                 stacked = np.stack(vals)

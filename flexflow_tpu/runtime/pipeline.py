@@ -54,7 +54,12 @@ from flexflow_tpu.parallel.mesh import (
 )
 from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
 from flexflow_tpu.runtime import telemetry as _telemetry
-from flexflow_tpu.runtime.executor import Executor, _merge_metrics, mean_metrics
+from flexflow_tpu.runtime.executor import (
+    Executor,
+    _merge_metrics,
+    _unique_row_sums,
+    mean_metrics,
+)
 
 _log = logging.getLogger("ff.pipeline")
 
@@ -389,23 +394,15 @@ class PipelineExecutor:
         self.optimizer = optimizer or SGDOptimizer(
             lr=self.config.learning_rate, weight_decay=self.config.weight_decay
         )
-        if (getattr(self.config, "lazy_sparse_optimizer", False)
-                or getattr(self.optimizer, "lazy_sparse", False)):
-            # Loudly reject rather than silently fall back to the dense
-            # update: the row-sparse embedding path (sparse_rows /
-            # sparse_apply + scatter_add_rows) dispatches through the
-            # full-mesh executor's sparse protocol, and layer-wise
-            # strategies would need the gathered rows + lazy momentum
-            # carried per-stage over each stage's own submesh.
-            raise PlacementError(
-                "--lazy-sparse-opt supports the full-mesh Executor only: "
-                "row-sparse updates are per-op over the op's full-mesh "
-                "placement, and layer-wise strategies would need the "
-                "sparse protocol carried PER-STAGE (each stage's tables "
-                "and lazy momentum on that stage's own devices) — not "
-                "implemented (open ROADMAP item); drop the flag to take "
-                "the dense update path on pipeline strategies"
-            )
+        # Row-sparse embedding updates (--sparse-embeddings /
+        # --lazy-sparse-opt) ride the per-stage sparse carry: each
+        # stage Executor's _sparse_ops gate runs against the STAGE
+        # model (ids entering the stage are stage graph-inputs), the
+        # stage backward differentiates (dense_params, xs, rows) and
+        # emits (flat_ids, row_grads) per sparse op, the host loop
+        # concatenates them in microbatch order, and _finish_step /
+        # _compiled_step_impl apply the executor's row update
+        # (_stage_update_sparse) on the stage's own submesh.
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps
@@ -545,29 +542,66 @@ class PipelineExecutor:
 
         return jax.jit(fwd)
 
+    @functools.cached_property
+    def _stage_sparse(self) -> List[List[Op]]:
+        """Per-stage row-sparse ops (the executor's ``_sparse_ops``
+        gate run against the STAGE model: ids flowing into the stage
+        are stage graph-inputs, the plan/pc checks use the stage's own
+        submesh).  Non-empty entries switch that stage's backward to
+        the sparse carry and its update to the row form."""
+        return [ex._sparse_ops for ex in self.stage_ex]
+
+    def _dense_stage_params(self, si: int, params_si):
+        """The subtree the stage backward differentiates: full params
+        minus the sparse ops' tables (those get row cotangents)."""
+        names = {op.name for op in self._stage_sparse[si]}
+        if not names:
+            return params_si
+        return {k: v for k, v in params_si.items() if k not in names}
+
     def _stage_bwd(self, si: int):
         """(params, state, inputs, douts, dloss) -> (dparams, dinputs,
-        metrics, new_state).  Recomputes the stage forward (remat at
-        stage boundaries) so the fwd pass stores only stage inputs."""
+        metrics, new_state, sparse).  Recomputes the stage forward
+        (remat at stage boundaries) so the fwd pass stores only stage
+        inputs.  ``sparse`` maps each sparse op's name to its
+        ``(flat_ids, flat_row_grads)`` for this microbatch (``{}`` on
+        dense stages); ``dparams`` then spans only the dense subtree —
+        the table never materializes a dense gradient."""
         ex, st = self.stage_ex[si], self.stages[si]
         diffable = self._diffable_inputs(si)
+        sparse_ops = self._stage_sparse[si]
+        sparse_names = {op.name for op in sparse_ops}
 
         def bwd(params, state, inputs, douts, dloss):
             const = {k: v for k, v in inputs.items() if k not in diffable}
+            xs = {k: v for k, v in inputs.items() if k in diffable}
+            rows, ids = {}, {}
+            for op in sparse_ops:
+                op.bind_mesh(ex.plan, ex._pc(op))
+                op_xs = [inputs[t.name] for t in op.inputs]
+                rows[op.name] = op.sparse_rows(params[op.name], op_xs)
+                ids[op.name] = op.sparse_flat_ids(params[op.name], op_xs)
+            dense = {k: v for k, v in params.items()
+                     if k not in sparse_names}
 
-            def f(p, xs):
+            def f(p, x, r):
                 loss, metrics, new_state, env = ex.forward(
-                    p, state, {**const, **xs}, training=True
+                    p, state, {**const, **x}, training=True,
+                    rows_override=r or None,
                 )
                 outs = {n: env[n] for n in st.out_names}
                 return (outs, loss), (metrics, new_state)
 
-            xs = {k: v for k, v in inputs.items() if k in diffable}
             (_, _), vjp, (metrics, new_state) = jax.vjp(
-                f, params, xs, has_aux=True
+                f, dense, xs, rows, has_aux=True
             )
-            dparams, dxs = vjp((douts, dloss))
-            return dparams, dxs, metrics, new_state
+            dparams, dxs, drows = vjp((douts, dloss))
+            sparse = {
+                n: (ids[n].reshape(-1),
+                    drows[n].reshape(-1, drows[n].shape[-1]))
+                for n in drows
+            }
+            return dparams, dxs, metrics, new_state, sparse
 
         return jax.jit(bwd)
 
@@ -630,45 +664,71 @@ class PipelineExecutor:
         (every stage but the last) drops metrics from the carry."""
         ex, st = self.stage_ex[si], self.stages[si]
         diffable = self._diffable_inputs(si)
+        sparse_ops = self._stage_sparse[si]
+        sparse_names = {op.name for op in sparse_ops}
 
         def bwd(params, prestates, inputs, douts, dloss, grads_acc,
                 metrics_acc):
             const_in = {k: v for k, v in inputs.items() if k not in diffable}
             xs_in = {k: v for k, v in inputs.items() if k in diffable}
+            dense = {k: v for k, v in params.items()
+                     if k not in sparse_names}
 
             def body(carry, per_mb):
                 s, const, xs, dd = per_mb
+                rows, ids = {}, {}
+                for op in sparse_ops:
+                    op.bind_mesh(ex.plan, ex._pc(op))
+                    mb_in = {**const, **xs}
+                    op_xs = [mb_in[t.name] for t in op.inputs]
+                    rows[op.name] = op.sparse_rows(params[op.name], op_xs)
+                    ids[op.name] = op.sparse_flat_ids(
+                        params[op.name], op_xs
+                    )
 
-                def f(p, x):
+                def f(p, x, r):
                     loss, metrics, new_state, env = ex.forward(
-                        p, s, {**const, **x}, training=True
+                        p, s, {**const, **x}, training=True,
+                        rows_override=r or None,
                     )
                     outs = {n: env[n] for n in st.out_names}
                     return (outs, loss), (metrics, new_state)
 
                 (_, _), vjp, (metrics, _) = jax.vjp(
-                    f, params, xs, has_aux=True
+                    f, dense, xs, rows, has_aux=True
                 )
-                dparams, dxs = vjp((dd, dloss))
+                dparams, dxs, drows = vjp((dd, dloss))
+                sparse = {
+                    n: (ids[n].reshape(-1),
+                        drows[n].reshape(-1, drows[n].shape[-1]))
+                    for n in drows
+                }
                 if metrics_acc is None:
                     g = jax.tree.map(jnp.add, carry, dparams)
-                    return g, dxs
+                    return g, (dxs, sparse)
                 g, macc = carry
                 g = jax.tree.map(jnp.add, g, dparams)
                 macc = {k: macc[k] + metrics[k] for k in macc}
-                return (g, macc), dxs
+                return (g, macc), (dxs, sparse)
 
             init = (
                 grads_acc if metrics_acc is None
                 else (grads_acc, metrics_acc)
             )
-            carry, dxs = jax.lax.scan(
+            carry, (dxs, sparse) = jax.lax.scan(
                 body, init, (prestates, const_in, xs_in, douts)
             )
+            # Stacked (L, n, ...) per-microbatch sparse carries flatten
+            # to the concatenation in microbatch order — the same order
+            # the chunk=1 event loop appends in.
+            sparse = {
+                n: (i.reshape(-1), g.reshape(-1, g.shape[-1]))
+                for n, (i, g) in sparse.items()
+            }
             if metrics_acc is None:
-                return carry, None, dxs
+                return carry, None, dxs, sparse
             g, macc = carry
-            return g, macc, dxs
+            return g, macc, dxs, sparse
 
         return jax.jit(bwd)
 
@@ -687,9 +747,11 @@ class PipelineExecutor:
         (the chunk=1 path starts from the gradient itself)."""
         z = self._zero_grads_cache.get(si)
         if z is None:
+            # Sparse stages carry gradients only for the DENSE subtree
+            # (tables flow as (flat_ids, row_grads) instead).
             z = self._zero_grads_cache[si] = jax.jit(
                 lambda p: jax.tree.map(jnp.zeros_like, p)
-            )(params_si)
+            )(self._dense_stage_params(si, params_si))
         return z
 
     def _abstract_zero_metrics(self, si: int, params_si, prestates, inputs):
@@ -760,6 +822,152 @@ class PipelineExecutor:
             return jax.jit(upd, donate_argnums=(0, 1))
 
         return [make(i) for i in range(len(self.stages))]
+
+    # -- per-stage sparse carry ---------------------------------------------
+    #
+    # Sparse stages never materialize a table-sized gradient: the stage
+    # backward emits (flat_ids, row_grads) per sparse op, the host loop
+    # (or the chunk scan) concatenates them in microbatch order, and
+    # the tail below applies the executor's row update on the stage's
+    # own submesh.  One traced body (_stage_update_sparse /
+    # _stage_sq_sparse) serves both the host-driven jits and the
+    # compiled whole-step trace, so host-vs-compiled bit-identity holds
+    # by construction.
+
+    def _stage_sq_sparse(self, si: int, grads, sparse):
+        """Stage clip-norm squared term with the sparse carries folded
+        in: each sparse op's UNIQUE-row summed cotangent squares (the
+        dense table gradient sums duplicate-id cotangents BEFORE
+        squaring) plus the dense leaves' squares — `extra_sq` first,
+        the same fold order as ``Executor._clip_scale``."""
+        extra = sum(
+            jnp.sum(jnp.square(
+                _unique_row_sums(ids, g)[1].astype(jnp.float32)
+            ))
+            for ids, g in sparse.values()
+        )
+        return extra + self._grad_sq_fns[si](grads)
+
+    def _stage_update_sparse(self, si: int, params, opt_state, grads,
+                             sparse, scale):
+        """Sparse-stage optimizer tail (mirrors the full-mesh
+        ``Executor.build_train_step`` sparse tail): dense update over
+        the filtered param/optimizer-state trees, then one row update
+        per sparse op — stateless: per-occurrence scatter of
+        ``-lr*g``; stateful (lazy momentum/Adam): unique-row sums into
+        the optimizer's row step.  ``scale`` is the clip factor for
+        the ROW grads (the dense grads arrive pre-scaled), or None
+        when clip is off."""
+        from flexflow_tpu.ops.embedding import _scatter_add_dispatch
+
+        ex = self.stage_ex[si]
+        sparse_ops = [
+            op for op in self._stage_sparse[si] if op.name in sparse
+        ]
+        sparse_names = {op.name for op in sparse_ops}
+        stateless = getattr(self.optimizer, "stateless_sparse", True)
+        dense = {k: v for k, v in params.items() if k not in sparse_names}
+        opt_dense = self.optimizer.map_param_states(
+            opt_state,
+            lambda tree: {k: v for k, v in tree.items()
+                          if k not in sparse_names},
+        )
+        new_params, new_opt = self.optimizer.update(dense, opt_dense, grads)
+        new_params = dict(new_params)
+        new_opt = self.optimizer.restore_param_states(
+            new_opt, opt_state, sparse_names
+        ) if new_opt is not None else None
+        lr = self.optimizer.lr
+        for op in sparse_ops:
+            op.bind_mesh(ex.plan, ex._pc(op))
+            ids, g = sparse[op.name]
+            if stateless:
+                if scale is not None:
+                    g = g * scale
+                key = op.sparse_keys()[0]
+                table = params[op.name][key]
+                flat = table.reshape(-1, table.shape[-1])
+                new_flat = _scatter_add_dispatch(op, flat, ids, -lr * g)
+                new_params[op.name] = {
+                    **params[op.name], key: new_flat.reshape(table.shape)
+                }
+            else:
+                uniq = _unique_row_sums(ids, g)
+                new_params[op.name], new_opt = ex._sparse_stateful_apply(
+                    op, params[op.name], new_opt, uniq, scale
+                )
+        return new_params, new_opt
+
+    @functools.cached_property
+    def _sparse_sq_fns(self):
+        def make(si):
+            def sq(grads, sparse):
+                return self._stage_sq_sparse(si, grads, sparse)
+
+            return jax.jit(sq)
+
+        return [make(i) for i in range(len(self.stages))]
+
+    @functools.cached_property
+    def _sparse_opt_fns(self):
+        def make(si):
+            def upd(params, opt_state, grads, sparse, scale):
+                return self._stage_update_sparse(
+                    si, params, opt_state, grads, sparse, scale
+                )
+
+            return jax.jit(upd, donate_argnums=(0, 1))
+
+        return [make(i) for i in range(len(self.stages))]
+
+    @functools.cached_property
+    def _sparse_concat_fns(self):
+        """Per-stage jitted concat of the per-unit (ids, row_grads)
+        carries in microbatch order — ONE host dispatch per sparse
+        stage per step (PIPELINE_OVERHEAD.md: dispatch cost is per
+        call)."""
+        def make(si):
+            # Pin the carry REPLICATED on the stage submesh: the
+            # per-microbatch loop hands over batch-sharded pieces while
+            # the chunked scan's flatten hands over replicated ones —
+            # without one canonical spec the row-update program
+            # partitions its duplicate-id scatter differently per
+            # producer and chunk invariance loses bit-identity.
+            rep = self.stage_ex[si].plan.replicated()
+
+            def cat(pieces):
+                return {
+                    n: (
+                        jax.lax.with_sharding_constraint(
+                            jnp.concatenate([p[n][0] for p in pieces]),
+                            rep,
+                        ),
+                        jax.lax.with_sharding_constraint(
+                            jnp.concatenate(
+                                [p[n][1] for p in pieces], axis=0
+                            ),
+                            rep,
+                        ),
+                    )
+                    for n in pieces[0]
+                }
+
+            return jax.jit(cat)
+
+        return [make(i) for i in range(len(self.stages))]
+
+    def _concat_sparse(self, sparse_acc: Dict[int, List[Any]]):
+        """Fold the per-unit sparse carries collected by the event
+        loops into per-stage ``{op: (ids, row_grads)}`` concatenations
+        (microbatch order — the accumulation-order invariant).  Single
+        pieces still route through the jitted concat for its canonical
+        replicated output sharding."""
+        out = {}
+        for si, pieces in sparse_acc.items():
+            if not pieces:
+                continue
+            out[si] = self._sparse_concat_fns[si](tuple(pieces))
+        return out
 
     # -- data movement ------------------------------------------------------
 
@@ -965,15 +1173,15 @@ class PipelineExecutor:
             self.note_fused_dispatch()
             return fn(params, opt_state, state, batch)
         if self.chunk > 1:
-            grads, stage_state, metrics_acc = self._run_chunked(
+            grads, stage_state, metrics_acc, sparse = self._run_chunked(
                 params, state, batch
             )
         else:
-            grads, stage_state, metrics_acc = self._run_microbatched(
+            grads, stage_state, metrics_acc, sparse = self._run_microbatched(
                 params, state, batch
             )
         return self._finish_step(params, opt_state, stage_state, grads,
-                                 metrics_acc)
+                                 metrics_acc, sparse)
 
     def _run_microbatched(self, params, state, batch):
         """The chunk=1 event loop: one fwd/bwd program per (stage,
@@ -993,6 +1201,10 @@ class PipelineExecutor:
         dloss_seed = jnp.float32(1.0 / m)
         grads = {si: None for si in range(S)}
         metrics_acc: Dict[str, jax.Array] = {}
+        # Per-stage per-microbatch sparse carries, appended in B-event
+        # order == microbatch order (both schedules fire a stage's
+        # backwards in microbatch order).
+        sparse_acc: Dict[int, List[Any]] = {si: [] for si in range(S)}
         # name -> list of cotangent contributions per microbatch (one
         # per consumer stage; a skip connection consumed by several
         # later stages contributes several — they SUM, on the
@@ -1032,7 +1244,7 @@ class PipelineExecutor:
                 "pipeline_stage_bwd", self._bwd_fns[si],
                 (params[si], fwd_state[mi][si], stage_inputs[mi][si],
                  douts, dloss_seed), stage=si)
-            dparams, dxs, mets, _ = self._bwd_fns[si](
+            dparams, dxs, mets, _, sp = self._bwd_fns[si](
                 params[si], fwd_state[mi][si], stage_inputs[mi][si],
                 douts, dloss_seed,
             )
@@ -1044,13 +1256,15 @@ class PipelineExecutor:
                 grads[si] = dparams
             else:
                 grads[si] = jax.tree.map(jnp.add, grads[si], dparams)
+            if sp:
+                sparse_acc[si].append(sp)
             for n, g in dxs.items():
                 dout_back[mi].setdefault(n, []).append(g)
             if si == S - 1:
                 metrics_acc = _merge_metrics(metrics_acc, {
                     k: v for k, v in mets.items()
                 })
-        return grads, stage_state, metrics_acc
+        return grads, stage_state, metrics_acc, self._concat_sparse(sparse_acc)
 
     def _chunk_plan(self, m: int, c: int) -> List[int]:
         """Chunk lengths covering ``m`` microbatches: ``ceil(m/c)``
@@ -1089,6 +1303,9 @@ class PipelineExecutor:
         dloss_seed = jnp.float32(1.0 / m)
         grads = {si: None for si in range(S)}
         metrics_acc = None
+        # Per-stage per-chunk sparse carries (each already flattened in
+        # microbatch order by the scan), appended in chunk order.
+        sparse_acc: Dict[int, List[Any]] = {si: [] for si in range(S)}
 
         events = self.build_schedule(S, n_chunks)
         self.last_schedule = events
@@ -1128,26 +1345,31 @@ class PipelineExecutor:
                 "pipeline_stage_bwd_chunk", self._bwd_chunk_fns[si],
                 (params[si], pre_states[ci][si], stage_inputs[ci][si],
                  douts, dloss_seed, g_acc, m_acc), stage=si)
-            g, mets, dxs = self._bwd_chunk_fns[si](
+            g, mets, dxs, sp = self._bwd_chunk_fns[si](
                 params[si], pre_states[ci][si], stage_inputs[ci][si],
                 douts, dloss_seed, g_acc, m_acc,
             )
             grads[si] = g
             if si == S - 1:
                 metrics_acc = mets
+            if sp:
+                sparse_acc[si].append(sp)
             # Release the remat inputs/states this backward consumed.
             stage_inputs[ci][si] = None
             pre_states[ci][si] = None
             for n, gx in dxs.items():
                 dout_back[ci].setdefault(n, []).append(gx)
-        return grads, stage_state, metrics_acc or {}
+        return (grads, stage_state, metrics_acc or {},
+                self._concat_sparse(sparse_acc))
 
     def _finish_step(self, params, opt_state, stage_state, grads,
-                     metrics_acc):
+                     metrics_acc, sparse=None):
         """Shared step tail: global clip-norm (ONE batched fence), the
-        per-stage optimizer updates, and count-aware metric means."""
+        per-stage optimizer updates (row updates on sparse stages), and
+        count-aware metric means."""
         m = self.microbatches
         S = len(self.stages)
+        sparse = sparse or {}
         # --clip-norm: the global L2 norm spans ALL stages' gradients;
         # per-stage squared norms combine on the host (the per-stage
         # grads live on different submeshes), then each stage scales.
@@ -1155,24 +1377,40 @@ class PipelineExecutor:
         # bit-identical to the compiled step's in-program hierarchical
         # clip — and the fetch is ONE device_get of all S squared norms
         # (each separate fetch is a ~1.5-16 ms round-trip through the
-        # relay).  The compiled path has no fence here at all.
+        # relay).  Sparse stages fold their unique-row sums into the
+        # SAME fence.  The compiled path has no fence here at all.
+        scale_arr = None
         if self.config.clip_norm > 0.0:
             sqs = _telemetry.current().fence(
-                [self._grad_sq_fns[si](grads[si]) for si in range(S)],
+                [
+                    self._sparse_sq_fns[si](grads[si], sparse[si])
+                    if si in sparse
+                    else self._grad_sq_fns[si](grads[si])
+                    for si in range(S)
+                ],
                 "clip_norm",
             )
             scale = _clip_scale_f32_host(sqs, self.config.clip_norm)
+            # Sparse row grads always multiply (x1.0 is bit-exact —
+            # the compiled path's unconditional form); dense grads
+            # keep the skip-at-1.0 fast path.
+            scale_arr = jnp.float32(scale)
             if scale < 1.0:
-                s_arr = jnp.float32(scale)
                 for si in range(S):
-                    grads[si] = self._scale_fns[si](grads[si], s_arr)
+                    grads[si] = self._scale_fns[si](grads[si], scale_arr)
 
         # Optimizer (per stage, concurrent across submeshes).
         new_params, new_opt = {}, {}
         for si in range(S):
-            new_params[si], new_opt[si] = self._opt_fns[si](
-                params[si], opt_state[si], grads[si]
-            )
+            if si in sparse:
+                new_params[si], new_opt[si] = self._sparse_opt_fns[si](
+                    params[si], opt_state[si], grads[si], sparse[si],
+                    scale_arr,
+                )
+            else:
+                new_params[si], new_opt[si] = self._opt_fns[si](
+                    params[si], opt_state[si], grads[si]
+                )
         m_out = mean_metrics(metrics_acc, count=m)
         return new_params, new_opt, stage_state, m_out
 
@@ -1297,6 +1535,7 @@ class PipelineExecutor:
         dloss_seed = jnp.float32(1.0 / m)
         dout_back: Dict[str, List[Any]] = {}
         grads: Dict[int, Any] = {}
+        sparse: Dict[int, Any] = {}
         metrics_acc = None
         for si in range(S - 1, -1, -1):
             st = self.stages[si]
@@ -1327,41 +1566,57 @@ class PipelineExecutor:
                     douts[n] = jax.lax.with_sharding_constraint(
                         jnp.zeros(ref.shape, ref.dtype), sh
                     )
-            g_acc = jax.tree.map(jnp.zeros_like, params[si])
+            g_acc = jax.tree.map(
+                jnp.zeros_like, self._dense_stage_params(si, params[si])
+            )
             m_acc = None
             if si == S - 1:
                 m_acc = self._abstract_zero_metrics(
                     si, params[si], pre_states[si], stage_inputs[si]
                 )
-            g, mets, dxs = jax.lax.optimization_barrier(
+            g, mets, dxs, sp = jax.lax.optimization_barrier(
                 self._bwd_chunk_fns[si](
                     params[si], pre_states[si], stage_inputs[si],
                     douts, dloss_seed, g_acc, m_acc,
                 )
             )
             grads[si] = g
+            sparse[si] = sp
             if si == S - 1:
                 metrics_acc = mets
             for n, gx in dxs.items():
                 dout_back.setdefault(n, []).append(gx)
 
         # Device-side hierarchical clip-norm: per-stage squared norms
-        # (the same _grad_sq_fns bodies) combined in stage order with
-        # the shared f32 formula — the host path's one-fence-per-step
-        # floor simply does not exist here.
+        # (the same _grad_sq_fns / _stage_sq_sparse bodies as the host
+        # path) combined in stage order with the shared f32 formula —
+        # the host path's one-fence-per-step floor simply does not
+        # exist here.
+        scale = None
         if self.config.clip_norm > 0.0:
-            total = self._grad_sq_fns[0](grads[0])
+            def term(si):
+                if sparse[si]:
+                    return self._stage_sq_sparse(si, grads[si], sparse[si])
+                return self._grad_sq_fns[si](grads[si])
+
+            total = term(0)
             for si in range(1, S):
-                total = total + self._grad_sq_fns[si](grads[si])
+                total = total + term(si)
             scale = _clip_scale_f32(total, self.config.clip_norm)
             for si in range(S):
                 grads[si] = self._scale_fns[si](grads[si], scale)
 
         new_params, new_opt = {}, {}
         for si in range(S):
-            new_params[si], new_opt[si] = self.optimizer.update(
-                params[si], opt_state[si], grads[si]
-            )
+            if sparse[si]:
+                new_params[si], new_opt[si] = self._stage_update_sparse(
+                    si, params[si], opt_state[si], grads[si],
+                    sparse[si], scale,
+                )
+            else:
+                new_params[si], new_opt[si] = self.optimizer.update(
+                    params[si], opt_state[si], grads[si]
+                )
         m_out = mean_metrics(metrics_acc or {}, count=m)
         return new_params, new_opt, stage_state, m_out
 
@@ -1434,7 +1689,16 @@ class PipelineExecutor:
             )
         sh = self._compiled_batch_shardings
         out = {}
-        for name in batches[0]:
+        # Ids-first H2D staging, mirroring Executor.stack_steps: the
+        # async device_put of integer id queues overlaps the host
+        # np.stack of the float inputs.
+        names = sorted(
+            batches[0],
+            key=lambda n: 0 if np.issubdtype(
+                batches[0][n].dtype, np.integer
+            ) else 1,
+        )
+        for name in names:
             vals = [b[name] for b in batches]
             if all(isinstance(v, np.ndarray) for v in vals):
                 stacked = np.stack(vals)
@@ -1504,13 +1768,21 @@ class PipelineExecutor:
         for si in range(S - 1, -1, -1):
             st = self.stages[si]
             douts = {n: boundary[n] for n in st.out_names}
-            dparams, dxs, _, _ = jax.eval_shape(
+            dparams, dxs, _, _, sparse = jax.eval_shape(
                 self._bwd_fns[si], params[si], state[si],
                 stage_inputs[si], douts, dloss,
             )
-            jax.eval_shape(
-                self.optimizer.update, params[si], opt_state[si], dparams
-            )
+            if sparse:
+                jax.eval_shape(
+                    lambda p, o, g, sp, _si=si:
+                        self._stage_update_sparse(_si, p, o, g, sp, None),
+                    params[si], opt_state[si], dparams, sparse,
+                )
+            else:
+                jax.eval_shape(
+                    self.optimizer.update, params[si], opt_state[si],
+                    dparams,
+                )
         return params, opt_state, state, metrics
 
     @functools.cached_property

@@ -148,6 +148,13 @@ def stubbed_bench(monkeypatch):
             "throttled_stream_samples_per_s": 900.0,
             "throttled_unprefetched_samples_per_s": 450.0,
             "throttled_overlap_speedup": 2.0,
+            "emb_budget_bytes": 73728,
+            "max_vocab_replicated": 1024,
+            "max_vocab_sharded_c4": 4096,
+            "vocab_capacity_ratio": 4.0,
+            "replicated_emb_samples_per_s": 800.0,
+            "sharded_emb_samples_per_s": 700.0,
+            "sharded_vs_replicated": 0.875,
         }),
     )
     monkeypatch.setattr(
@@ -286,6 +293,16 @@ def test_bench_stdout_is_exactly_one_json_line(stubbed_bench, monkeypatch):
     assert dp["throttled_stream_samples_per_s"] == 900.0
     assert dp["throttled_unprefetched_samples_per_s"] == 450.0
     assert dp["throttled_overlap_speedup"] == 2.0
+    # Sharded-embedding capacity columns (ISSUE 20): max vocab the
+    # zero-copy tier admits under FF_DEVICE_MEM_BYTES, replicated vs
+    # c=4 row-sharded, and the throughput ratio at a common vocab.
+    assert dp["emb_budget_bytes"] == 73728
+    assert dp["max_vocab_replicated"] == 1024
+    assert dp["max_vocab_sharded_c4"] == 4096
+    assert dp["vocab_capacity_ratio"] == 4.0
+    assert dp["replicated_emb_samples_per_s"] == 800.0
+    assert dp["sharded_emb_samples_per_s"] == 700.0
+    assert dp["sharded_vs_replicated"] == 0.875
     # The box-state fingerprint (obs/registry.py): pairs this artifact
     # with telemetry runs for cross-run drift detection.  Every field
     # present; values may be None on a degraded box but the schema is

@@ -147,6 +147,73 @@ class TestCompiledStep:
         assert full_activation_allgathers(ex, hlo) == []
 
 
+class TestShardedTables:
+    """FFH002 (ISSUE 20): row-sharded embedding tables must never be
+    re-gathered in full — the owning-shard gather + psum combine is
+    the whole point of ``--shard-embeddings``."""
+
+    def _emb(self, c=4):
+        import jax.numpy as jnp
+
+        from flexflow_tpu.config import FFConfig
+        from flexflow_tpu.graph import FFModel
+        from flexflow_tpu.parallel.strategy import ParallelConfig, StrategyStore
+
+        ff = FFModel(FFConfig(batch_size=16, shard_embeddings=True))
+        ids = ff.create_tensor((16, 4), dtype=jnp.int32, name="ids")
+        lbl = ff.create_tensor((16,), dtype=jnp.int32, name="label")
+        t = ff.embedding(ids, 96, 8, aggr="sum", name="emb")
+        t = ff.dense(t, 16, activation="relu", name="fc1")
+        t = ff.dense(t, 4, name="fc2")
+        ff.softmax(t, lbl, name="softmax")
+        store = StrategyStore(8)
+        store.set("emb", ParallelConfig(n=8 // c, c=c))
+        return ff, store
+
+    def test_sharded_embedding_no_full_table_allgather(self):
+        from flexflow_tpu.analysis.hlo import (
+            count_collectives,
+            full_table_allgathers,
+            sharded_table_sizes,
+        )
+
+        ff, store = self._emb(c=4)
+        ex, hlo = _audit(ff, store)
+        assert sharded_table_sizes(ex) == {"emb.table": 96 * 8}
+        assert full_table_allgathers(ex, hlo) == []
+        # The shard-local gather combines with a psum (all-reduce) —
+        # presence guard against a vacuously-empty parse.
+        counts = count_collectives(hlo)
+        assert counts.get("all-reduce", 0) >= 1, counts
+
+    def test_full_table_allgather_flagged(self):
+        """A synthetic all-gather at exactly the global table size is
+        the violation the rule exists to catch."""
+        from flexflow_tpu.analysis.hlo import full_table_allgathers
+
+        ff, store = self._emb(c=4)
+        ex = Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.1),
+                      devices=jax.devices()[:8])
+        hlo = "%all-gather.1 = f32[96,8]{1,0} all-gather(%table)\n"
+        bad = full_table_allgathers(ex, hlo)
+        assert len(bad) == 1 and bad[0].elements == 96 * 8
+
+    def test_unsharded_tables_exempt(self):
+        """c=1 (replicated table): no sharded-table sizes, the check
+        is inert even when a legitimate full-size gather exists."""
+        from flexflow_tpu.analysis.hlo import (
+            full_table_allgathers,
+            sharded_table_sizes,
+        )
+
+        ff, store = self._emb(c=1)
+        ex = Executor(ff, strategy=store, optimizer=SGDOptimizer(lr=0.1),
+                      devices=jax.devices()[:8])
+        assert sharded_table_sizes(ex) == {}
+        hlo = "%all-gather.1 = f32[96,8]{1,0} all-gather(%table)\n"
+        assert full_table_allgathers(ex, hlo) == []
+
+
 class TestByteAccounting:
     def test_bytes_dtype_and_metadata(self):
         hlo = (

@@ -117,12 +117,19 @@ def test_hetero_sparse_matches_dense(rng):
         pd["tables"]["table"], ps["tables"]["table"], rtol=1e-6, atol=1e-7
     )
 
-    # Row-range-sharded tables stay on the dense path (shard_map fwd).
+    # Row-range-sharded tables now ride the sparse path too: the
+    # owning-shard gather/scatter dispatches (ops/embedding.py
+    # _sharded_gather/_sharded_scatter_add) keep the per-row protocol
+    # intact under c>1, so the sharded run must match the replicated
+    # dense oracle.
     store = StrategyStore(8)
     store.set("tables", ParallelConfig(n=2, c=4))
-    ex = Executor(build(True), strategy=store, optimizer=SGDOptimizer(lr=0.3),
-                  devices=jax.devices()[:8])
-    assert not ex._sparse_ops
+    ex_c, pc, lc = _run(build(True), batch, n_devices=8, strategy=store)
+    assert [op.name for op in ex_c._sparse_ops] == ["tables"]
+    assert ld == pytest.approx(lc, rel=1e-6)
+    np.testing.assert_allclose(
+        pd["tables"]["table"], pc["tables"]["table"], rtol=1e-6, atol=1e-7
+    )
 
 
 def test_word_embedding_sparse(rng):
